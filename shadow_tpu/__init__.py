@@ -1,0 +1,36 @@
+"""shadow_tpu — a TPU-native parallel discrete-event network simulator.
+
+A ground-up rebuild of the capabilities of Shadow (github.com/shadow/shadow,
+reference snapshot at /root/reference) designed for TPU hardware: simulated
+hosts live as rows of HBM-resident state tensors, per-host event queues are
+fixed-slot tensors drained by a jitted conservative-PDES round step, and
+in-flight packets move as a batched sparse exchange (all-to-all over ICI when
+hosts are sharded across a `jax.sharding.Mesh`).
+
+Design contract inherited from the reference (see SURVEY.md):
+  * total event order = (time, variant Packet<Local, src_host_id, per-src seq)
+    [reference: src/main/core/work/event.rs:104-155]
+  * conservative lookahead: round length = min link latency
+    [reference: src/main/core/scheduler/runahead.rs:43-56]
+  * cross-host packet delivery time clamped to >= round end
+    [reference: src/main/core/worker.rs:399-402]
+  * per-host deterministic RNG, drawn in event-execution order
+    [reference: src/main/host/host.rs:218]  (re-specified counter-based here)
+
+Simulation times are i64 nanoseconds; x64 must be enabled before any jax
+arrays are created, which importing this package guarantees.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from shadow_tpu.simtime import (  # noqa: E402
+    SIM_START_UNIX_NS,
+    NS_PER_US,
+    NS_PER_MS,
+    NS_PER_SEC,
+    TIME_MAX,
+)
+
+__version__ = "0.1.0"

@@ -1,0 +1,38 @@
+"""`shadow-tpu` command-line entry point.
+
+Mirrors the reference's CLI shape (reference: src/main/core/main.rs:61-120):
+a YAML config plus flag overrides drives a simulation. The full config
+system and runtime land with the controller/manager; until then this is a
+minimal front door that reports version/devices and refuses politely.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    import shadow_tpu
+
+    parser = argparse.ArgumentParser(
+        prog="shadow-tpu",
+        description="TPU-native parallel discrete-event network simulator",
+    )
+    parser.add_argument("--version", action="version", version=f"shadow-tpu {shadow_tpu.__version__}")
+    sub = parser.add_subparsers(dest="command")
+    run_p = sub.add_parser("run", help="run a simulation from a YAML config")
+    run_p.add_argument("config", help="path to shadow.yaml-style config")
+    run_p.add_argument("--show-config", action="store_true", help="print resolved config and exit")
+    args = parser.parse_args(argv)
+
+    if args.command == "run":
+        from shadow_tpu.runtime.cli_run import run_from_config
+
+        return run_from_config(args.config, show_config=args.show_config)
+    parser.print_help()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

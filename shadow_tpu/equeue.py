@@ -1,0 +1,217 @@
+"""Vectorized per-host event queues as fixed-slot HBM tensors.
+
+Replaces the reference's per-host `BinaryHeap<Reverse<Event>>`
+(reference: src/main/core/work/event_queue.rs:10-49) with a
+struct-of-arrays layout: H hosts x Q slots. Slots [0, count[h]) of row h
+hold that host's pending events in *arbitrary* order; "pop" is a two-stage
+masked argmin over the total-order key (time, tie) from events.py, and the
+freed slot is back-filled with the last valid slot so rows stay compact.
+
+All operations are branch-free, fixed-shape, and vectorized over hosts so
+they trace into a single XLA computation (no per-host Python loops).
+
+The reference panics when the queue would pop out of order
+(event_queue.rs:26-31); here ordering is intrinsic (argmin), and the
+analogous failure mode is slot exhaustion, which we track per host in
+`overflow` rather than silently dropping.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from shadow_tpu.events import KIND_INVALID, pack_tie, tie_src_host
+from shadow_tpu.simtime import TIME_MAX
+
+# Number of i32 payload lanes carried by every event. Models/packets pack
+# their data into these (see engine/state.py for layouts).
+PAYLOAD_LANES = 4
+
+_I64_MAX = jnp.iinfo(jnp.int64).max
+
+
+@flax.struct.dataclass
+class EventQueue:
+    """H x Q event slots + per-host fill counts."""
+
+    time: jax.Array  # [H, Q] i64 ns; TIME_MAX in empty slots
+    tie: jax.Array  # [H, Q] i64 packed (variant, src_host, seq); _I64_MAX when empty
+    kind: jax.Array  # [H, Q] i32 dispatch code; KIND_INVALID when empty
+    data: jax.Array  # [H, Q, PAYLOAD_LANES] i32
+    count: jax.Array  # [H] i32 number of valid slots
+    overflow: jax.Array  # [H] i32 number of events dropped for lack of slots
+
+    @property
+    def num_hosts(self) -> int:
+        return self.time.shape[0]
+
+    @property
+    def capacity(self) -> int:
+        return self.time.shape[1]
+
+
+def create(num_hosts: int, capacity: int) -> EventQueue:
+    h, q = num_hosts, capacity
+    return EventQueue(
+        time=jnp.full((h, q), TIME_MAX, dtype=jnp.int64),
+        tie=jnp.full((h, q), _I64_MAX, dtype=jnp.int64),
+        kind=jnp.full((h, q), KIND_INVALID, dtype=jnp.int32),
+        data=jnp.zeros((h, q, PAYLOAD_LANES), dtype=jnp.int32),
+        count=jnp.zeros((h,), dtype=jnp.int32),
+        overflow=jnp.zeros((h,), dtype=jnp.int32),
+    )
+
+
+def next_time(q: EventQueue) -> jax.Array:
+    """[H] i64: each host's earliest pending event time (TIME_MAX if none)."""
+    return jnp.min(q.time, axis=1)
+
+
+@flax.struct.dataclass
+class Popped:
+    """One popped event per host (valid marks hosts that actually popped)."""
+
+    valid: jax.Array  # [H] bool
+    time: jax.Array  # [H] i64
+    tie: jax.Array  # [H] i64
+    kind: jax.Array  # [H] i32
+    data: jax.Array  # [H, PAYLOAD_LANES] i32
+
+    @property
+    def src_host(self) -> jax.Array:
+        return tie_src_host(self.tie).astype(jnp.int32)
+
+
+def pop_min(q: EventQueue, want: jax.Array) -> tuple[Popped, EventQueue]:
+    """Pop each host's minimum event where `want[h]` and the host is non-empty.
+
+    Ordering follows the reference's total order: min by time, ties broken by
+    the packed (variant, src_host, seq) key (event.rs:104-155). The freed slot
+    is back-filled from slot count-1 to keep rows compact.
+    """
+    h_idx = jnp.arange(q.num_hosts)
+    slot_idx = jnp.arange(q.capacity)[None, :]
+
+    tmin = jnp.min(q.time, axis=1)  # [H]
+    at_min = q.time == tmin[:, None]
+    tie_masked = jnp.where(at_min, q.tie, _I64_MAX)
+    slot = jnp.argmin(tie_masked, axis=1)  # [H]
+
+    valid = want & (q.count > 0)
+
+    ev = Popped(
+        valid=valid,
+        time=q.time[h_idx, slot],
+        tie=q.tie[h_idx, slot],
+        kind=q.kind[h_idx, slot],
+        data=q.data[h_idx, slot, :],
+    )
+
+    # Back-fill the popped slot with the last valid slot, then clear the last.
+    last = q.count - 1
+    take_last = (slot_idx == slot[:, None]) & valid[:, None]
+    clear = (slot_idx == last[:, None]) & valid[:, None]
+
+    def fill(arr, empty_val):
+        from_last = arr[h_idx, last]
+        if arr.ndim == 3:
+            out = jnp.where(take_last[:, :, None], from_last[:, None, :], arr)
+            return jnp.where(clear[:, :, None], empty_val, out)
+        out = jnp.where(take_last, from_last[:, None], arr)
+        return jnp.where(clear, empty_val, out)
+
+    return ev, q.replace(
+        time=fill(q.time, TIME_MAX),
+        tie=fill(q.tie, _I64_MAX),
+        kind=fill(q.kind, KIND_INVALID),
+        data=fill(q.data, 0),
+        count=q.count - valid.astype(jnp.int32),
+    )
+
+
+def push_self(
+    q: EventQueue,
+    valid: jax.Array,  # [H] bool
+    time: jax.Array,  # [H] i64
+    tie: jax.Array,  # [H] i64
+    kind: jax.Array,  # [H] i32
+    data: jax.Array,  # [H, PAYLOAD_LANES] i32
+) -> EventQueue:
+    """Each host pushes at most one event into its *own* queue (conflict-free)."""
+    slot_idx = jnp.arange(q.capacity)[None, :]
+    has_room = q.count < q.capacity
+    write = valid & has_room
+    at = (slot_idx == q.count[:, None]) & write[:, None]
+    return q.replace(
+        time=jnp.where(at, time[:, None], q.time),
+        tie=jnp.where(at, tie[:, None], q.tie),
+        kind=jnp.where(at, kind[:, None], q.kind),
+        data=jnp.where(at[:, :, None], data[:, None, :], q.data),
+        count=q.count + write.astype(jnp.int32),
+        overflow=q.overflow + (valid & ~has_room).astype(jnp.int32),
+    )
+
+
+def push_many(
+    q: EventQueue,
+    dst: jax.Array,  # [M] i32 destination host ids
+    valid: jax.Array,  # [M] bool
+    time: jax.Array,  # [M] i64
+    tie: jax.Array,  # [M] i64
+    kind: jax.Array,  # [M] i32
+    data: jax.Array,  # [M, PAYLOAD_LANES] i32
+) -> EventQueue:
+    """Batched push of M events to arbitrary destination hosts.
+
+    This is the round-boundary exchange step (the analogue of
+    Worker::push_packet_to_host, reference src/main/core/worker.rs:619-629,
+    minus the mutex): sort entries by destination, rank within each
+    destination segment, and scatter into each destination's free slots.
+    """
+    m = dst.shape[0]
+    num_hosts = q.num_hosts
+    pos = jnp.arange(m)
+
+    # Invalid entries sort to a sentinel destination past all hosts and are
+    # dropped by out-of-bounds scatter semantics.
+    key = jnp.where(valid, dst, num_hosts).astype(jnp.int32)
+    order = jnp.argsort(key, stable=True)
+    key_s = key[order]
+    valid_s = valid[order]
+
+    seg_start = jnp.concatenate([jnp.ones((1,), bool), key_s[1:] != key_s[:-1]])
+    start_pos = jax.lax.cummax(jnp.where(seg_start, pos, -1))
+    rank = pos - start_pos  # index within this destination's batch
+
+    slot = q.count[jnp.minimum(key_s, num_hosts - 1)] + rank.astype(jnp.int32)
+    fits = valid_s & (slot < q.capacity)
+    # Route dropped/invalid entries fully out of bounds so scatter drops them.
+    sdst = jnp.where(fits, key_s, num_hosts)
+    sslot = jnp.where(fits, slot, q.capacity)
+
+    return q.replace(
+        time=q.time.at[sdst, sslot].set(time[order], mode="drop"),
+        tie=q.tie.at[sdst, sslot].set(tie[order], mode="drop"),
+        kind=q.kind.at[sdst, sslot].set(kind[order], mode="drop"),
+        data=q.data.at[sdst, sslot].set(data[order], mode="drop"),
+        count=q.count.at[sdst].add(fits.astype(jnp.int32), mode="drop"),
+        overflow=q.overflow.at[jnp.where(valid_s & ~fits, key_s, num_hosts)].add(
+            (valid_s & ~fits).astype(jnp.int32), mode="drop"
+        ),
+    )
+
+
+def debug_sorted_events(q: EventQueue, host: int):
+    """Host-side helper: the given host's events in pop order (for tests)."""
+    time = jax.device_get(q.time[host])
+    tie = jax.device_get(q.tie[host])
+    kind = jax.device_get(q.kind[host])
+    data = jax.device_get(q.data[host])
+    n = int(q.count[host])
+    items = sorted(
+        ((int(time[i]), int(tie[i]), int(kind[i]), tuple(int(x) for x in data[i])) for i in range(q.capacity) if kind[i] != KIND_INVALID),
+    )
+    assert len(items) == n, (len(items), n)
+    return items

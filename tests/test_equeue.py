@@ -1,0 +1,130 @@
+"""Event-queue semantics: pop order must equal the reference total order
+(time, Packet<Local, src_host, seq) — reference src/main/core/work/event.rs:104-155 —
+validated property-style against a plain Python sorted list."""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu import equeue
+from shadow_tpu.equeue import PAYLOAD_LANES
+from shadow_tpu.events import KIND_PACKET, pack_tie, tie_seq, tie_src_host, tie_is_local
+from shadow_tpu.simtime import TIME_MAX
+
+
+def _mk_events(rng, n, num_hosts, seq_base=0):
+    evs = []
+    for i in range(n):
+        t = rng.randrange(0, 50)
+        kind = rng.choice([KIND_PACKET, 1, 2])
+        src = rng.randrange(num_hosts)
+        seq = seq_base + i
+        data = [rng.randrange(100) for _ in range(PAYLOAD_LANES)]
+        evs.append((t, kind, src, seq, data))
+    return evs
+
+
+def test_tie_packing_roundtrip():
+    tie = pack_tie(3, 12345, 678)
+    assert tie_src_host(tie) == 12345
+    assert tie_seq(tie) == 678
+    assert tie_is_local(tie) == 1
+    tie_p = pack_tie(KIND_PACKET, 1, 2)
+    assert tie_is_local(tie_p) == 0
+    assert tie_p < tie  # packets sort before locals at equal time
+
+
+def test_push_pop_single_host_matches_sorted_order():
+    rng = random.Random(7)
+    H, Q, N = 3, 64, 40
+    q = equeue.create(H, Q)
+    expect = {h: [] for h in range(H)}
+    evs = _mk_events(rng, N, H)
+    for t, kind, src, seq, data in evs:
+        dsth = rng.randrange(H)
+        tie = pack_tie(kind, src, seq)
+        q = equeue.push_many(
+            q,
+            dst=jnp.array([dsth], jnp.int32),
+            valid=jnp.array([True]),
+            time=jnp.array([t], jnp.int64),
+            tie=jnp.array([tie], jnp.int64),
+            kind=jnp.array([kind], jnp.int32),
+            data=jnp.array([data], jnp.int32),
+        )
+        expect[dsth].append((t, tie, kind, tuple(data)))
+
+    assert int(q.overflow.sum()) == 0
+    assert [int(c) for c in q.count] == [len(expect[h]) for h in range(H)]
+
+    # pop everything from all hosts simultaneously; per-host order must match
+    got = {h: [] for h in range(H)}
+    for _ in range(max(len(v) for v in expect.values())):
+        ev, q = equeue.pop_min(q, jnp.ones((H,), bool))
+        for h in range(H):
+            if bool(ev.valid[h]):
+                got[h].append((int(ev.time[h]), int(ev.tie[h]), int(ev.kind[h]), tuple(int(x) for x in ev.data[h])))
+    for h in range(H):
+        assert got[h] == sorted(expect[h]), f"host {h}"
+    assert int(q.count.sum()) == 0
+    assert int(jnp.min(q.time)) == TIME_MAX
+
+
+def test_batched_push_with_conflicts():
+    rng = random.Random(3)
+    H, Q, M = 5, 32, 60
+    q = equeue.create(H, Q)
+    dst = [rng.randrange(H) for _ in range(M)]
+    valid = [rng.random() < 0.8 for _ in range(M)]
+    evs = _mk_events(rng, M, H)
+    ties = [pack_tie(k, s, sq) for (_, k, s, sq, _) in evs]
+    q = equeue.push_many(
+        q,
+        dst=jnp.array(dst, jnp.int32),
+        valid=jnp.array(valid),
+        time=jnp.array([e[0] for e in evs], jnp.int64),
+        tie=jnp.array(ties, jnp.int64),
+        kind=jnp.array([e[1] for e in evs], jnp.int32),
+        data=jnp.array([e[4] for e in evs], jnp.int32),
+    )
+    expect = {h: [] for h in range(H)}
+    for i in range(M):
+        if valid[i]:
+            t, k, _, _, d = evs[i]
+            expect[dst[i]].append((t, ties[i], k, tuple(d)))
+    for h in range(H):
+        assert equeue.debug_sorted_events(q, h) == sorted(expect[h])
+
+
+def test_push_self_and_overflow():
+    H, Q = 4, 2
+    q = equeue.create(H, Q)
+    for i in range(3):  # third push overflows every host
+        q = equeue.push_self(
+            q,
+            valid=jnp.ones((H,), bool),
+            time=jnp.full((H,), 10 + i, jnp.int64),
+            tie=jnp.array([pack_tie(1, h, i) for h in range(H)], jnp.int64),
+            kind=jnp.full((H,), 1, jnp.int32),
+            data=jnp.zeros((H, PAYLOAD_LANES), jnp.int32),
+        )
+    np.testing.assert_array_equal(np.asarray(q.count), 2)
+    np.testing.assert_array_equal(np.asarray(q.overflow), 1)
+
+
+def test_pop_respects_want_mask_and_empty_hosts():
+    H, Q = 3, 4
+    q = equeue.create(H, Q)
+    q = equeue.push_self(
+        q,
+        valid=jnp.array([True, False, True]),
+        time=jnp.array([5, 0, 9], jnp.int64),
+        tie=jnp.array([pack_tie(1, h, 0) for h in range(H)], jnp.int64),
+        kind=jnp.full((H,), 1, jnp.int32),
+        data=jnp.zeros((H, PAYLOAD_LANES), jnp.int32),
+    )
+    ev, q = equeue.pop_min(q, jnp.array([True, True, False]))
+    assert bool(ev.valid[0]) and not bool(ev.valid[1]) and not bool(ev.valid[2])
+    assert int(ev.time[0]) == 5
+    assert [int(c) for c in q.count] == [0, 0, 1]

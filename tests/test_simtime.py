@@ -1,0 +1,34 @@
+from shadow_tpu.simtime import (
+    NS_PER_MS,
+    NS_PER_SEC,
+    SIM_START_UNIX_NS,
+    TIME_MAX,
+    fmt_time_ns,
+    parse_time_ns,
+)
+
+
+def test_epoch_is_y2k():
+    # 2000-01-01T00:00:00Z == 946684800 Unix seconds
+    assert SIM_START_UNIX_NS == 946684800 * NS_PER_SEC
+
+
+def test_parse_time():
+    assert parse_time_ns("10 ms") == 10 * NS_PER_MS
+    assert parse_time_ns("2 sec") == 2 * NS_PER_SEC
+    assert parse_time_ns("2s") == 2 * NS_PER_SEC
+    assert parse_time_ns("1 min") == 60 * NS_PER_SEC
+    assert parse_time_ns("30") == 30 * NS_PER_SEC
+    assert parse_time_ns(5) == 5 * NS_PER_SEC
+    assert parse_time_ns("1500 ns") == 1500
+    assert parse_time_ns("2.5 us") == 2500
+
+
+def test_fmt_time():
+    assert fmt_time_ns(0).startswith("2000-01-01 00:00:00")
+    assert fmt_time_ns(TIME_MAX) == "never"
+
+
+def test_time_max_headroom():
+    # adding a large latency to TIME_MAX must not overflow i64
+    assert TIME_MAX + 10 * NS_PER_SEC < (1 << 63) - 1
