@@ -1,0 +1,3 @@
+from shadow_tpu.cpu_ref.sim import CpuRefPhold
+
+__all__ = ["CpuRefPhold"]

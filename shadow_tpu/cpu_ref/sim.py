@@ -1,0 +1,124 @@
+"""CPU reference simulator: plain-Python heapq implementation of the exact
+engine semantics, used as the conformance oracle for the device engine
+(the role the reference's native schedulers play for the --scheduler=tpu
+backend, and the model for our determinism tests per
+src/test/determinism/CMakeLists.txt).
+
+Every random draw calls the same threefry functions as the device engine
+(elementwise), so a conforming engine must match bit-for-bit: identical
+event traces under the total order, identical final counters, identical
+leftover queue contents.
+"""
+
+from __future__ import annotations
+
+import heapq
+
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu import rng
+from shadow_tpu.engine.state import EngineConfig
+from shadow_tpu.events import KIND_PACKET, pack_tie
+from shadow_tpu.models.phold import KIND_SEND, PholdModel
+from shadow_tpu.simtime import TIME_MAX
+
+
+class CpuRefPhold:
+    def __init__(self, cfg: EngineConfig, model: PholdModel, tables, host_node):
+        self.cfg = cfg
+        self.model = model
+        self.h = cfg.num_hosts
+        self.keys = rng.host_keys(cfg.seed, self.h)
+        self.lat = np.asarray(tables.lat_ns)
+        self.rel = np.asarray(tables.rel)
+        self.node = [int(x) for x in host_node]
+        self.queues = [[] for _ in range(self.h)]  # heaps of (time, tie, kind, data)
+        self.seq = [0] * self.h
+        self.ctr = [0] * self.h
+        self.recv = [0] * self.h
+        self.send = [0] * self.h
+        self.packets_sent = [0] * self.h
+        self.packets_dropped = [0] * self.h
+        self.trace = []  # (time, tie, kind, data, host) in processing order
+
+    # --- identical draw primitives (threefry, counter-based) ---
+    def _u_int(self, host, counter, lo, hi) -> int:
+        return int(
+            rng.uniform_int(
+                self.keys[host : host + 1], jnp.array([counter], jnp.uint32), lo, hi
+            )[0]
+        )
+
+    def _u_f32(self, host, counter) -> float:
+        return float(
+            rng.uniform_f32(self.keys[host : host + 1], jnp.array([counter], jnp.uint32))[0]
+        )
+
+    def _peer(self, host, counter) -> int:
+        if self.h == 1:
+            return 0
+        p = self._u_int(host, counter, 0, self.h - 1)
+        return p + (1 if p >= host else 0)
+
+    def bootstrap(self):
+        m = self.model
+        for host in range(self.h):
+            dst = self._peer(host, 0)
+            offset = self._u_int(host, 1, m.min_delay_ns, m.max_delay_ns)
+            tie = pack_tie(KIND_SEND, host, self.seq[host])
+            self.seq[host] += 1
+            heapq.heappush(self.queues[host], (offset, tie, KIND_SEND, (dst, 0, 0, 0)))
+            self.ctr[host] = m.BOOTSTRAP_DRAWS
+
+    def _handle(self, host, t, tie, kind, data, window_end, outbox):
+        m = self.model
+        self.trace.append((t, tie, kind, data, host))
+        base = self.ctr[host]
+        if kind == KIND_PACKET:
+            self.recv[host] += 1
+            dst = self._peer(host, base + 0)
+            delay = self._u_int(host, base + 1, m.min_delay_ns, m.max_delay_ns)
+            ltie = pack_tie(KIND_SEND, host, self.seq[host])
+            self.seq[host] += 1
+            heapq.heappush(self.queues[host], (t + delay, ltie, KIND_SEND, (dst, 0, 0, 0)))
+        elif kind == KIND_SEND:
+            self.send[host] += 1
+            dst = data[0]
+            lat = int(self.lat[self.node[host], self.node[dst]])
+            rel = float(self.rel[self.node[host], self.node[dst]])
+            loss_u = self._u_f32(host, base + m.DRAWS_PER_EVENT + 0)
+            if lat < TIME_MAX:
+                if loss_u < rel:
+                    deliver = max(t + lat, window_end)
+                    ptie = pack_tie(KIND_PACKET, host, self.seq[host])
+                    self.seq[host] += 1
+                    outbox.append((dst, deliver, ptie, (0, 0, 0, 0)))
+                    self.packets_sent[host] += 1
+                else:
+                    self.packets_dropped[host] += 1
+        else:
+            raise AssertionError(f"unknown kind {kind}")
+        self.ctr[host] = base + m.DRAWS_PER_EVENT + m.PACKET_EMITS
+
+    def next_time(self) -> int:
+        nts = [q[0][0] for q in self.queues if q]
+        return min(nts) if nts else TIME_MAX
+
+    def run_until(self, end_time: int):
+        while True:
+            start = self.next_time()
+            if start >= end_time:
+                break
+            window_end = min(start + self.cfg.runahead_ns, end_time)
+            outbox = []
+            for host in range(self.h):
+                q = self.queues[host]
+                while q and q[0][0] < window_end:
+                    t, tie, kind, data = heapq.heappop(q)
+                    self._handle(host, t, tie, kind, data, window_end, outbox)
+            for dst, deliver, ptie, data in outbox:
+                heapq.heappush(self.queues[dst], (deliver, ptie, KIND_PACKET, data))
+
+    def queue_contents(self, host) -> list:
+        return sorted(self.queues[host])

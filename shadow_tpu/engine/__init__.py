@@ -1,0 +1,27 @@
+from shadow_tpu.engine.state import EngineConfig, LocalEmits, PacketEmits, SimState, init_state
+from shadow_tpu.engine.round import (
+    bootstrap,
+    round_body_debug,
+    run_round,
+    run_rounds_scan,
+    run_until,
+    validate_runahead,
+)
+from shadow_tpu.engine.sharded import ShardedRunner, shard_state, state_specs
+
+__all__ = [
+    "EngineConfig",
+    "LocalEmits",
+    "PacketEmits",
+    "SimState",
+    "ShardedRunner",
+    "bootstrap",
+    "init_state",
+    "round_body_debug",
+    "run_round",
+    "run_rounds_scan",
+    "run_until",
+    "shard_state",
+    "state_specs",
+    "validate_runahead",
+]

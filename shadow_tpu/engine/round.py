@@ -1,0 +1,368 @@
+"""The conservative-PDES round engine, jitted end to end.
+
+This is the TPU lift of the reference's scheduling loop (reference:
+src/main/core/manager.rs:392-478 + src/main/host/host.rs:697-752): each round
+is a window [start, start + runahead) in which every host drains its own
+event queue independently (lookahead guarantees no cross-host effect lands
+inside the window), cross-host packets stage into per-host outboxes with
+delivery clamped to >= round end (worker.rs:399-402), and one batched
+exchange at the round boundary replaces the reference's mutex push into the
+destination's queue (worker.rs:619-629).
+
+Inside a round the engine iterates: every host with an eligible event pops
+its minimum-key event simultaneously; handlers are vectorized over hosts.
+The iteration count is the max events any single host handles this round —
+hosts are rows, the event loop is data-parallel, and the whole thing traces
+into a single XLA while loop (no host<->device sync until the caller asks).
+
+With `axis_name` set, the same code runs under shard_map with hosts block-
+sharded across devices: the window min becomes a pmin over ICI and the
+boundary exchange an all_gather (all-to-all refinement is a later round's
+optimization).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from shadow_tpu import equeue, rng
+from shadow_tpu.engine.state import EngineConfig, SimState
+from shadow_tpu.equeue import PAYLOAD_LANES
+from shadow_tpu.events import KIND_PACKET, pack_tie
+from shadow_tpu.graph.routing import RoutingTables
+from shadow_tpu.simtime import TIME_MAX
+
+
+@dataclasses.dataclass(frozen=True)
+class Draw:
+    """Per-host counter-based draw access for one handler invocation.
+
+    Logical draw i of this event = threefry(host_key, counter + i). The
+    engine advances counters by the fixed per-event stride afterwards, so
+    draws are in event-execution order per host, like the reference's
+    per-host RNG (host.rs:218).
+    """
+
+    key: jax.Array  # [H]
+    counter: jax.Array  # [H] u32
+
+    def uniform(self, i: int) -> jax.Array:
+        return rng.uniform_f32(self.key, self.counter + jnp.uint32(i))
+
+    def uniform_int(self, i: int, lo, hi) -> jax.Array:
+        return rng.uniform_int(self.key, self.counter + jnp.uint32(i), lo, hi)
+
+    def exponential_ns(self, i: int, mean_ns) -> jax.Array:
+        return rng.exponential_ns(self.key, self.counter + jnp.uint32(i), mean_ns)
+
+
+def _lane_seqs(valid: jax.Array, base: jax.Array):
+    """Per-lane sequence numbers: base + (# valid lanes before this one)."""
+    ranks = jnp.cumsum(valid.astype(jnp.uint32), axis=1) - valid.astype(jnp.uint32)
+    return base[:, None] + ranks, base + jnp.sum(valid.astype(jnp.uint32), axis=1)
+
+
+def bootstrap(st: SimState, model, cfg: EngineConfig) -> SimState:
+    """Push the model's initial events (the analogue of Host::boot +
+    add_application scheduling, reference host.rs:374-436)."""
+    host_ids = st.host_id
+    draw = Draw(st.rng_key, st.rng_counter)
+    lemits = model.bootstrap(draw, host_ids)
+    lseq, seq_final = _lane_seqs(lemits.valid, st.seq)
+    queue = st.queue
+    for l in range(lemits.valid.shape[1]):
+        queue = equeue.push_self(
+            queue,
+            valid=lemits.valid[:, l],
+            time=lemits.time[:, l],
+            tie=pack_tie(lemits.kind[:, l], host_ids, lseq[:, l]),
+            kind=lemits.kind[:, l],
+            data=lemits.data[:, l, :],
+        )
+    return st.replace(
+        queue=queue,
+        seq=seq_final,
+        rng_counter=st.rng_counter + jnp.uint32(model.BOOTSTRAP_DRAWS),
+    )
+
+
+def handle_one_iteration(
+    st: SimState,
+    window_end: jax.Array,
+    model,
+    tables: RoutingTables,
+    cfg: EngineConfig,
+) -> SimState:
+    """Pop + handle one event per eligible host; stage emissions.
+
+    Works on local (per-shard) rows; `st.host_id` carries global ids and
+    `tables.host_node` is the replicated global host->node map, so packet
+    destinations are global host ids everywhere.
+    """
+    host_ids = st.host_id
+
+    want = equeue.next_time(st.queue) < window_end
+    ev, q = equeue.pop_min(st.queue, want)
+    st = st.replace(queue=q)
+
+    draw = Draw(st.rng_key, st.rng_counter)
+    mstate, lemits, pemits = model.handle(st.model, ev, draw, cfg, host_ids)
+
+    lvalid = lemits.valid & ev.valid[:, None]  # [H, EL]
+    pvalid = pemits.valid & ev.valid[:, None]  # [H, EP]
+    ep = pvalid.shape[1]
+
+    # --- packet path: routing lookup, loss draw, delivery clamp ---
+    src_node = tables.host_node[host_ids]  # [H]
+    dst_clamped = jnp.clip(pemits.dst, 0, tables.num_global_hosts - 1)
+    dst_node = tables.host_node[dst_clamped]  # [H, EP]
+    lat = tables.lat_ns[src_node[:, None], dst_node]  # [H, EP] i64
+    rel = tables.rel[src_node[:, None], dst_node]  # [H, EP] f32
+
+    unroutable = pvalid & (lat >= TIME_MAX)
+    loss_u = jnp.stack(
+        [draw.uniform(model.DRAWS_PER_EVENT + p) for p in range(ep)], axis=1
+    )  # [H, EP]; one loss draw per packet lane, drawn in lane order
+    kept = pvalid & ~unroutable & (loss_u < rel)
+    dropped = pvalid & ~unroutable & ~(loss_u < rel)
+
+    deliver = jnp.maximum(ev.time[:, None] + lat, window_end)  # [H, EP]
+
+    # --- sequence numbers: local lanes first, then surviving packets ---
+    lseq, seq_after_locals = _lane_seqs(lvalid, st.seq)
+    pseq, seq_final = _lane_seqs(kept, seq_after_locals)
+
+    # --- push local events into own queues (row-wise, conflict-free) ---
+    queue = st.queue
+    for l in range(lvalid.shape[1]):
+        queue = equeue.push_self(
+            queue,
+            valid=lvalid[:, l],
+            time=lemits.time[:, l],
+            tie=pack_tie(lemits.kind[:, l], host_ids, lseq[:, l]),
+            kind=lemits.kind[:, l],
+            data=lemits.data[:, l, :],
+        )
+
+    # --- stage surviving packets into own outbox rows ---
+    ob = st.outbox
+    o_cap = ob.valid.shape[1]
+    lane_idx = jnp.arange(o_cap)[None, :]
+    fill, overflow = ob.fill, ob.overflow
+    obv, obd, obt, obtie, obdata = ob.valid, ob.dst, ob.time, ob.tie, ob.data
+    pkt_kind = jnp.full(host_ids.shape, KIND_PACKET, jnp.int32)
+    for p in range(ep):
+        has_room = fill < o_cap
+        write = kept[:, p] & has_room
+        at = (lane_idx == fill[:, None]) & write[:, None]
+        tie = pack_tie(pkt_kind, host_ids, pseq[:, p])
+        obv = obv | at
+        obd = jnp.where(at, dst_clamped[:, p][:, None], obd)
+        obt = jnp.where(at, deliver[:, p][:, None], obt)
+        obtie = jnp.where(at, tie[:, None], obtie)
+        obdata = jnp.where(at[:, :, None], pemits.data[:, p, None, :], obdata)
+        fill = fill + write.astype(jnp.int32)
+        overflow = overflow + (kept[:, p] & ~has_room).astype(jnp.int32)
+    ob = ob.replace(valid=obv, dst=obd, time=obt, tie=obtie, data=obdata, fill=fill, overflow=overflow)
+
+    stride = jnp.uint32(model.DRAWS_PER_EVENT + ep)
+    return st.replace(
+        queue=queue,
+        outbox=ob,
+        model=mstate,
+        seq=seq_final,
+        rng_counter=st.rng_counter + stride * ev.valid.astype(jnp.uint32),
+        events_handled=st.events_handled + ev.valid,
+        packets_sent=st.packets_sent + jnp.sum(kept, axis=1),
+        packets_dropped=st.packets_dropped + jnp.sum(dropped, axis=1),
+        packets_unroutable=st.packets_unroutable + jnp.sum(unroutable, axis=1),
+    )
+
+
+def flush_outbox(st: SimState, axis_name: Optional[str]) -> SimState:
+    """Round-boundary exchange: deliver staged packets into destination queues.
+
+    Sharded, this is the cross-chip step: gather every shard's outbox over
+    the mesh, keep entries addressed to local hosts, push. (The reference's
+    analogue is the locked cross-host EventQueue push, worker.rs:619-629.)
+    """
+    ob = st.outbox
+    h_local, o_cap = ob.valid.shape
+
+    def flat(x):
+        return x.reshape((h_local * o_cap,) + x.shape[2:])
+
+    valid, dst, time, tie = flat(ob.valid), flat(ob.dst), flat(ob.time), flat(ob.tie)
+    data = flat(ob.data)
+
+    base = 0
+    if axis_name is not None:
+        valid = jax.lax.all_gather(valid, axis_name, tiled=True)
+        dst = jax.lax.all_gather(dst, axis_name, tiled=True)
+        time = jax.lax.all_gather(time, axis_name, tiled=True)
+        tie = jax.lax.all_gather(tie, axis_name, tiled=True)
+        data = jax.lax.all_gather(data, axis_name, tiled=True)
+        base = jax.lax.axis_index(axis_name) * h_local
+
+    local_dst = dst - base
+    mine = valid & (local_dst >= 0) & (local_dst < h_local)
+    queue = equeue.push_many(
+        st.queue,
+        dst=local_dst,
+        valid=mine,
+        time=time,
+        tie=tie,
+        kind=jnp.full(valid.shape, KIND_PACKET, jnp.int32),
+        data=data,
+    )
+
+    fresh = ob.replace(
+        valid=jnp.zeros_like(ob.valid),
+        time=jnp.full_like(ob.time, TIME_MAX),
+        fill=jnp.zeros_like(ob.fill),
+    )
+    return st.replace(queue=queue, outbox=fresh)
+
+
+def run_round(
+    st: SimState,
+    window_end: jax.Array,
+    model,
+    tables: RoutingTables,
+    cfg: EngineConfig,
+    axis_name: Optional[str] = None,
+) -> SimState:
+    """Drain all events < window_end on every host, then exchange packets."""
+
+    def cond(carry):
+        s, iters = carry
+        return jnp.any(equeue.next_time(s.queue) < window_end) & (
+            iters < cfg.max_iters_per_round
+        )
+
+    def body(carry):
+        s, iters = carry
+        return handle_one_iteration(s, window_end, model, tables, cfg), iters + 1
+
+    st, _ = jax.lax.while_loop(cond, body, (st, jnp.asarray(0, jnp.int32)))
+    st = flush_outbox(st, axis_name)
+    return st.replace(now=jnp.maximum(st.now, window_end))
+
+
+def _next_window_end(st: SimState, end_time, runahead_ns, axis_name):
+    start = jnp.min(equeue.next_time(st.queue))
+    if axis_name is not None:
+        start = jax.lax.pmin(start, axis_name)
+    start = jnp.minimum(start, end_time)
+    return jnp.minimum(start + runahead_ns, end_time)
+
+
+def run_rounds_scan(
+    st: SimState,
+    end_time: jax.Array,
+    num_rounds: int,
+    model,
+    tables: RoutingTables,
+    cfg: EngineConfig,
+    axis_name: Optional[str] = None,
+) -> SimState:
+    """Run a fixed number of rounds fully on device (rounds past the end of
+    the simulation, or past the last pending event, are no-ops)."""
+
+    def one(s, _):
+        window_end = _next_window_end(s, end_time, cfg.runahead_ns, axis_name)
+        return run_round(s, window_end, model, tables, cfg, axis_name), None
+
+    st, _ = jax.lax.scan(one, st, None, length=num_rounds)
+    return st
+
+
+def validate_runahead(cfg: EngineConfig, tables: RoutingTables) -> None:
+    """The conservative window must not exceed the minimum possible path
+    latency, or cross-host deliveries would be silently delayed by the
+    round-end clamp (the reference derives the window from the graph for
+    the same reason, runahead.rs:43-56)."""
+    min_lat = tables.min_path_latency_ns()
+    if cfg.runahead_ns > min_lat:
+        raise ValueError(
+            f"runahead_ns={cfg.runahead_ns} exceeds the minimum path latency "
+            f"{min_lat}ns; use runahead_ns <= graph.min_latency_ns()"
+        )
+
+
+@jax.jit
+def _peek_next_time(st: SimState) -> jax.Array:
+    return jnp.min(equeue.next_time(st.queue))
+
+
+def _run_chunk(st, end, num_rounds, model, tables, cfg):
+    return run_rounds_scan(st, end, num_rounds, model, tables, cfg)
+
+
+# model/cfg are hashable frozen dataclasses -> proper jit cache keys, so
+# repeated run_until calls reuse the compiled chunk executable.
+_run_chunk_jit = jax.jit(_run_chunk, static_argnums=(2, 3, 5))
+
+
+def run_until(
+    st: SimState,
+    end_time: int,
+    model,
+    tables: RoutingTables,
+    cfg: EngineConfig,
+    rounds_per_chunk: int = 64,
+    max_chunks: int = 10_000,
+) -> SimState:
+    """Host-side driver: chunked device scans until no work remains before
+    end_time (one host<->device sync per chunk). Single-device variant; the
+    sharded driver lives in engine/sharded.py."""
+    validate_runahead(cfg, tables)
+    end = jnp.asarray(end_time, jnp.int64)
+
+    for _ in range(max_chunks):
+        nt = int(_peek_next_time(st))
+        if nt >= end_time:
+            return st
+        st = _run_chunk_jit(st, end, rounds_per_chunk, model, tables, cfg)
+    if int(_peek_next_time(st)) < end_time:
+        raise RuntimeError(
+            f"simulation did not reach end_time={end_time} within "
+            f"{max_chunks}x{rounds_per_chunk} rounds; raise max_chunks/rounds_per_chunk"
+        )
+    return st
+
+
+def round_body_debug(
+    st: SimState,
+    window_end,
+    model,
+    tables: RoutingTables,
+    cfg: EngineConfig,
+    trace: "list | None" = None,
+) -> SimState:
+    """Eager (non-while_loop) version of a round's drain phase for tests:
+    records every popped event into `trace` as
+    (time, tie, kind, data, host) tuples in pop order per iteration."""
+    window_end = jnp.asarray(window_end, jnp.int64)
+    while bool(jnp.any(equeue.next_time(st.queue) < window_end)):
+        if trace is not None:
+            want = equeue.next_time(st.queue) < window_end
+            ev, _ = equeue.pop_min(st.queue, want)
+            for hh in range(st.num_hosts):
+                if bool(ev.valid[hh]):
+                    trace.append(
+                        (
+                            int(ev.time[hh]),
+                            int(ev.tie[hh]),
+                            int(ev.kind[hh]),
+                            tuple(int(x) for x in ev.data[hh]),
+                            hh,
+                        )
+                    )
+        st = handle_one_iteration(st, window_end, model, tables, cfg)
+    st = flush_outbox(st, None)
+    return st.replace(now=jnp.maximum(st.now, window_end))

@@ -1,0 +1,151 @@
+"""Simulation state: hosts as rows of HBM-resident tensors.
+
+The per-host world the reference keeps behind `Host` (event queue, RNG,
+deterministic counters — reference: src/main/host/host.rs:96-205) becomes a
+struct-of-arrays pytree sharded/batched over the host axis. Model-specific
+per-host state (the analogue of processes/sockets) hangs off `model` as an
+opaque pytree whose leaves all lead with the host axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from shadow_tpu import equeue, rng
+from shadow_tpu.equeue import PAYLOAD_LANES, EventQueue
+from shadow_tpu.events import MAX_HOSTS
+from shadow_tpu.simtime import TIME_MAX
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Static (trace-time) engine parameters."""
+
+    num_hosts: int
+    queue_capacity: int = 64
+    outbox_capacity: int = 16
+    runahead_ns: int = 1_000_000  # min link latency; the conservative window
+    seed: int = 1
+    max_iters_per_round: int = 1_000_000
+    # draws consumed per handled event = model.DRAWS_PER_EVENT + PACKET_EMITS
+    # (one loss draw per packet lane), fixed-stride for determinism.
+
+    def __post_init__(self):
+        if not 0 < self.num_hosts <= MAX_HOSTS:
+            raise ValueError(f"num_hosts must be in (0, {MAX_HOSTS}]")
+        if self.runahead_ns <= 0:
+            raise ValueError("runahead must be > 0")
+
+
+@flax.struct.dataclass
+class Outbox:
+    """Per-host staging area for packets emitted during a round.
+
+    Rows are owned by the emitting host, so writes are conflict-free; the
+    round-boundary flush turns rows into a batched cross-host push (the
+    all-to-all exchange when sharded). Delivery times are already computed
+    (and clamped to >= round end, as in reference worker.rs:399-402).
+    """
+
+    valid: jax.Array  # [H, O] bool
+    dst: jax.Array  # [H, O] i32
+    time: jax.Array  # [H, O] i64 delivery time
+    tie: jax.Array  # [H, O] i64
+    data: jax.Array  # [H, O, PAYLOAD_LANES] i32
+    fill: jax.Array  # [H] i32 next free lane
+    overflow: jax.Array  # [H] i32 emissions dropped for lack of lanes
+
+
+def _empty_outbox(h: int, o: int) -> Outbox:
+    return Outbox(
+        valid=jnp.zeros((h, o), bool),
+        dst=jnp.zeros((h, o), jnp.int32),
+        time=jnp.full((h, o), TIME_MAX, jnp.int64),
+        tie=jnp.zeros((h, o), jnp.int64),
+        data=jnp.zeros((h, o, PAYLOAD_LANES), jnp.int32),
+        fill=jnp.zeros((h,), jnp.int32),
+        overflow=jnp.zeros((h,), jnp.int32),
+    )
+
+
+@flax.struct.dataclass
+class SimState:
+    now: jax.Array  # scalar i64: start of the current window
+    queue: EventQueue
+    outbox: Outbox
+    seq: jax.Array  # [H] u32 per-host event-id counter (tie-key source)
+    rng_key: jax.Array  # [H] per-host base keys
+    rng_counter: jax.Array  # [H] u32 per-host draw counter
+    host_id: jax.Array  # [H] i32 *global* host id of each row (shard-aware)
+    model: Any  # model-specific pytree, host-axis leading
+    # stats (per host)
+    events_handled: jax.Array  # [H] i64
+    packets_sent: jax.Array  # [H] i64
+    packets_dropped: jax.Array  # [H] i64  (path packet_loss)
+    packets_unroutable: jax.Array  # [H] i64  (no path; reference errors hard)
+
+    @property
+    def num_hosts(self) -> int:
+        return self.seq.shape[0]
+
+
+@flax.struct.dataclass
+class LocalEmits:
+    """Up to EL local (task/timer) events per host from one handler call."""
+
+    valid: jax.Array  # [H, EL] bool
+    time: jax.Array  # [H, EL] i64 absolute fire time
+    kind: jax.Array  # [H, EL] i32
+    data: jax.Array  # [H, EL, PAYLOAD_LANES] i32
+
+
+@flax.struct.dataclass
+class PacketEmits:
+    """Up to EP packets per host from one handler call."""
+
+    valid: jax.Array  # [H, EP] bool
+    dst: jax.Array  # [H, EP] i32 destination host id
+    data: jax.Array  # [H, EP, PAYLOAD_LANES] i32
+
+
+def empty_local_emits(h: int, el: int) -> LocalEmits:
+    return LocalEmits(
+        valid=jnp.zeros((h, el), bool),
+        time=jnp.zeros((h, el), jnp.int64),
+        kind=jnp.zeros((h, el), jnp.int32),
+        data=jnp.zeros((h, el, PAYLOAD_LANES), jnp.int32),
+    )
+
+
+def empty_packet_emits(h: int, ep: int) -> PacketEmits:
+    return PacketEmits(
+        valid=jnp.zeros((h, ep), bool),
+        dst=jnp.zeros((h, ep), jnp.int32),
+        data=jnp.zeros((h, ep, PAYLOAD_LANES), jnp.int32),
+    )
+
+
+def init_state(cfg: EngineConfig, model_state) -> SimState:
+    """Build the (global) initial state. The host->graph-node map lives on
+    RoutingTables (see RoutingTables.with_hosts), not here, because it must
+    stay replicated when the state is sharded over hosts."""
+    h = cfg.num_hosts
+    return SimState(
+        now=jnp.asarray(0, jnp.int64),
+        queue=equeue.create(h, cfg.queue_capacity),
+        outbox=_empty_outbox(h, cfg.outbox_capacity),
+        seq=jnp.zeros((h,), jnp.uint32),
+        rng_key=rng.host_keys(cfg.seed, h),
+        rng_counter=jnp.zeros((h,), jnp.uint32),
+        host_id=jnp.arange(h, dtype=jnp.int32),
+        model=model_state,
+        events_handled=jnp.zeros((h,), jnp.int64),
+        packets_sent=jnp.zeros((h,), jnp.int64),
+        packets_dropped=jnp.zeros((h,), jnp.int64),
+        packets_unroutable=jnp.zeros((h,), jnp.int64),
+    )

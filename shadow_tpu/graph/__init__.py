@@ -1,0 +1,14 @@
+from shadow_tpu.graph.gml import GmlGraph, parse_gml
+from shadow_tpu.graph.network_graph import ONE_GBIT_SWITCH_GML, NetworkGraph
+from shadow_tpu.graph.routing import RoutingTables, compute_routing
+from shadow_tpu.graph.ip import IpAssignment
+
+__all__ = [
+    "GmlGraph",
+    "parse_gml",
+    "NetworkGraph",
+    "ONE_GBIT_SWITCH_GML",
+    "RoutingTables",
+    "compute_routing",
+    "IpAssignment",
+]

@@ -1,0 +1,161 @@
+"""All-pairs path properties on device: tropical (min-plus) matrix squaring.
+
+The reference computes all-pairs shortest paths with a rayon-parallelized
+Dijkstra per source (reference: src/main/network/graph/mod.rs:185-230) or a
+direct-edges-only table (:232-254), composing per-path properties as
+latency-sum / reliability-product (:300-333). On TPU the natural formulation
+is matrix iteration over the (min, +) semiring: D <- min_k(D[i,k] + D[k,j]),
+log2(N) squarings, each a blocked "tropical matmul" carrying reliability
+along the argmin path. Ties pick the smallest intermediate node index, so
+the result is deterministic.
+
+Self-paths (diagonal) come from self-loop edges only, as in the reference
+(graph/mod.rs:212-219): a node with no self-loop has no path to itself.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.graph.network_graph import NetworkGraph
+from shadow_tpu.simtime import TIME_MAX
+
+
+@flax.struct.dataclass
+class RoutingTables:
+    """Dense node-to-node path properties, device-resident.
+
+    lat_ns[i, j] == TIME_MAX means unreachable. After `with_hosts`, the
+    engine looks paths up with a gather:
+    lat_ns[host_node[src_host], host_node[dst_host]]. `host_node` is indexed
+    by *global* host id and is replicated across shards (the engine's only
+    per-packet routing state, the analogue of RoutingInfo's path table,
+    reference graph/mod.rs:432-449).
+    """
+
+    lat_ns: jax.Array  # [N, N] i64
+    rel: jax.Array  # [N, N] f32
+    host_node: "jax.Array | None" = None  # [H_global] i32
+
+    @property
+    def num_nodes(self) -> int:
+        return self.lat_ns.shape[0]
+
+    @property
+    def num_global_hosts(self) -> int:
+        return self.host_node.shape[0]
+
+    def with_hosts(self, host_node) -> "RoutingTables":
+        hn = jnp.asarray(host_node, jnp.int32)
+        if hn.ndim != 1:
+            raise ValueError("host_node must be 1-D [num_hosts]")
+        return self.replace(host_node=hn)
+
+    def min_path_latency_ns(self) -> int:
+        """Minimum finite path latency — upper bound for a valid runahead."""
+        import numpy as _np
+
+        lat = _np.asarray(self.lat_ns)
+        finite = lat[lat < TIME_MAX]
+        if finite.size == 0:
+            raise ValueError("routing table has no reachable pairs")
+        return int(finite.min())
+
+
+def _minplus_square_once(lat: jax.Array, rel: jax.Array, block: int) -> tuple[jax.Array, jax.Array]:
+    """One squaring step: out[i,j] = min(lat[i,j], min_k lat[i,k]+lat[k,j]).
+
+    Blocked over rows and scanned over k-chunks so peak memory stays
+    O(block * chunk * N) and XLA can fuse the broadcast-add with the min
+    reduction.
+    """
+    n = lat.shape[0]
+    nk = n // block
+
+    lat_k = lat.reshape(nk, block, n)  # k-chunks of the "B" operand
+    rel_k = rel.reshape(nk, block, n)
+
+    def row_block(args):
+        lat_blk, rel_blk = args  # [B, N] rows of the "A" operand
+
+        la = lat_blk.reshape(lat_blk.shape[0], nk, block).transpose(1, 0, 2)  # [nk, B, C]
+        ra = rel_blk.reshape(rel_blk.shape[0], nk, block).transpose(1, 0, 2)
+
+        def body(carry, xs):
+            best_lat, best_rel = carry
+            la_c, ra_c, lb_c, rb_c = xs  # [B,C], [B,C], [C,N], [C,N]
+            cand_lat = la_c[:, :, None] + lb_c[None, :, :]  # [B, C, N]
+            k_best = jnp.argmin(cand_lat, axis=1)  # [B, N]
+            cl = jnp.take_along_axis(cand_lat, k_best[:, None, :], axis=1)[:, 0, :]
+            cand_rel = ra_c[:, :, None] * rb_c[None, :, :]
+            cr = jnp.take_along_axis(cand_rel, k_best[:, None, :], axis=1)[:, 0, :]
+            upd = cl < best_lat
+            return (jnp.where(upd, cl, best_lat), jnp.where(upd, cr, best_rel)), None
+
+        (out_lat, out_rel), _ = jax.lax.scan(body, (lat_blk, rel_blk), (la, ra, lat_k, rel_k))
+        return out_lat, out_rel
+
+    # row-blocks of the "A" operand are the same chunking as lat_k/rel_k
+    out_lat, out_rel = jax.lax.map(row_block, (lat_k, rel_k))
+    return out_lat.reshape(n, n), out_rel.reshape(n, n)
+
+
+def _pad_to_multiple(arr: np.ndarray, block: int, fill) -> np.ndarray:
+    n = arr.shape[0]
+    pad = (-n) % block
+    if pad == 0:
+        return arr
+    out = np.full((n + pad, n + pad), fill, dtype=arr.dtype)
+    out[:n, :n] = arr
+    return out
+
+
+def compute_routing(
+    graph: NetworkGraph, use_shortest_path: bool = True, block: int = 128
+) -> RoutingTables:
+    """Build node-to-node routing tables (runs the solve on the default device)."""
+    n = graph.num_nodes
+    block = min(block, max(8, 1 << (n - 1).bit_length()))
+
+    lat0 = _pad_to_multiple(graph.lat_ns, block, TIME_MAX)
+    rel0 = _pad_to_multiple(graph.rel, block, 0.0)
+
+    if not use_shortest_path:
+        # direct-edges-only mode (reference graph/mod.rs:232-254): the table
+        # is just the adjacency, self-loops included.
+        return RoutingTables(lat_ns=jnp.asarray(lat0[:n, :n]), rel=jnp.asarray(rel0[:n, :n]))
+
+    np_n = lat0.shape[0]
+    # transit computation runs with a free (0-cost) diagonal…
+    diag = np.arange(np_n)
+    lat_t = lat0.copy()
+    rel_t = rel0.copy()
+    lat_t[diag, diag] = 0
+    rel_t[diag, diag] = 1.0
+
+    lat_d = jnp.asarray(lat_t)
+    rel_d = jnp.asarray(rel_t)
+
+    @jax.jit
+    def solve(lat, rel):
+        steps = max(1, (max(n - 1, 1)).bit_length())
+        for _ in range(steps):
+            lat, rel = _minplus_square_once(lat, rel, block)
+            # clamp so unreachable+unreachable cannot overflow i64 next round
+            lat = jnp.minimum(lat, TIME_MAX)
+        return lat, rel
+
+    lat_sp, rel_sp = solve(lat_d, rel_d)
+
+    # …then the diagonal is replaced by self-loop edge properties, matching
+    # the reference's node-to-self semantics (graph/mod.rs:212-219).
+    self_lat = jnp.asarray(np.ascontiguousarray(np.diagonal(lat0)))
+    self_rel = jnp.asarray(np.ascontiguousarray(np.diagonal(rel0)))
+    di = jnp.arange(np_n)
+    lat_sp = lat_sp.at[di, di].set(self_lat)
+    rel_sp = rel_sp.at[di, di].set(self_rel)
+
+    return RoutingTables(lat_ns=lat_sp[:n, :n], rel=rel_sp[:n, :n])

@@ -1,0 +1,3 @@
+from shadow_tpu.models.phold import PholdModel
+
+__all__ = ["PholdModel"]

@@ -1,0 +1,107 @@
+"""PHOLD: the classic PDES benchmark workload, fully on device.
+
+The reference ships PHOLD as real UDP-speaking processes
+(reference: src/test/phold/ — also its determinism benchmark); here it is
+the first "scripted host" model: on receiving a ball (packet), a host draws
+a random hold delay and a random peer, holds, then throws the ball on.
+Exercises every engine path: packets, local timers, per-host RNG in event
+order, routing latency/loss, and the round-boundary exchange.
+
+Event kinds:
+  KIND_PACKET — a ball arrives        (draws: dst, hold-delay -> local SEND)
+  KIND_SEND   — hold expired          (emits the packet)
+
+All timing draws are integer-valued so timelines are bit-identical across
+CPU and TPU backends (see shadow_tpu.rng).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from shadow_tpu.engine.state import EngineConfig, LocalEmits, PacketEmits
+from shadow_tpu.equeue import PAYLOAD_LANES
+from shadow_tpu.events import KIND_MODEL_BASE, KIND_PACKET
+from shadow_tpu.simtime import NS_PER_MS
+
+KIND_SEND = KIND_MODEL_BASE  # 1
+
+
+@flax.struct.dataclass
+class PholdState:
+    recv_count: jax.Array  # [H] i64 balls received
+    send_count: jax.Array  # [H] i64 balls thrown
+
+
+@dataclasses.dataclass(frozen=True)
+class PholdModel:
+    num_hosts: int
+    min_delay_ns: int = 1 * NS_PER_MS
+    max_delay_ns: int = 20 * NS_PER_MS  # exclusive
+
+    DRAWS_PER_EVENT = 2  # (dst, delay) on ball arrival
+    LOCAL_EMITS = 1
+    PACKET_EMITS = 1
+    BOOTSTRAP_DRAWS = 2  # (dst, initial offset)
+
+    def init(self) -> PholdState:
+        h = self.num_hosts
+        return PholdState(
+            recv_count=jnp.zeros((h,), jnp.int64),
+            send_count=jnp.zeros((h,), jnp.int64),
+        )
+
+    def _draw_peer(self, draw, i: int, host_id) -> jax.Array:
+        """Uniform peer excluding self (any host if there is only one).
+        host_id carries *global* ids; draws cover all hosts in the sim."""
+        h = self.num_hosts
+        if h == 1:
+            return jnp.zeros(host_id.shape, jnp.int32)
+        peer = draw.uniform_int(i, 0, h - 1)
+        return (peer + (peer >= host_id.astype(jnp.int64))).astype(jnp.int32)
+
+    def bootstrap(self, draw, host_id) -> LocalEmits:
+        """Every host starts holding one ball: SEND at a random offset."""
+        h = host_id.shape[0]
+        dst = self._draw_peer(draw, 0, host_id)
+        offset = draw.uniform_int(1, self.min_delay_ns, self.max_delay_ns)
+        data = jnp.zeros((h, 1, PAYLOAD_LANES), jnp.int32).at[:, 0, 0].set(dst)
+        return LocalEmits(
+            valid=jnp.ones((h, 1), bool),
+            time=offset[:, None],
+            kind=jnp.full((h, 1), KIND_SEND, jnp.int32),
+            data=data,
+        )
+
+    def handle(self, state: PholdState, ev, draw, cfg: EngineConfig, host_id):
+        h = host_id.shape[0]
+        is_ball = ev.valid & (ev.kind == KIND_PACKET)
+        is_send = ev.valid & (ev.kind == KIND_SEND)
+
+        # ball arrival: hold it, schedule the throw
+        dst = self._draw_peer(draw, 0, host_id)
+        delay = draw.uniform_int(1, self.min_delay_ns, self.max_delay_ns)
+        ldata = jnp.zeros((h, 1, PAYLOAD_LANES), jnp.int32).at[:, 0, 0].set(dst)
+        lemits = LocalEmits(
+            valid=is_ball[:, None],
+            time=(ev.time + delay)[:, None],
+            kind=jnp.full((h, 1), KIND_SEND, jnp.int32),
+            data=ldata,
+        )
+
+        # hold expired: throw the ball to the peer recorded in the timer
+        pemits = PacketEmits(
+            valid=is_send[:, None],
+            dst=ev.data[:, 0][:, None],
+            data=jnp.zeros((h, 1, PAYLOAD_LANES), jnp.int32),
+        )
+
+        state = state.replace(
+            recv_count=state.recv_count + is_ball,
+            send_count=state.send_count + is_send,
+        )
+        return state, lemits, pemits

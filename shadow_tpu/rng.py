@@ -1,0 +1,64 @@
+"""Deterministic per-host randomness, counter-based.
+
+The reference seeds one Xoshiro256++ per host from the global seed and draws
+in event-execution order (reference: src/main/host/host.rs:218,
+src/main/core/worker.rs:361-378). A stateful stream doesn't vectorize, so we
+re-specify the semantics counter-based (threefry): every host owns a key
+fold_in(global, host_id) and a monotonically increasing draw counter; logical
+draw #c of host h is a pure function of (seed, h, c). Handlers advance each
+host's counter by the number of draws they make, preserving the reference's
+"random choices happen in event order" determinism contract while letting all
+hosts draw in parallel.
+
+Draws used for event *timing* are integer-valued (derived from raw threefry
+bits), so simulated timelines are bit-identical across CPU and TPU backends.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import random
+
+
+def host_keys(seed: int, num_hosts: int) -> jax.Array:
+    """[H] per-host base keys derived from the global seed."""
+    base = random.key(seed)
+    return jax.vmap(lambda h: random.fold_in(base, h))(jnp.arange(num_hosts, dtype=jnp.uint32))
+
+
+def _draw_keys(keys: jax.Array, counters: jax.Array) -> jax.Array:
+    return jax.vmap(random.fold_in)(keys, counters.astype(jnp.uint32))
+
+
+def uniform_f32(keys: jax.Array, counters: jax.Array) -> jax.Array:
+    """[H] uniforms in [0, 1) for draw #counter of each host (bit-exact
+    across backends: built from threefry bits with exact float ops)."""
+    return jax.vmap(lambda k: random.uniform(k, dtype=jnp.float32))(_draw_keys(keys, counters))
+
+
+def bernoulli(keys: jax.Array, counters: jax.Array, p: jax.Array) -> jax.Array:
+    """[H] bools, True with probability p (one draw per host)."""
+    return uniform_f32(keys, counters) < p
+
+
+def uniform_int(keys: jax.Array, counters: jax.Array, lo, hi) -> jax.Array:
+    """[H] integers in [lo, hi) (one draw per host; integer path only)."""
+    ks = _draw_keys(keys, counters)
+    lo = jnp.asarray(lo, jnp.int64)
+    hi = jnp.asarray(hi, jnp.int64)
+    lo_b = jnp.broadcast_to(lo, ks.shape)
+    hi_b = jnp.broadcast_to(hi, ks.shape)
+    return jax.vmap(lambda k, a, b: random.randint(k, (), a, b, dtype=jnp.int64))(ks, lo_b, hi_b)
+
+
+def exponential_ns(keys: jax.Array, counters: jax.Array, mean_ns) -> jax.Array:
+    """[H] i64 ~ Exp(mean_ns), rounded to ns (one draw per host).
+
+    Uses f32 log; bit-identical within a backend (run-twice determinism) but
+    not guaranteed identical across CPU vs TPU — use uniform_int-based timing
+    where cross-backend conformance matters.
+    """
+    u = uniform_f32(keys, counters)
+    draw = -jnp.log1p(-u)  # Exp(1), finite since u < 1
+    return (draw.astype(jnp.float64) * jnp.asarray(mean_ns, jnp.float64)).astype(jnp.int64)

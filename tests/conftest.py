@@ -1,7 +1,14 @@
 """Test harness: run everything on a virtual 8-device CPU mesh.
 
-Must set env vars before jax initializes its backends, so this executes at
-conftest import time (pytest loads conftest before test modules).
+Two jobs, both of which must happen before jax backends initialize:
+
+1. Force the CPU platform with 8 virtual devices (multi-chip sharding tests).
+2. Neutralize the axon TPU plugin. The machine image injects an axon PJRT
+   plugin via PYTHONPATH sitecustomize which registers itself at interpreter
+   startup and dials a local relay at first backend init; when that relay is
+   down, backend init hangs forever — even under JAX_PLATFORMS=cpu. The
+   plugin is already registered by the time pytest imports this conftest, so
+   we drop its factory from jax's backend registry before any array op.
 """
 
 import os
@@ -12,6 +19,18 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
+
+# sitecustomize may have imported jax already (baking jax_platforms=axon from
+# the env), so the env var alone is not enough:
+jax.config.update("jax_platforms", "cpu")
+
+try:  # jax-internal, but the only seam that works post-registration
+    from jax._src import xla_bridge as _xb
+
+    for _name in ("axon", "tpu"):
+        _xb._backend_factories.pop(_name, None)
+except Exception:  # pragma: no cover — registry layout changed; rely on env
+    pass
 
 import shadow_tpu  # noqa: E402,F401  (enables x64)
 

@@ -1,0 +1,160 @@
+import heapq
+import random
+
+import numpy as np
+import pytest
+
+from shadow_tpu.graph import NetworkGraph, IpAssignment, compute_routing, parse_gml
+from shadow_tpu.graph.gml import write_gml
+from shadow_tpu.simtime import NS_PER_MS, TIME_MAX
+from shadow_tpu.units import parse_bandwidth_bits_per_sec, parse_bytes
+
+
+def test_units():
+    assert parse_bandwidth_bits_per_sec("1 Gbit") == 10**9
+    assert parse_bandwidth_bits_per_sec("100 Mbit") == 10**8
+    assert parse_bandwidth_bits_per_sec(2048) == 2048
+    assert parse_bytes("16 KiB") == 16384
+    assert parse_bytes("10 MB") == 10**7
+    with pytest.raises(ValueError):
+        parse_bandwidth_bits_per_sec("10 parsecs")
+
+
+def test_parse_one_gbit_switch():
+    g = NetworkGraph.one_gbit_switch()
+    assert g.num_nodes == 1
+    assert g.bw_up_bits[0] == 10**9
+    assert g.bw_down_bits[0] == 10**9
+    assert g.lat_ns[0, 0] == NS_PER_MS
+    assert g.rel[0, 0] == 1.0
+    assert g.min_latency_ns() == NS_PER_MS
+
+
+def test_gml_roundtrip_and_validation():
+    gml = """
+    # a comment
+    graph [
+      directed 1
+      node [ id 5 host_bandwidth_up "10 Mbit" ]
+      node [ id 7 ]
+      edge [ source 5 target 7 latency "2 ms" packet_loss 0.25 jitter "1 ms" ]
+    ]
+    """
+    g = parse_gml(gml)
+    assert g.directed and len(g.nodes) == 2 and len(g.edges) == 1
+    text2 = write_gml(g)
+    g2 = parse_gml(text2)
+    assert g2.nodes == g.nodes and g2.edges == g.edges
+
+    ng = NetworkGraph.from_parsed(g)
+    i5, i7 = ng.id_to_index[5], ng.id_to_index[7]
+    assert ng.lat_ns[i5, i7] == 2 * NS_PER_MS
+    assert ng.lat_ns[i7, i5] == TIME_MAX  # directed: no reverse edge
+    assert abs(ng.rel[i5, i7] - 0.75) < 1e-6
+    assert ng.jitter_ns[i5, i7] == NS_PER_MS
+    assert ng.bw_down_bits[i5] == -1
+
+    with pytest.raises(ValueError):
+        NetworkGraph.from_gml('graph [ node [ id 0 ] edge [ source 0 target 0 latency "0 ms" ] ]')
+    with pytest.raises(ValueError):
+        NetworkGraph.from_gml('graph [ node [ id 0 ] edge [ source 0 target 0 latency "1 ms" packet_loss 1.5 ] ]')
+
+
+def test_gml_malformed_inputs_raise_value_error():
+    for bad in ["graph [ node", "graph [ directed 1", "graph [ node [ id 0 ]", "nodes only", "graph"]:
+        with pytest.raises(ValueError):
+            parse_gml(bad)
+
+
+def _dijkstra(lat: np.ndarray, rel: np.ndarray, src: int):
+    """Oracle: shortest latency + reliability along the found path."""
+    n = lat.shape[0]
+    dist = [None] * n
+    best_rel = [0.0] * n
+    pq = [(0, 1.0, src)]
+    seen = set()
+    while pq:
+        d, r, u = heapq.heappop(pq)
+        if u in seen:
+            continue
+        seen.add(u)
+        dist[u] = d
+        best_rel[u] = r
+        for v in range(n):
+            if v != u and lat[u, v] < TIME_MAX and v not in seen:
+                heapq.heappush(pq, (d + int(lat[u, v]), r * float(rel[u, v]), v))
+    return dist, best_rel
+
+
+def _random_graph(rng, n, p_edge=0.35, directed=False):
+    lines = ["graph [", f"  directed {int(directed)}"]
+    for i in range(n):
+        lines.append(f'  node [ id {i} host_bandwidth_up "10 Mbit" host_bandwidth_down "10 Mbit" ]')
+    for i in range(n):
+        # self-loop on every node
+        lines.append(f'  edge [ source {i} target {i} latency "{rng.randrange(100, 999)} us" packet_loss 0.0 ]')
+        for j in range(n):
+            if i == j or rng.random() > p_edge:
+                continue
+            if not directed and j < i:
+                continue
+            lat_us = rng.randrange(1000, 99999)
+            loss = rng.choice([0.0, 0.01, 0.1])
+            lines.append(
+                f'  edge [ source {i} target {j} latency "{lat_us} us" packet_loss {loss} ]'
+            )
+    lines.append("]")
+    return "\n".join(lines)
+
+
+@pytest.mark.parametrize("directed", [False, True])
+def test_routing_matches_dijkstra(directed):
+    rng = random.Random(42 + directed)
+    ng = NetworkGraph.from_gml(_random_graph(rng, 12, directed=directed))
+    tables = compute_routing(ng, block=8)
+    lat = np.asarray(tables.lat_ns)
+    rel = np.asarray(tables.rel)
+
+    for src in range(ng.num_nodes):
+        dist, dist_rel = _dijkstra(ng.lat_ns, ng.rel, src)
+        for dst in range(ng.num_nodes):
+            if src == dst:
+                # self-path = the self-loop edge, not the empty path
+                assert lat[src, src] == ng.lat_ns[src, src]
+                continue
+            if dist[dst] is None:
+                assert lat[src, dst] == TIME_MAX
+            else:
+                assert lat[src, dst] == dist[dst], (src, dst)
+                # reliability is path-dependent; with random distinct
+                # latencies the shortest path is a.s. unique
+                assert abs(rel[src, dst] - dist_rel[dst]) < 1e-5, (src, dst)
+
+
+def test_routing_direct_mode():
+    gml = 'graph [ node [ id 0 ] node [ id 1 ] node [ id 2 ] edge [ source 0 target 1 latency "1 ms" ] edge [ source 1 target 2 latency "1 ms" ] ]'
+    ng = NetworkGraph.from_gml(gml)
+    t = compute_routing(ng, use_shortest_path=False, block=8)
+    lat = np.asarray(t.lat_ns)
+    assert lat[0, 1] == NS_PER_MS and lat[1, 2] == NS_PER_MS
+    assert lat[0, 2] == TIME_MAX  # no transitive route in direct mode
+
+
+def test_ip_assignment():
+    ipa = IpAssignment()
+    a = ipa.assign_auto(0)
+    assert ipa.ip_str(0) == "11.0.0.1"  # .0 skipped
+    ipa.assign_explicit(1, "11.0.0.2")
+    b = ipa.assign_auto(2)
+    assert ipa.ip_str(2) == "11.0.0.3"  # .2 taken, skipped
+    assert ipa.host_for_ip("11.0.0.2") == 1
+    assert ipa.host_for_ip(a) == 0 and ipa.host_for_ip(b) == 2
+    # exhaust to the .255/.0 boundary
+    ipa2 = IpAssignment()
+    for h in range(260):
+        ipa2.assign_auto(h)
+    ips = {ipa2.ip_str(h) for h in range(260)}
+    assert "11.0.0.255" not in ips and "11.0.1.0" not in ips
+    assert "11.0.1.1" in ips
+    with pytest.raises(ValueError):
+        ipa.assign_explicit(9, "11.0.0.2")
