@@ -24,14 +24,13 @@ optimization).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
 from shadow_tpu import equeue, rng
 from shadow_tpu.engine.state import EngineConfig, SimState
-from shadow_tpu.equeue import PAYLOAD_LANES
 from shadow_tpu.events import KIND_PACKET, pack_tie
 from shadow_tpu.graph.routing import RoutingTables
 from shadow_tpu.simtime import TIME_MAX
@@ -299,6 +298,24 @@ def _peek_next_time(st: SimState) -> jax.Array:
     return jnp.min(equeue.next_time(st.queue))
 
 
+@jax.jit
+def _peek_overflow(st: SimState) -> jax.Array:
+    return jnp.sum(st.queue.overflow) + jnp.sum(st.outbox.overflow)
+
+
+def check_capacity(st: SimState) -> None:
+    """Fail loudly if fixed-slot capacity was exhausted: past that point the
+    simulation has silently dropped events and no longer matches the
+    determinism contract (the tensor-shaped analogue of the reference's
+    unbounded queues never dropping)."""
+    dropped = int(_peek_overflow(st))
+    if dropped:
+        raise RuntimeError(
+            f"event capacity exhausted: {dropped} events/packets dropped "
+            f"(queue.overflow/outbox.overflow); increase queue_capacity/outbox_capacity"
+        )
+
+
 def _run_chunk(st, end, num_rounds, model, tables, cfg):
     return run_rounds_scan(st, end, num_rounds, model, tables, cfg)
 
@@ -326,8 +343,10 @@ def run_until(
     for _ in range(max_chunks):
         nt = int(_peek_next_time(st))
         if nt >= end_time:
+            check_capacity(st)
             return st
         st = _run_chunk_jit(st, end, rounds_per_chunk, model, tables, cfg)
+    check_capacity(st)
     if int(_peek_next_time(st)) < end_time:
         raise RuntimeError(
             f"simulation did not reach end_time={end_time} within "

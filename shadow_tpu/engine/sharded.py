@@ -28,7 +28,12 @@ try:  # stable alias in newer jax
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
-from shadow_tpu.engine.round import _peek_next_time, run_rounds_scan, validate_runahead
+from shadow_tpu.engine.round import (
+    _peek_next_time,
+    check_capacity,
+    run_rounds_scan,
+    validate_runahead,
+)
 from shadow_tpu.engine.state import EngineConfig, SimState
 from shadow_tpu.graph.routing import RoutingTables
 
@@ -104,8 +109,10 @@ class ShardedRunner:
         end = jnp.asarray(end_time, jnp.int64)
         for _ in range(max_chunks):
             if int(_peek_next_time(st)) >= end_time:
+                check_capacity(st)
                 return st
             st = self._compiled(st, self.tables, end)
+        check_capacity(st)
         if int(_peek_next_time(st)) < end_time:
             raise RuntimeError(
                 f"sharded simulation did not reach end_time={end_time} within "

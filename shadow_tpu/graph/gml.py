@@ -51,7 +51,8 @@ def _tokenize(text: str):
         elif m.lastgroup == "rbracket":
             yield ("]", None)
         elif m.lastgroup == "string":
-            yield ("value", m.group("string")[1:-1].replace('\\"', '"'))
+            raw = m.group("string")[1:-1]
+            yield ("value", raw.replace('\\"', '"').replace("\\\\", "\\"))
         elif m.lastgroup == "number":
             text_num = m.group("number")
             if re.fullmatch(r"[-+]?\d+", text_num):
@@ -108,9 +109,13 @@ def parse_gml(text: str) -> GmlGraph:
     edges = as_list(body.pop("edge", None))
     directed = bool(body.pop("directed", 0))
     for n in nodes:
+        if not isinstance(n, dict):
+            raise ValueError(f"'node' must be a [ ... ] block, got {n!r}")
         if "id" not in n:
             raise ValueError(f"node missing 'id': {n}")
     for e in edges:
+        if not isinstance(e, dict):
+            raise ValueError(f"'edge' must be a [ ... ] block, got {e!r}")
         if "source" not in e or "target" not in e:
             raise ValueError(f"edge missing source/target: {e}")
     return GmlGraph(directed=directed, attrs=body, nodes=nodes, edges=edges)
@@ -119,7 +124,8 @@ def parse_gml(text: str) -> GmlGraph:
 def write_gml(g: GmlGraph) -> str:
     def fmt_val(v):
         if isinstance(v, str):
-            return f'"{v}"'
+            escaped = v.replace("\\", "\\\\").replace('"', '\\"')
+            return f'"{escaped}"'
         if isinstance(v, bool):
             return str(int(v))
         return repr(v) if isinstance(v, float) else str(v)

@@ -56,9 +56,7 @@ class RoutingTables:
 
     def min_path_latency_ns(self) -> int:
         """Minimum finite path latency — upper bound for a valid runahead."""
-        import numpy as _np
-
-        lat = _np.asarray(self.lat_ns)
+        lat = np.asarray(self.lat_ns)
         finite = lat[lat < TIME_MAX]
         if finite.size == 0:
             raise ValueError("routing table has no reachable pairs")

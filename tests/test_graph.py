@@ -61,9 +61,36 @@ def test_gml_roundtrip_and_validation():
 
 
 def test_gml_malformed_inputs_raise_value_error():
-    for bad in ["graph [ node", "graph [ directed 1", "graph [ node [ id 0 ]", "nodes only", "graph"]:
+    for bad in [
+        "graph [ node",
+        "graph [ directed 1",
+        "graph [ node [ id 0 ]",
+        "nodes only",
+        "graph",
+        "graph [ node 5 ]",
+        "graph [ edge 5 ]",
+    ]:
         with pytest.raises(ValueError):
             parse_gml(bad)
+
+
+def test_gml_string_escaping_roundtrip():
+    g = parse_gml('graph [ node [ id 0 label "a\\"b\\\\c" ] ]')
+    assert g.nodes[0]["label"] == 'a"b\\c'
+    assert parse_gml(write_gml(g)).nodes == g.nodes
+
+
+def test_engine_raises_on_capacity_exhaustion():
+    import jax.numpy as jnp
+
+    from shadow_tpu.engine import EngineConfig, init_state
+    from shadow_tpu.engine.round import check_capacity
+
+    cfg = EngineConfig(num_hosts=2, queue_capacity=4, outbox_capacity=2)
+    st = init_state(cfg, model_state=None)
+    st = st.replace(queue=st.queue.replace(overflow=jnp.array([1, 0], jnp.int32)))
+    with pytest.raises(RuntimeError):
+        check_capacity(st)
 
 
 def _dijkstra(lat: np.ndarray, rel: np.ndarray, src: int):
