@@ -27,9 +27,13 @@ def main(argv: "list[str] | None" = None) -> int:
     args = parser.parse_args(argv)
 
     if args.command == "run":
-        from shadow_tpu.runtime.cli_run import run_from_config
+        from shadow_tpu.runtime.cli_run import CliUserError, run_from_config
 
-        return run_from_config(args.config, show_config=args.show_config)
+        try:
+            return run_from_config(args.config, show_config=args.show_config)
+        except CliUserError as e:
+            print(f"shadow-tpu: error: {e}", file=sys.stderr)
+            return 1
     parser.print_help()
     return 0
 

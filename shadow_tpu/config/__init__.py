@@ -1,0 +1,21 @@
+from shadow_tpu.config.options import (
+    ConfigOptions,
+    GeneralOptions,
+    HostOptions,
+    NetworkOptions,
+    ExperimentalOptions,
+    ProcessOptions,
+    load_config_file,
+    load_config_str,
+)
+
+__all__ = [
+    "ConfigOptions",
+    "GeneralOptions",
+    "HostOptions",
+    "NetworkOptions",
+    "ExperimentalOptions",
+    "ProcessOptions",
+    "load_config_file",
+    "load_config_str",
+]

@@ -1,0 +1,227 @@
+"""Typed simulation configuration: YAML file ⊕ overrides.
+
+Mirrors the reference's config architecture (reference:
+src/main/core/support/configuration.rs:96-455): one source of truth with
+`general` / `network` / `experimental` / `hosts` sections, typed units
+("10 Mbit", "2 sec"), per-host defaults with overrides, YAML merge keys
+(pyyaml handles `<<:` natively) and ignored `x-...` extension fields
+(reference main.rs:272-291). The `experimental.scheduler` knob is the
+Scheduler seam (reference scheduler/mod.rs:31): `tpu` (the device engine,
+sharded over all visible devices) or `cpu-ref` (the Python conformance
+oracle).
+
+Where the reference runs real executables per host
+(`hosts.<name>.processes[].path`), this build currently runs *scripted
+host models* on device; `path` therefore names a registered model
+(e.g. "phold") — the managed-process layer will widen this seam.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import yaml
+
+from shadow_tpu.simtime import parse_time_ns
+from shadow_tpu.units import parse_bandwidth_bits_per_sec
+
+
+def _drop_extension_fields(obj):
+    """Strip `x-...` keys anywhere in the tree (reference main.rs:272-291)."""
+    if isinstance(obj, dict):
+        return {k: _drop_extension_fields(v) for k, v in obj.items() if not str(k).startswith("x-")}
+    if isinstance(obj, list):
+        return [_drop_extension_fields(v) for v in obj]
+    return obj
+
+
+@dataclasses.dataclass
+class GeneralOptions:
+    stop_time_ns: int = 0  # required > 0
+    seed: int = 1
+    bootstrap_end_time_ns: int = 0
+    heartbeat_interval_ns: int = 1_000_000_000
+    parallelism: int = 0  # 0 = all visible devices
+    log_level: str = "info"
+    data_directory: str = "shadow.data"
+    progress: bool = False
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "GeneralOptions":
+        out = cls()
+        if "stop_time" in d:
+            out.stop_time_ns = parse_time_ns(d.pop("stop_time"))
+        if "bootstrap_end_time" in d:
+            out.bootstrap_end_time_ns = parse_time_ns(d.pop("bootstrap_end_time"))
+        if "heartbeat_interval" in d:
+            hb = d.pop("heartbeat_interval")
+            out.heartbeat_interval_ns = 0 if hb is None else parse_time_ns(hb)
+        for k in ("seed", "parallelism", "log_level", "data_directory", "progress"):
+            if k in d:
+                setattr(out, k, d.pop(k))
+        _reject_unknown("general", d)
+        return out
+
+
+@dataclasses.dataclass
+class GraphSource:
+    kind: str = "1_gbit_switch"  # "1_gbit_switch" | "gml"
+    inline: Optional[str] = None
+    path: Optional[str] = None
+
+
+@dataclasses.dataclass
+class NetworkOptions:
+    graph: GraphSource = dataclasses.field(default_factory=GraphSource)
+    use_shortest_path: bool = True
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "NetworkOptions":
+        out = cls()
+        g = d.pop("graph", None)
+        if g is not None:
+            kind = g.get("type", "1_gbit_switch")
+            src = GraphSource(kind=kind)
+            if kind == "gml":
+                if "inline" in g:
+                    src.inline = g["inline"]
+                elif "file" in g:
+                    src.path = g["file"]["path"] if isinstance(g["file"], dict) else g["file"]
+                else:
+                    raise ValueError("network.graph type 'gml' needs 'inline' or 'file'")
+            elif kind != "1_gbit_switch":
+                raise ValueError(f"unknown graph type {kind!r}")
+            out.graph = src
+        if "use_shortest_path" in d:
+            out.use_shortest_path = bool(d.pop("use_shortest_path"))
+        _reject_unknown("network", d)
+        return out
+
+
+@dataclasses.dataclass
+class ExperimentalOptions:
+    scheduler: str = "tpu"  # "tpu" | "cpu-ref"
+    runahead_ns: Optional[int] = None  # None = min graph latency
+    use_dynamic_runahead: bool = False
+    queue_capacity: int = 64
+    outbox_capacity: int = 16
+    rounds_per_chunk: int = 256
+    max_iters_per_round: int = 1_000_000
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ExperimentalOptions":
+        out = cls()
+        if "runahead" in d:
+            ra = d.pop("runahead")
+            out.runahead_ns = None if ra is None else parse_time_ns(ra)
+        for k in (
+            "scheduler",
+            "use_dynamic_runahead",
+            "queue_capacity",
+            "outbox_capacity",
+            "rounds_per_chunk",
+            "max_iters_per_round",
+        ):
+            if k in d:
+                setattr(out, k, d.pop(k))
+        if out.scheduler not in ("tpu", "cpu-ref"):
+            raise ValueError(f"unknown scheduler {out.scheduler!r} (expected 'tpu' or 'cpu-ref')")
+        _reject_unknown("experimental", d)
+        return out
+
+
+@dataclasses.dataclass
+class ProcessOptions:
+    path: str = ""  # registered model name (reference: executable path)
+    args: dict = dataclasses.field(default_factory=dict)
+    start_time_ns: int = 0
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ProcessOptions":
+        out = cls()
+        out.path = d.pop("path")
+        out.args = d.pop("args", {}) or {}
+        if "start_time" in d:
+            out.start_time_ns = parse_time_ns(d.pop("start_time"))
+        _reject_unknown("process", d)
+        return out
+
+
+@dataclasses.dataclass
+class HostOptions:
+    name: str = ""
+    network_node_id: int = 0
+    quantity: int = 1
+    ip_addr: Optional[str] = None
+    bandwidth_up_bits: Optional[int] = None
+    bandwidth_down_bits: Optional[int] = None
+    processes: list = dataclasses.field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, name: str, d: dict, defaults: dict) -> "HostOptions":
+        merged = dict(defaults)
+        merged.update(d)
+        out = cls(name=name)
+        out.network_node_id = int(merged.pop("network_node_id", 0))
+        out.quantity = int(merged.pop("quantity", 1))
+        out.ip_addr = merged.pop("ip_addr", None)
+        if "bandwidth_up" in merged:
+            bw = merged.pop("bandwidth_up")
+            out.bandwidth_up_bits = None if bw is None else parse_bandwidth_bits_per_sec(bw)
+        if "bandwidth_down" in merged:
+            bw = merged.pop("bandwidth_down")
+            out.bandwidth_down_bits = None if bw is None else parse_bandwidth_bits_per_sec(bw)
+        out.processes = [ProcessOptions.from_dict(dict(p)) for p in merged.pop("processes", [])]
+        _reject_unknown(f"hosts.{name}", merged)
+        if out.quantity < 1:
+            raise ValueError(f"hosts.{name}.quantity must be >= 1")
+        return out
+
+
+@dataclasses.dataclass
+class ConfigOptions:
+    general: GeneralOptions
+    network: NetworkOptions
+    experimental: ExperimentalOptions
+    hosts: "list[HostOptions]"
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "ConfigOptions":
+        raw = _drop_extension_fields(raw)
+        if "general" not in raw:
+            raise ValueError("config missing required 'general' section")
+        if "hosts" not in raw or not raw["hosts"]:
+            raise ValueError("config missing required 'hosts' section")
+        general = GeneralOptions.from_dict(dict(raw.pop("general")))
+        network = NetworkOptions.from_dict(dict(raw.pop("network", {}) or {}))
+        experimental = ExperimentalOptions.from_dict(dict(raw.pop("experimental", {}) or {}))
+        defaults = dict(raw.pop("host_option_defaults", {}) or {})
+        hosts = [
+            HostOptions.from_dict(name, dict(h or {}), defaults)
+            for name, h in raw.pop("hosts").items()
+        ]
+        _reject_unknown("config", raw)
+        if general.stop_time_ns <= 0:
+            raise ValueError("general.stop_time must be > 0")
+        return cls(general=general, network=network, experimental=experimental, hosts=hosts)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _reject_unknown(section: str, leftover: dict) -> None:
+    if leftover:
+        raise ValueError(f"unknown key(s) in {section}: {sorted(leftover)}")
+
+
+def load_config_str(text: str) -> ConfigOptions:
+    raw = yaml.safe_load(text)
+    if not isinstance(raw, dict):
+        raise ValueError("config YAML must be a mapping")
+    return ConfigOptions.from_dict(raw)
+
+
+def load_config_file(path: str) -> ConfigOptions:
+    with open(path) as f:
+        return load_config_str(f.read())

@@ -303,6 +303,10 @@ def _peek_overflow(st: SimState) -> jax.Array:
     return jnp.sum(st.queue.overflow) + jnp.sum(st.outbox.overflow)
 
 
+class CapacityError(RuntimeError):
+    """Fixed-slot capacity exhausted — user-remediable via config."""
+
+
 def check_capacity(st: SimState) -> None:
     """Fail loudly if fixed-slot capacity was exhausted: past that point the
     simulation has silently dropped events and no longer matches the
@@ -310,7 +314,7 @@ def check_capacity(st: SimState) -> None:
     unbounded queues never dropping)."""
     dropped = int(_peek_overflow(st))
     if dropped:
-        raise RuntimeError(
+        raise CapacityError(
             f"event capacity exhausted: {dropped} events/packets dropped "
             f"(queue.overflow/outbox.overflow); increase queue_capacity/outbox_capacity"
         )
@@ -333,10 +337,12 @@ def run_until(
     cfg: EngineConfig,
     rounds_per_chunk: int = 64,
     max_chunks: int = 10_000,
+    on_chunk=None,
 ) -> SimState:
     """Host-side driver: chunked device scans until no work remains before
     end_time (one host<->device sync per chunk). Single-device variant; the
-    sharded driver lives in engine/sharded.py."""
+    sharded driver lives in engine/sharded.py. `on_chunk(state)` is invoked
+    after every device chunk (heartbeats/progress)."""
     validate_runahead(cfg, tables)
     end = jnp.asarray(end_time, jnp.int64)
 
@@ -346,6 +352,8 @@ def run_until(
             check_capacity(st)
             return st
         st = _run_chunk_jit(st, end, rounds_per_chunk, model, tables, cfg)
+        if on_chunk is not None:
+            on_chunk(st)
     check_capacity(st)
     if int(_peek_next_time(st)) < end_time:
         raise RuntimeError(

@@ -102,7 +102,9 @@ class ShardedRunner:
         )
         return jax.jit(f)
 
-    def run_until(self, st: SimState, end_time: int, max_chunks: int = 10_000) -> SimState:
+    def run_until(
+        self, st: SimState, end_time: int, max_chunks: int = 10_000, on_chunk=None
+    ) -> SimState:
         st = shard_state(st, self.mesh)
         if self._compiled is None:
             self._compiled = self._chunk_fn(st)
@@ -112,6 +114,8 @@ class ShardedRunner:
                 check_capacity(st)
                 return st
             st = self._compiled(st, self.tables, end)
+            if on_chunk is not None:
+                on_chunk(st)
         check_capacity(st)
         if int(_peek_next_time(st)) < end_time:
             raise RuntimeError(

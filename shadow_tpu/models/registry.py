@@ -1,0 +1,35 @@
+"""Model registry: maps a config `processes[].path` to a scripted host
+model builder. The reference runs real executables here (reference:
+src/main/core/support/configuration.rs:560-640 ProcessOptions); scripted
+on-device models are this build's current equivalent, and the managed-
+process layer will plug into the same seam.
+"""
+
+from __future__ import annotations
+
+from shadow_tpu.models.phold import PholdModel
+from shadow_tpu.simtime import parse_time_ns
+
+
+def _build_phold(num_hosts: int, args: dict) -> PholdModel:
+    kwargs = {}
+    if "min_delay" in args:
+        kwargs["min_delay_ns"] = parse_time_ns(args["min_delay"])
+    if "max_delay" in args:
+        kwargs["max_delay_ns"] = parse_time_ns(args["max_delay"])
+    return PholdModel(num_hosts=num_hosts, **kwargs)
+
+
+_REGISTRY = {
+    "phold": _build_phold,
+}
+
+
+def build_model(name: str, num_hosts: int, args: dict):
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown model {name!r}; available: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](num_hosts, args)
+
+
+def register_model(name: str, builder) -> None:
+    _REGISTRY[name] = builder

@@ -1,0 +1,4 @@
+from shadow_tpu.runtime.manager import Manager, SimResults
+from shadow_tpu.runtime.scheduler import CpuRefScheduler, TpuScheduler, make_scheduler
+
+__all__ = ["Manager", "SimResults", "CpuRefScheduler", "TpuScheduler", "make_scheduler"]

@@ -1,0 +1,41 @@
+"""`shadow-tpu run` implementation.
+
+User mistakes (bad YAML, bad config values, capacity exhaustion) surface as
+CliUserError and print as one-line errors; anything else is a real bug and
+propagates with its traceback.
+"""
+
+from __future__ import annotations
+
+import json
+
+import yaml
+
+from shadow_tpu.config import load_config_file
+from shadow_tpu.engine.round import CapacityError
+from shadow_tpu.runtime.manager import Manager
+from shadow_tpu.utils.shadow_log import set_level
+
+
+class CliUserError(Exception):
+    pass
+
+
+def run_from_config(path: str, show_config: bool = False) -> int:
+    try:
+        config = load_config_file(path)
+    except (ValueError, OSError, yaml.YAMLError) as e:
+        raise CliUserError(f"invalid config: {e}") from e
+    set_level(config.general.log_level)
+    if show_config:
+        print(json.dumps(config.to_dict(), indent=2, default=str))
+        return 0
+    try:
+        manager = Manager(config)  # construction = world validation
+    except (ValueError, OSError) as e:
+        raise CliUserError(str(e)) from e
+    try:
+        results = manager.run()
+    except CapacityError as e:
+        raise CliUserError(str(e)) from e
+    return 0 if results.packets_unroutable == 0 else 1

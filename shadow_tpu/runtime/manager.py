@@ -1,0 +1,228 @@
+"""Manager/Controller: build the simulated world from config and run it.
+
+The reference splits this between Controller (owns end time / windows,
+reference src/main/core/controller.rs:39-111) and Manager (builds hosts,
+picks the scheduler, runs the round loop, reference manager.rs:227-549).
+Window logic lives on-device here (engine/round.py), so this Manager's jobs
+are: resolve the graph, expand host specs (quantity), assign IPs, map hosts
+to graph nodes, build the model, run the chosen scheduler with heartbeats,
+and write `sim-stats.json` + the processed config into the data directory
+(reference manager.rs:187-198 re-serializes config the same way).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+
+import numpy as np
+
+from shadow_tpu.config import ConfigOptions
+from shadow_tpu.engine import EngineConfig
+from shadow_tpu.graph import IpAssignment, NetworkGraph, compute_routing
+from shadow_tpu.graph.network_graph import ONE_GBIT_SWITCH_GML
+from shadow_tpu.models.registry import build_model
+from shadow_tpu.runtime.scheduler import CpuRefScheduler, make_scheduler
+from shadow_tpu.simtime import NS_PER_SEC, fmt_time_ns
+from shadow_tpu.utils.shadow_log import slog
+
+
+@dataclasses.dataclass
+class HostInstance:
+    """One expanded simulated host (reference: HostInfo, sim_config.rs:96)."""
+
+    index: int
+    name: str
+    node_index: int
+    ip: int
+    model_name: str
+
+
+@dataclasses.dataclass
+class SimResults:
+    hosts: "list[HostInstance]"
+    events_handled: int
+    packets_sent: int
+    packets_dropped: int
+    packets_unroutable: int
+    wall_seconds: float
+    sim_seconds: float
+    scheduler: str
+
+    @property
+    def sim_sec_per_wall_sec(self) -> float:
+        return self.sim_seconds / self.wall_seconds if self.wall_seconds > 0 else float("inf")
+
+
+class Manager:
+    def __init__(self, config: ConfigOptions):
+        self.config = config
+        self.graph = self._load_graph()
+        self.hosts = self._expand_hosts()
+        self.ip = IpAssignment()
+        for h in self.hosts:
+            if h.ip >= 0:
+                self.ip.assign_explicit(h.index, h.ip)
+        for h in self.hosts:
+            if h.ip < 0:
+                h.ip = self.ip.assign_auto(h.index)
+
+    def _load_graph(self) -> NetworkGraph:
+        g = self.config.network.graph
+        if g.kind == "1_gbit_switch":
+            return NetworkGraph.from_gml(ONE_GBIT_SWITCH_GML)
+        if g.inline is not None:
+            return NetworkGraph.from_gml(g.inline)
+        with open(g.path) as f:
+            return NetworkGraph.from_gml(f.read())
+
+    def _expand_hosts(self) -> "list[HostInstance]":
+        import ipaddress
+
+        out = []
+        for spec in self.config.hosts:
+            if spec.network_node_id not in self.graph.id_to_index:
+                raise ValueError(
+                    f"hosts.{spec.name}: network_node_id {spec.network_node_id} not in graph"
+                )
+            if len(spec.processes) != 1:
+                raise ValueError(
+                    f"hosts.{spec.name}: exactly one process per host is supported currently"
+                )
+            for i in range(spec.quantity):
+                name = spec.name if spec.quantity == 1 else f"{spec.name}{i + 1}"
+                ip = -1
+                if spec.ip_addr is not None:
+                    if spec.quantity != 1:
+                        raise ValueError(f"hosts.{spec.name}: ip_addr with quantity > 1")
+                    ip = int(ipaddress.IPv4Address(spec.ip_addr))
+                out.append(
+                    HostInstance(
+                        index=len(out),
+                        name=name,
+                        node_index=self.graph.id_to_index[spec.network_node_id],
+                        ip=ip,
+                        model_name=spec.processes[0].path,
+                    )
+                )
+        return out
+
+    def run(self) -> SimResults:
+        cfgo = self.config
+        num_hosts = len(self.hosts)
+
+        model_names = {h.model_name for h in self.hosts}
+        if len(model_names) != 1:
+            raise ValueError(
+                f"all hosts must run the same model currently, got {sorted(model_names)}"
+            )
+        model = build_model(model_names.pop(), num_hosts, cfgo.hosts[0].processes[0].args)
+
+        host_node = [h.node_index for h in self.hosts]
+        tables = compute_routing(self.graph, use_shortest_path=cfgo.network.use_shortest_path)
+        tables = tables.with_hosts(host_node)
+
+        runahead = cfgo.experimental.runahead_ns
+        if runahead is None:
+            runahead = min(self.graph.min_latency_ns(), tables.min_path_latency_ns())
+
+        ecfg = EngineConfig(
+            num_hosts=num_hosts,
+            queue_capacity=cfgo.experimental.queue_capacity,
+            outbox_capacity=cfgo.experimental.outbox_capacity,
+            runahead_ns=runahead,
+            seed=cfgo.general.seed,
+            max_iters_per_round=cfgo.experimental.max_iters_per_round,
+        )
+
+        sched = make_scheduler(
+            cfgo.experimental.scheduler,
+            model,
+            tables,
+            ecfg,
+            host_node,
+            parallelism=cfgo.general.parallelism,
+            rounds_per_chunk=cfgo.experimental.rounds_per_chunk,
+        )
+
+        end = cfgo.general.stop_time_ns
+        hb_ns = cfgo.general.heartbeat_interval_ns
+        last_hb = [0]
+
+        def on_chunk(st):
+            if hb_ns <= 0:
+                return
+            now = int(np.asarray(st.now))
+            if now - last_hb[0] >= hb_ns:
+                last_hb[0] = now
+                ev = int(np.asarray(st.events_handled).sum())
+                pk = int(np.asarray(st.packets_sent).sum())
+                slog(
+                    "info",
+                    now,
+                    "manager",
+                    f"heartbeat: {ev} events, {pk} packets, sim time {fmt_time_ns(now)}",
+                )
+
+        slog("info", 0, "manager", f"starting: {num_hosts} hosts, scheduler={sched.name}, "
+             f"runahead={runahead}ns, stop={fmt_time_ns(end)}")
+        t0 = time.perf_counter()
+        final = sched.run(end, on_chunk=on_chunk)
+        wall = time.perf_counter() - t0
+
+        if isinstance(sched, CpuRefScheduler):
+            results = SimResults(
+                hosts=self.hosts,
+                events_handled=len(final.trace),
+                packets_sent=sum(final.packets_sent),
+                packets_dropped=sum(final.packets_dropped),
+                packets_unroutable=0,
+                wall_seconds=wall,
+                sim_seconds=end / NS_PER_SEC,
+                scheduler=sched.name,
+            )
+        else:
+            results = SimResults(
+                hosts=self.hosts,
+                events_handled=int(np.asarray(final.events_handled).sum()),
+                packets_sent=int(np.asarray(final.packets_sent).sum()),
+                packets_dropped=int(np.asarray(final.packets_dropped).sum()),
+                packets_unroutable=int(np.asarray(final.packets_unroutable).sum()),
+                wall_seconds=wall,
+                sim_seconds=end / NS_PER_SEC,
+                scheduler=sched.name,
+            )
+        slog("info", end, "manager",
+             f"finished: {results.events_handled} events in {wall:.2f}s wall "
+             f"({results.sim_sec_per_wall_sec:.2f} sim-s/wall-s)")
+        self._write_outputs(results)
+        return results
+
+    def _write_outputs(self, results: SimResults) -> None:
+        data_dir = self.config.general.data_directory
+        os.makedirs(data_dir, exist_ok=True)
+        # sim-stats.json (reference: sim_stats.rs:110 write_stats_to_file)
+        with open(os.path.join(data_dir, "sim-stats.json"), "w") as f:
+            json.dump(
+                {
+                    "events_handled": results.events_handled,
+                    "packets_sent": results.packets_sent,
+                    "packets_dropped": results.packets_dropped,
+                    "packets_unroutable": results.packets_unroutable,
+                    "wall_seconds": results.wall_seconds,
+                    "sim_seconds": results.sim_seconds,
+                    "scheduler": results.scheduler,
+                    "num_hosts": len(results.hosts),
+                },
+                f,
+                indent=2,
+            )
+        # processed config (reference: manager.rs:187-198)
+        with open(os.path.join(data_dir, "processed-config.json"), "w") as f:
+            json.dump(self.config.to_dict(), f, indent=2, default=str)
+        # hosts file (the analogue of the DNS /etc/hosts export, dns.c:115)
+        with open(os.path.join(data_dir, "hosts"), "w") as f:
+            for h in self.hosts:
+                f.write(f"{self.ip.ip_str(h.index)} {h.name}\n")

@@ -1,0 +1,86 @@
+"""The Scheduler seam: which engine steps the simulation.
+
+Mirrors the reference's `Scheduler` facade over interchangeable parallel
+engines (reference: src/main/core/scheduler/mod.rs:19-151, with
+ThreadPerCore/ThreadPerHost variants). Here the variants are:
+
+  * TpuScheduler — the jitted device engine; single device, or hosts
+    block-sharded over all visible devices via ShardedRunner.
+  * CpuRefScheduler — the pure-Python conformance oracle (slow; exists so
+    device results can be diffed against independently-written semantics,
+    like the reference's determinism double-runs).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from shadow_tpu.cpu_ref import CpuRefPhold
+from shadow_tpu.engine import EngineConfig
+from shadow_tpu.engine.round import bootstrap, run_until
+from shadow_tpu.engine.sharded import AXIS, ShardedRunner
+from shadow_tpu.engine.state import init_state
+from shadow_tpu.graph.routing import RoutingTables
+from shadow_tpu.models.phold import PholdModel
+
+
+class TpuScheduler:
+    name = "tpu"
+
+    def __init__(self, model, tables: RoutingTables, cfg: EngineConfig, *, parallelism: int = 0, rounds_per_chunk: int = 256):
+        self.model = model
+        self.tables = tables
+        self.cfg = cfg
+        self.rounds_per_chunk = rounds_per_chunk
+        devices = jax.devices()
+        n = parallelism if parallelism > 0 else len(devices)
+        n = min(n, len(devices))
+        # shard only when it divides evenly; otherwise fall back to 1 device
+        while n > 1 and cfg.num_hosts % n != 0:
+            n -= 1
+        self.num_devices = n
+        if n > 1:
+            from jax.sharding import Mesh
+
+            mesh = Mesh(np.array(devices[:n]), (AXIS,))
+            self._runner = ShardedRunner(mesh, model, tables, cfg, rounds_per_chunk)
+        else:
+            self._runner = None
+
+    def run(self, end_time_ns: int, on_chunk=None, max_chunks: int = 100_000):
+        st = bootstrap(init_state(self.cfg, self.model.init()), self.model, self.cfg)
+        if self._runner is not None:
+            return self._runner.run_until(st, end_time_ns, max_chunks=max_chunks, on_chunk=on_chunk)
+        return run_until(
+            st,
+            end_time_ns,
+            self.model,
+            self.tables,
+            self.cfg,
+            rounds_per_chunk=self.rounds_per_chunk,
+            max_chunks=max_chunks,
+            on_chunk=on_chunk,
+        )
+
+
+class CpuRefScheduler:
+    name = "cpu-ref"
+
+    def __init__(self, model, tables: RoutingTables, cfg: EngineConfig, host_node, **_):
+        if not isinstance(model, PholdModel):
+            raise ValueError("cpu-ref scheduler currently supports only the phold model")
+        self.ref = CpuRefPhold(cfg, model, tables, host_node)
+
+    def run(self, end_time_ns: int, on_chunk=None, max_chunks: int = 100_000):
+        self.ref.bootstrap()
+        self.ref.run_until(end_time_ns)
+        return self.ref
+
+
+def make_scheduler(name: str, model, tables, cfg, host_node, parallelism=0, rounds_per_chunk=256):
+    if name == "tpu":
+        return TpuScheduler(model, tables, cfg, parallelism=parallelism, rounds_per_chunk=rounds_per_chunk)
+    if name == "cpu-ref":
+        return CpuRefScheduler(model, tables, cfg, host_node)
+    raise ValueError(f"unknown scheduler {name!r}")

@@ -1,0 +1,46 @@
+"""Simulation-aware logging.
+
+The reference's ShadowLogger stamps every record with wall time, emulated
+time, and the active host (reference: src/main/core/logger/shadow_logger.rs)
+and flushes off-thread. Python's logging is already buffered/async enough at
+our volumes; the important part — the stable record shape with both clocks —
+is reproduced here:
+
+  00:00:01.234 [info] [2000-01-01 00:00:05.000000000] [hostname] message
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from shadow_tpu.simtime import fmt_time_ns
+
+_LEVELS = {"error": 40, "warning": 30, "info": 20, "debug": 10, "trace": 5}
+_threshold = 20
+_start = time.monotonic()
+_sink = None  # None = stderr
+
+
+def set_level(level: str) -> None:
+    global _threshold
+    _threshold = _LEVELS.get(level, 20)
+
+
+def set_sink(fileobj) -> None:
+    """Redirect records (None restores stderr)."""
+    global _sink
+    _sink = fileobj
+
+
+def slog(level: str, sim_time_ns: int, host: str, msg: str) -> None:
+    if _LEVELS.get(level, 20) < _threshold:
+        return
+    elapsed = time.monotonic() - _start
+    mm, ss = divmod(elapsed, 60)
+    hh, mm = divmod(int(mm), 60)
+    line = (
+        f"{hh:02d}:{int(mm):02d}:{ss:06.3f} [{level}] "
+        f"[{fmt_time_ns(sim_time_ns)}] [{host}] {msg}"
+    )
+    print(line, file=_sink or sys.stderr, flush=True)

@@ -1,0 +1,119 @@
+"""Config parsing + Manager end-to-end runs (the analogue of the
+reference's config tests, src/test/config/, and the 3-host example runs)."""
+
+import json
+import os
+
+import pytest
+
+from shadow_tpu.config import load_config_str
+from shadow_tpu.runtime.manager import Manager
+from shadow_tpu.simtime import NS_PER_MS, NS_PER_SEC
+
+BASIC = """
+general:
+  stop_time: "300 ms"
+  seed: 9
+  heartbeat_interval: "100 ms"
+  data_directory: {data_dir}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        node [ id 1 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        edge [ source 0 target 0 latency "1 ms" ]
+        edge [ source 1 target 1 latency "1 ms" ]
+        edge [ source 0 target 1 latency "5 ms" packet_loss 0.02 ]
+      ]
+experimental:
+  scheduler: {scheduler}
+  queue_capacity: 32
+x-custom: ignored
+hosts:
+  alpha:
+    network_node_id: 0
+    quantity: 6
+    processes:
+      - path: phold
+        args: {{ min_delay: "1 ms", max_delay: "10 ms" }}
+  beta:
+    network_node_id: 1
+    quantity: 2
+    ip_addr: null
+    processes:
+      - path: phold
+        args: {{ min_delay: "1 ms", max_delay: "10 ms" }}
+"""
+
+
+def test_config_parsing():
+    cfg = load_config_str(BASIC.format(data_dir="/tmp/x", scheduler="tpu"))
+    assert cfg.general.stop_time_ns == 300 * NS_PER_MS
+    assert cfg.general.seed == 9
+    assert cfg.experimental.queue_capacity == 32
+    assert len(cfg.hosts) == 2
+    assert cfg.hosts[0].quantity == 6
+    assert cfg.hosts[0].processes[0].args["min_delay"] == "1 ms"
+
+
+def test_config_rejects_unknown_keys_and_missing_sections():
+    with pytest.raises(ValueError):
+        load_config_str("general: {stop_time: '1 s', bogus_key: 1}\nhosts: {a: {processes: [{path: phold}]}}")
+    with pytest.raises(ValueError):
+        load_config_str("hosts: {a: {processes: [{path: phold}]}}")  # no general
+    with pytest.raises(ValueError):
+        load_config_str("general: {stop_time: '1 s'}")  # no hosts
+    with pytest.raises(ValueError):
+        load_config_str("general: {stop_time: '0 s'}\nhosts: {a: {processes: [{path: phold}]}}")
+
+
+def test_manager_end_to_end_tpu(tmp_path):
+    cfg = load_config_str(BASIC.format(data_dir=tmp_path / "data", scheduler="tpu"))
+    mgr = Manager(cfg)
+    # expansion: alpha1..alpha6 + beta1, beta2; auto IPs from 11.0.0.0
+    assert [h.name for h in mgr.hosts][:3] == ["alpha1", "alpha2", "alpha3"]
+    assert mgr.ip.ip_str(0) == "11.0.0.1"
+    results = mgr.run()
+    assert results.events_handled > 50
+    assert results.packets_unroutable == 0
+    stats = json.loads((tmp_path / "data" / "sim-stats.json").read_text())
+    assert stats["events_handled"] == results.events_handled
+    assert stats["num_hosts"] == 8
+    hosts_file = (tmp_path / "data" / "hosts").read_text().splitlines()
+    assert hosts_file[0] == "11.0.0.1 alpha1"
+    assert len(hosts_file) == 8
+    assert (tmp_path / "data" / "processed-config.json").exists()
+
+
+def test_manager_tpu_matches_cpu_ref_scheduler(tmp_path):
+    cfg_t = load_config_str(BASIC.format(data_dir=tmp_path / "t", scheduler="tpu"))
+    cfg_c = load_config_str(BASIC.format(data_dir=tmp_path / "c", scheduler="cpu-ref"))
+    rt = Manager(cfg_t).run()
+    rc = Manager(cfg_c).run()
+    assert rt.events_handled == rc.events_handled
+    assert rt.packets_sent == rc.packets_sent
+    assert rt.packets_dropped == rc.packets_dropped
+
+
+def test_example_config_runs(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    path = os.path.join(os.path.dirname(__file__), "..", "examples", "phold", "shadow.yaml")
+    from shadow_tpu.config import load_config_file
+
+    cfg = load_config_file(path)
+    cfg.general.stop_time_ns = 200 * NS_PER_MS  # keep the test fast
+    results = Manager(cfg).run()
+    assert results.events_handled > 0
+
+
+def test_cli_show_config(tmp_path, capsys):
+    from shadow_tpu.cli import main
+
+    p = tmp_path / "c.yaml"
+    p.write_text(BASIC.format(data_dir=tmp_path / "d", scheduler="tpu"))
+    assert main(["run", str(p), "--show-config"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["general"]["seed"] == 9
