@@ -29,10 +29,11 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from shadow_tpu import equeue, rng
+from shadow_tpu import equeue, netstack, rng
 from shadow_tpu.engine.state import EngineConfig, SimState
 from shadow_tpu.events import KIND_PACKET, pack_tie
 from shadow_tpu.graph.routing import RoutingTables
+from shadow_tpu.netstack import AUX_SHAPED_BIT, AUX_SIZE_MASK
 from shadow_tpu.simtime import TIME_MAX
 
 
@@ -108,6 +109,49 @@ def handle_one_iteration(
     ev, q = equeue.pop_min(st.queue, want)
     st = st.replace(queue=q)
 
+    net = st.net
+    defer = jnp.zeros_like(ev.valid)
+    ready = ev.time
+    size_in = jnp.zeros_like(ev.time)
+    if cfg.use_netstack:
+        # --- ingress: down-bw relay + CoDel at the upstream router -------
+        # (relay/mod.rs:110-230 + router/mod.rs:59-115, reformulated as a
+        # closed-form deferred re-enqueue; see netstack.py).
+        is_pkt = ev.valid & (ev.kind == KIND_PACKET)
+        size_in = (ev.aux & AUX_SIZE_MASK).astype(jnp.int64)
+        shaped = (ev.aux & AUX_SHAPED_BIT) != 0
+        loopback = ev.src_host == host_ids
+        in_bootstrap = ev.time < cfg.bootstrap_end_ns
+
+        # a shaped event is the deferred dequeue completing: drain backlog
+        finish = is_pkt & shaped
+        net = net.replace(
+            rx_backlog_bytes=net.rx_backlog_bytes - jnp.where(finish, size_in, 0)
+        )
+
+        need = is_pkt & ~shaped & ~loopback & ~in_bootstrap & (net.rx_refill > 0)
+        ready, rx_tok, rx_last = netstack.tb_depart(
+            net.rx_tokens, net.rx_last, net.rx_refill, ev.time, size_in, need
+        )
+        sojourn = ready - ev.time
+        codel_drop, net = netstack.codel_dequeue(net, ready, sojourn, need)
+        keep_in = need & ~codel_drop
+        # tokens are only consumed by packets that actually pass the relay
+        net = net.replace(
+            rx_tokens=jnp.where(keep_in, rx_tok, net.rx_tokens),
+            rx_last=jnp.where(keep_in, rx_last, net.rx_last),
+            codel_dropped=net.codel_dropped + codel_drop,
+        )
+        defer = keep_in & (ready > ev.time)
+        net = net.replace(
+            rx_backlog_bytes=net.rx_backlog_bytes + jnp.where(defer, size_in, 0)
+        )
+        ev = ev.replace(valid=ev.valid & ~(defer | codel_drop))
+        net = net.replace(
+            bytes_recv=net.bytes_recv
+            + jnp.where(ev.valid & is_pkt, size_in, 0)
+        )
+
     draw = Draw(st.rng_key, st.rng_counter)
     mstate, lemits, pemits = model.handle(st.model, ev, draw, cfg, host_ids)
 
@@ -129,7 +173,32 @@ def handle_one_iteration(
     kept = pvalid & ~unroutable & (loss_u < rel)
     dropped = pvalid & ~unroutable & ~(loss_u < rel)
 
-    deliver = jnp.maximum(ev.time[:, None] + lat, window_end)  # [H, EP]
+    if cfg.use_netstack:
+        # --- egress: up-bw relay charged in lane order at emit time ------
+        # (the loss draw happens downstream of the relay in the reference,
+        # worker.rs:361-378, so loss-dropped packets still consume tokens;
+        # loopback and bootstrap-period packets are exempt,
+        # relay/mod.rs:144-230.)
+        sizes = pemits.size.astype(jnp.int64)
+        in_bootstrap_tx = ev.time < cfg.bootstrap_end_ns
+        tx_tok, tx_last = net.tx_tokens, net.tx_last
+        deps = []
+        for p in range(ep):
+            loopb = dst_clamped[:, p] == host_ids
+            charge = (pvalid[:, p] & ~unroutable[:, p]) & ~loopb & ~in_bootstrap_tx
+            dep_p, tx_tok, tx_last = netstack.tb_depart(
+                tx_tok, tx_last, net.tx_refill, ev.time, sizes[:, p], charge
+            )
+            deps.append(dep_p)
+        dep = jnp.stack(deps, axis=1)  # [H, EP]
+        net = net.replace(
+            tx_tokens=tx_tok,
+            tx_last=tx_last,
+            bytes_sent=net.bytes_sent + jnp.sum(jnp.where(kept, sizes, 0), axis=1),
+        )
+        deliver = jnp.maximum(dep + lat, window_end)  # [H, EP]
+    else:
+        deliver = jnp.maximum(ev.time[:, None] + lat, window_end)  # [H, EP]
 
     # --- sequence numbers: local lanes first, then surviving packets ---
     lseq, seq_after_locals = _lane_seqs(lvalid, st.seq)
@@ -137,6 +206,18 @@ def handle_one_iteration(
 
     # --- push local events into own queues (row-wise, conflict-free) ---
     queue = st.queue
+    if cfg.use_netstack:
+        # re-enqueue relay-deferred arrivals at their dequeue time, same tie
+        # (ordering at `ready` still follows the original total-order key)
+        queue = equeue.push_self(
+            queue,
+            valid=defer,
+            time=ready,
+            tie=ev.tie,
+            kind=ev.kind,
+            data=ev.data,
+            aux=(size_in.astype(jnp.int32) | jnp.int32(AUX_SHAPED_BIT)),
+        )
     for l in range(lvalid.shape[1]):
         queue = equeue.push_self(
             queue,
@@ -153,6 +234,7 @@ def handle_one_iteration(
     lane_idx = jnp.arange(o_cap)[None, :]
     fill, overflow = ob.fill, ob.overflow
     obv, obd, obt, obtie, obdata = ob.valid, ob.dst, ob.time, ob.tie, ob.data
+    obaux = ob.aux
     pkt_kind = jnp.full(host_ids.shape, KIND_PACKET, jnp.int32)
     for p in range(ep):
         has_room = fill < o_cap
@@ -164,14 +246,16 @@ def handle_one_iteration(
         obt = jnp.where(at, deliver[:, p][:, None], obt)
         obtie = jnp.where(at, tie[:, None], obtie)
         obdata = jnp.where(at[:, :, None], pemits.data[:, p, None, :], obdata)
+        obaux = jnp.where(at, (pemits.size[:, p] & AUX_SIZE_MASK)[:, None], obaux)
         fill = fill + write.astype(jnp.int32)
         overflow = overflow + (kept[:, p] & ~has_room).astype(jnp.int32)
-    ob = ob.replace(valid=obv, dst=obd, time=obt, tie=obtie, data=obdata, fill=fill, overflow=overflow)
+    ob = ob.replace(valid=obv, dst=obd, time=obt, tie=obtie, data=obdata, aux=obaux, fill=fill, overflow=overflow)
 
     stride = jnp.uint32(model.DRAWS_PER_EVENT + ep)
     return st.replace(
         queue=queue,
         outbox=ob,
+        net=net,
         model=mstate,
         seq=seq_final,
         rng_counter=st.rng_counter + stride * ev.valid.astype(jnp.uint32),
@@ -196,7 +280,7 @@ def flush_outbox(st: SimState, axis_name: Optional[str]) -> SimState:
         return x.reshape((h_local * o_cap,) + x.shape[2:])
 
     valid, dst, time, tie = flat(ob.valid), flat(ob.dst), flat(ob.time), flat(ob.tie)
-    data = flat(ob.data)
+    data, aux = flat(ob.data), flat(ob.aux)
 
     base = 0
     if axis_name is not None:
@@ -205,6 +289,7 @@ def flush_outbox(st: SimState, axis_name: Optional[str]) -> SimState:
         time = jax.lax.all_gather(time, axis_name, tiled=True)
         tie = jax.lax.all_gather(tie, axis_name, tiled=True)
         data = jax.lax.all_gather(data, axis_name, tiled=True)
+        aux = jax.lax.all_gather(aux, axis_name, tiled=True)
         base = jax.lax.axis_index(axis_name) * h_local
 
     local_dst = dst - base
@@ -217,6 +302,7 @@ def flush_outbox(st: SimState, axis_name: Optional[str]) -> SimState:
         tie=tie,
         kind=jnp.full(valid.shape, KIND_PACKET, jnp.int32),
         data=data,
+        aux=aux,
     )
 
     fresh = ob.replace(

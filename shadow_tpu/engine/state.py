@@ -16,9 +16,10 @@ import flax.struct
 import jax
 import jax.numpy as jnp
 
-from shadow_tpu import equeue, rng
+from shadow_tpu import equeue, netstack, rng
 from shadow_tpu.equeue import PAYLOAD_LANES, EventQueue
 from shadow_tpu.events import MAX_HOSTS
+from shadow_tpu.netstack import NetDevState
 from shadow_tpu.simtime import TIME_MAX
 
 
@@ -32,6 +33,13 @@ class EngineConfig:
     runahead_ns: int = 1_000_000  # min link latency; the conservative window
     seed: int = 1
     max_iters_per_round: int = 1_000_000
+    # Token-bucket relays + CoDel AQM (netstack.py). Off by default: hosts
+    # with no bandwidth config are unshaped, like graph nodes without
+    # bandwidth in the reference.
+    use_netstack: bool = False
+    # Relays are exempt during the bootstrap period (relay/mod.rs:200-230;
+    # config bootstrap_end_time).
+    bootstrap_end_ns: int = 0
     # draws consumed per handled event = model.DRAWS_PER_EVENT + PACKET_EMITS
     # (one loss draw per packet lane), fixed-stride for determinism.
 
@@ -57,6 +65,7 @@ class Outbox:
     time: jax.Array  # [H, O] i64 delivery time
     tie: jax.Array  # [H, O] i64
     data: jax.Array  # [H, O, PAYLOAD_LANES] i32
+    aux: jax.Array  # [H, O] i32 (packet size in bytes)
     fill: jax.Array  # [H] i32 next free lane
     overflow: jax.Array  # [H] i32 emissions dropped for lack of lanes
 
@@ -68,6 +77,7 @@ def _empty_outbox(h: int, o: int) -> Outbox:
         time=jnp.full((h, o), TIME_MAX, jnp.int64),
         tie=jnp.zeros((h, o), jnp.int64),
         data=jnp.zeros((h, o, PAYLOAD_LANES), jnp.int32),
+        aux=jnp.zeros((h, o), jnp.int32),
         fill=jnp.zeros((h,), jnp.int32),
         overflow=jnp.zeros((h,), jnp.int32),
     )
@@ -82,6 +92,7 @@ class SimState:
     rng_key: jax.Array  # [H] per-host base keys
     rng_counter: jax.Array  # [H] u32 per-host draw counter
     host_id: jax.Array  # [H] i32 *global* host id of each row (shard-aware)
+    net: NetDevState  # per-host relays + AQM (netstack.py)
     model: Any  # model-specific pytree, host-axis leading
     # stats (per host)
     events_handled: jax.Array  # [H] i64
@@ -111,6 +122,7 @@ class PacketEmits:
     valid: jax.Array  # [H, EP] bool
     dst: jax.Array  # [H, EP] i32 destination host id
     data: jax.Array  # [H, EP, PAYLOAD_LANES] i32
+    size: jax.Array  # [H, EP] i32 bytes on the wire (feeds the relays)
 
 
 def empty_local_emits(h: int, el: int) -> LocalEmits:
@@ -127,13 +139,21 @@ def empty_packet_emits(h: int, ep: int) -> PacketEmits:
         valid=jnp.zeros((h, ep), bool),
         dst=jnp.zeros((h, ep), jnp.int32),
         data=jnp.zeros((h, ep, PAYLOAD_LANES), jnp.int32),
+        size=jnp.zeros((h, ep), jnp.int32),
     )
 
 
-def init_state(cfg: EngineConfig, model_state) -> SimState:
+def init_state(
+    cfg: EngineConfig,
+    model_state,
+    tx_bytes_per_interval=None,
+    rx_bytes_per_interval=None,
+) -> SimState:
     """Build the (global) initial state. The host->graph-node map lives on
     RoutingTables (see RoutingTables.with_hosts), not here, because it must
-    stay replicated when the state is sharded over hosts."""
+    stay replicated when the state is sharded over hosts. Bandwidths are
+    per-host bucket refills in bytes per refill interval (netstack.py);
+    None/0 = unshaped."""
     h = cfg.num_hosts
     return SimState(
         now=jnp.asarray(0, jnp.int64),
@@ -143,6 +163,7 @@ def init_state(cfg: EngineConfig, model_state) -> SimState:
         rng_key=rng.host_keys(cfg.seed, h),
         rng_counter=jnp.zeros((h,), jnp.uint32),
         host_id=jnp.arange(h, dtype=jnp.int32),
+        net=netstack.create(h, tx_bytes_per_interval, rx_bytes_per_interval),
         model=model_state,
         events_handled=jnp.zeros((h,), jnp.int64),
         packets_sent=jnp.zeros((h,), jnp.int64),

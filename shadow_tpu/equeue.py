@@ -40,6 +40,7 @@ class EventQueue:
     tie: jax.Array  # [H, Q] i64 packed (variant, src_host, seq); _I64_MAX when empty
     kind: jax.Array  # [H, Q] i32 dispatch code; KIND_INVALID when empty
     data: jax.Array  # [H, Q, PAYLOAD_LANES] i32
+    aux: jax.Array  # [H, Q] i32 engine channel (packet size | shaped flag)
     count: jax.Array  # [H] i32 number of valid slots
     overflow: jax.Array  # [H] i32 number of events dropped for lack of slots
 
@@ -59,6 +60,7 @@ def create(num_hosts: int, capacity: int) -> EventQueue:
         tie=jnp.full((h, q), _I64_MAX, dtype=jnp.int64),
         kind=jnp.full((h, q), KIND_INVALID, dtype=jnp.int32),
         data=jnp.zeros((h, q, PAYLOAD_LANES), dtype=jnp.int32),
+        aux=jnp.zeros((h, q), dtype=jnp.int32),
         count=jnp.zeros((h,), dtype=jnp.int32),
         overflow=jnp.zeros((h,), dtype=jnp.int32),
     )
@@ -78,6 +80,7 @@ class Popped:
     tie: jax.Array  # [H] i64
     kind: jax.Array  # [H] i32
     data: jax.Array  # [H, PAYLOAD_LANES] i32
+    aux: jax.Array  # [H] i32
 
     @property
     def src_host(self) -> jax.Array:
@@ -107,6 +110,7 @@ def pop_min(q: EventQueue, want: jax.Array) -> tuple[Popped, EventQueue]:
         tie=q.tie[h_idx, slot],
         kind=q.kind[h_idx, slot],
         data=q.data[h_idx, slot, :],
+        aux=q.aux[h_idx, slot],
     )
 
     # Back-fill the popped slot with the last valid slot, then clear the last.
@@ -127,6 +131,7 @@ def pop_min(q: EventQueue, want: jax.Array) -> tuple[Popped, EventQueue]:
         tie=fill(q.tie, _I64_MAX),
         kind=fill(q.kind, KIND_INVALID),
         data=fill(q.data, 0),
+        aux=fill(q.aux, 0),
         count=q.count - valid.astype(jnp.int32),
     )
 
@@ -138,8 +143,11 @@ def push_self(
     tie: jax.Array,  # [H] i64
     kind: jax.Array,  # [H] i32
     data: jax.Array,  # [H, PAYLOAD_LANES] i32
+    aux: "jax.Array | None" = None,  # [H] i32
 ) -> EventQueue:
     """Each host pushes at most one event into its *own* queue (conflict-free)."""
+    if aux is None:
+        aux = jnp.zeros_like(kind)
     slot_idx = jnp.arange(q.capacity)[None, :]
     has_room = q.count < q.capacity
     write = valid & has_room
@@ -149,6 +157,7 @@ def push_self(
         tie=jnp.where(at, tie[:, None], q.tie),
         kind=jnp.where(at, kind[:, None], q.kind),
         data=jnp.where(at[:, :, None], data[:, None, :], q.data),
+        aux=jnp.where(at, aux[:, None], q.aux),
         count=q.count + write.astype(jnp.int32),
         overflow=q.overflow + (valid & ~has_room).astype(jnp.int32),
     )
@@ -162,6 +171,7 @@ def push_many(
     tie: jax.Array,  # [M] i64
     kind: jax.Array,  # [M] i32
     data: jax.Array,  # [M, PAYLOAD_LANES] i32
+    aux: "jax.Array | None" = None,  # [M] i32
 ) -> EventQueue:
     """Batched push of M events to arbitrary destination hosts.
 
@@ -170,6 +180,8 @@ def push_many(
     minus the mutex): sort entries by destination, rank within each
     destination segment, and scatter into each destination's free slots.
     """
+    if aux is None:
+        aux = jnp.zeros_like(kind)
     m = dst.shape[0]
     num_hosts = q.num_hosts
     pos = jnp.arange(m)
@@ -196,6 +208,7 @@ def push_many(
         tie=q.tie.at[sdst, sslot].set(tie[order], mode="drop"),
         kind=q.kind.at[sdst, sslot].set(kind[order], mode="drop"),
         data=q.data.at[sdst, sslot].set(data[order], mode="drop"),
+        aux=q.aux.at[sdst, sslot].set(aux[order], mode="drop"),
         count=q.count.at[sdst].add(fits.astype(jnp.int32), mode="drop"),
         overflow=q.overflow.at[jnp.where(valid_s & ~fits, key_s, num_hosts)].add(
             (valid_s & ~fits).astype(jnp.int32), mode="drop"

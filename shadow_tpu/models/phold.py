@@ -42,6 +42,7 @@ class PholdModel:
     num_hosts: int
     min_delay_ns: int = 1 * NS_PER_MS
     max_delay_ns: int = 20 * NS_PER_MS  # exclusive
+    ball_bytes: int = 0  # wire size per ball; feeds the relays when shaped
 
     DRAWS_PER_EVENT = 2  # (dst, delay) on ball arrival
     LOCAL_EMITS = 1
@@ -98,6 +99,7 @@ class PholdModel:
             valid=is_send[:, None],
             dst=ev.data[:, 0][:, None],
             data=jnp.zeros((h, 1, PAYLOAD_LANES), jnp.int32),
+            size=jnp.full((h, 1), self.ball_bytes, jnp.int32),
         )
 
         state = state.replace(

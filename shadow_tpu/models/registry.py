@@ -17,6 +17,8 @@ def _build_phold(num_hosts: int, args: dict) -> PholdModel:
         kwargs["min_delay_ns"] = parse_time_ns(args["min_delay"])
     if "max_delay" in args:
         kwargs["max_delay_ns"] = parse_time_ns(args["max_delay"])
+    if "ball_bytes" in args:
+        kwargs["ball_bytes"] = int(args["ball_bytes"])
     return PholdModel(num_hosts=num_hosts, **kwargs)
 
 

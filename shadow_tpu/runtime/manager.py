@@ -38,6 +38,11 @@ class HostInstance:
     node_index: int
     ip: int
     model_name: str
+    # resolved access-link bandwidth: per-host config override, else the
+    # graph node's host_bandwidth_up/down, else -1 = unshaped
+    # (reference: sim_config.rs Bandwidth resolution)
+    bw_up_bits: int = -1
+    bw_down_bits: int = -1
 
 
 @dataclasses.dataclass
@@ -98,13 +103,22 @@ class Manager:
                     if spec.quantity != 1:
                         raise ValueError(f"hosts.{spec.name}: ip_addr with quantity > 1")
                     ip = int(ipaddress.IPv4Address(spec.ip_addr))
+                node_index = self.graph.id_to_index[spec.network_node_id]
+                bw_up = spec.bandwidth_up_bits
+                if bw_up is None:
+                    bw_up = int(self.graph.bw_up_bits[node_index])
+                bw_down = spec.bandwidth_down_bits
+                if bw_down is None:
+                    bw_down = int(self.graph.bw_down_bits[node_index])
                 out.append(
                     HostInstance(
                         index=len(out),
                         name=name,
-                        node_index=self.graph.id_to_index[spec.network_node_id],
+                        node_index=node_index,
                         ip=ip,
                         model_name=spec.processes[0].path,
+                        bw_up_bits=bw_up,
+                        bw_down_bits=bw_down,
                     )
                 )
         return out
@@ -118,6 +132,12 @@ class Manager:
             raise ValueError(
                 f"all hosts must run the same model currently, got {sorted(model_names)}"
             )
+        arg_sets = {json.dumps(spec.processes[0].args, sort_keys=True) for spec in cfgo.hosts}
+        if len(arg_sets) != 1:
+            raise ValueError(
+                "all hosts must run the model with identical args currently, got "
+                f"{sorted(arg_sets)}"
+            )
         model = build_model(model_names.pop(), num_hosts, cfgo.hosts[0].processes[0].args)
 
         host_node = [h.node_index for h in self.hosts]
@@ -128,6 +148,16 @@ class Manager:
         if runahead is None:
             runahead = min(self.graph.min_latency_ns(), tables.min_path_latency_ns())
 
+        # Any host with a resolved bandwidth turns the relays/AQM on; hosts
+        # without one stay unshaped (refill 0).
+        from shadow_tpu.netstack import bw_bits_per_sec_to_refill
+
+        bw_up = np.array([max(h.bw_up_bits, 0) for h in self.hosts], dtype=np.int64)
+        bw_down = np.array([max(h.bw_down_bits, 0) for h in self.hosts], dtype=np.int64)
+        use_netstack = bool((bw_up > 0).any() or (bw_down > 0).any())
+        tx_refill = np.asarray(bw_bits_per_sec_to_refill(bw_up)) if use_netstack else None
+        rx_refill = np.asarray(bw_bits_per_sec_to_refill(bw_down)) if use_netstack else None
+
         ecfg = EngineConfig(
             num_hosts=num_hosts,
             queue_capacity=cfgo.experimental.queue_capacity,
@@ -135,6 +165,8 @@ class Manager:
             runahead_ns=runahead,
             seed=cfgo.general.seed,
             max_iters_per_round=cfgo.experimental.max_iters_per_round,
+            use_netstack=use_netstack,
+            bootstrap_end_ns=cfgo.general.bootstrap_end_time_ns,
         )
 
         sched = make_scheduler(
@@ -145,6 +177,8 @@ class Manager:
             host_node,
             parallelism=cfgo.general.parallelism,
             rounds_per_chunk=cfgo.experimental.rounds_per_chunk,
+            tx_bytes_per_interval=tx_refill,
+            rx_bytes_per_interval=rx_refill,
         )
 
         end = cfgo.general.stop_time_ns

@@ -28,11 +28,14 @@ from shadow_tpu.models.phold import PholdModel
 class TpuScheduler:
     name = "tpu"
 
-    def __init__(self, model, tables: RoutingTables, cfg: EngineConfig, *, parallelism: int = 0, rounds_per_chunk: int = 256):
+    def __init__(self, model, tables: RoutingTables, cfg: EngineConfig, *, parallelism: int = 0, rounds_per_chunk: int = 256,
+                 tx_bytes_per_interval=None, rx_bytes_per_interval=None):
         self.model = model
         self.tables = tables
         self.cfg = cfg
         self.rounds_per_chunk = rounds_per_chunk
+        self.tx_bytes_per_interval = tx_bytes_per_interval
+        self.rx_bytes_per_interval = rx_bytes_per_interval
         devices = jax.devices()
         n = parallelism if parallelism > 0 else len(devices)
         n = min(n, len(devices))
@@ -49,7 +52,16 @@ class TpuScheduler:
             self._runner = None
 
     def run(self, end_time_ns: int, on_chunk=None, max_chunks: int = 100_000):
-        st = bootstrap(init_state(self.cfg, self.model.init()), self.model, self.cfg)
+        st = bootstrap(
+            init_state(
+                self.cfg,
+                self.model.init(),
+                tx_bytes_per_interval=self.tx_bytes_per_interval,
+                rx_bytes_per_interval=self.rx_bytes_per_interval,
+            ),
+            self.model,
+            self.cfg,
+        )
         if self._runner is not None:
             return self._runner.run_until(st, end_time_ns, max_chunks=max_chunks, on_chunk=on_chunk)
         return run_until(
@@ -67,10 +79,13 @@ class TpuScheduler:
 class CpuRefScheduler:
     name = "cpu-ref"
 
-    def __init__(self, model, tables: RoutingTables, cfg: EngineConfig, host_node, **_):
+    def __init__(self, model, tables: RoutingTables, cfg: EngineConfig, host_node,
+                 tx_bytes_per_interval=None, rx_bytes_per_interval=None, **_):
         if not isinstance(model, PholdModel):
             raise ValueError("cpu-ref scheduler currently supports only the phold model")
-        self.ref = CpuRefPhold(cfg, model, tables, host_node)
+        self.ref = CpuRefPhold(cfg, model, tables, host_node,
+                               tx_bytes_per_interval=tx_bytes_per_interval,
+                               rx_bytes_per_interval=rx_bytes_per_interval)
 
     def run(self, end_time_ns: int, on_chunk=None, max_chunks: int = 100_000):
         self.ref.bootstrap()
@@ -78,9 +93,14 @@ class CpuRefScheduler:
         return self.ref
 
 
-def make_scheduler(name: str, model, tables, cfg, host_node, parallelism=0, rounds_per_chunk=256):
+def make_scheduler(name: str, model, tables, cfg, host_node, parallelism=0, rounds_per_chunk=256,
+                   tx_bytes_per_interval=None, rx_bytes_per_interval=None):
     if name == "tpu":
-        return TpuScheduler(model, tables, cfg, parallelism=parallelism, rounds_per_chunk=rounds_per_chunk)
+        return TpuScheduler(model, tables, cfg, parallelism=parallelism, rounds_per_chunk=rounds_per_chunk,
+                            tx_bytes_per_interval=tx_bytes_per_interval,
+                            rx_bytes_per_interval=rx_bytes_per_interval)
     if name == "cpu-ref":
-        return CpuRefScheduler(model, tables, cfg, host_node)
+        return CpuRefScheduler(model, tables, cfg, host_node,
+                               tx_bytes_per_interval=tx_bytes_per_interval,
+                               rx_bytes_per_interval=rx_bytes_per_interval)
     raise ValueError(f"unknown scheduler {name!r}")

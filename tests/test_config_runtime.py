@@ -117,3 +117,54 @@ def test_cli_show_config(tmp_path, capsys):
     assert main(["run", str(p), "--show-config"]) == 0
     out = json.loads(capsys.readouterr().out)
     assert out["general"]["seed"] == 9
+
+
+SHAPED = """
+general:
+  stop_time: "200 ms"
+  seed: 4
+  data_directory: {data_dir}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "2 Mbit" host_bandwidth_down "2 Mbit" ]
+        edge [ source 0 target 0 latency "1 ms" ]
+      ]
+hosts:
+  h:
+    network_node_id: 0
+    quantity: 4
+    processes:
+      - path: phold
+        args: {{ min_delay: "1 ms", max_delay: "4 ms", ball_bytes: 1400 }}
+"""
+
+
+def test_config_bandwidth_reaches_engine(tmp_path):
+    """YAML bandwidth must shape traffic: a 2 Mbit access link caps phold's
+    ball rate well below the unshaped rate (regression: config-parsed
+    bandwidths were silently dropped before reaching EngineConfig)."""
+    cfg = load_config_str(SHAPED.format(data_dir=tmp_path / "a"))
+    res_shaped = Manager(cfg).run()
+
+    unshaped = SHAPED.replace(' host_bandwidth_up "2 Mbit" host_bandwidth_down "2 Mbit"', "")
+    cfg2 = load_config_str(unshaped.format(data_dir=tmp_path / "b"))
+    res_free = Manager(cfg2).run()
+
+    # 2 Mbit = 250 bytes/ms; a 1400-byte ball every ~2.5ms/host unshaped vs
+    # ~5.6ms/ball shaped per host pair -> strictly fewer events when shaped
+    assert res_shaped.events_handled < res_free.events_handled
+
+
+def test_manager_rejects_differing_model_args(tmp_path):
+    two_args = BASIC.format(data_dir=tmp_path / "c", scheduler="tpu").replace(
+        'args: { min_delay: "1 ms", max_delay: "10 ms" }',
+        'args: { min_delay: "2 ms", max_delay: "10 ms" }',
+        1,
+    )
+    cfg = load_config_str(two_args)
+    with pytest.raises(ValueError, match="identical args"):
+        Manager(cfg).run()

@@ -1,0 +1,238 @@
+"""Token-bucket relays + CoDel AQM: unit tests of the closed-form shaping
+math against the integer reference, plus full-engine conformance with the
+netstack enabled (the analogue of the reference's relay/token-bucket/CoDel
+unit tests, src/main/network/relay/token_bucket.rs tests and
+router/codel_queue.rs tests, and its determinism double-runs)."""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shadow_tpu import equeue, netstack
+from shadow_tpu.cpu_ref import CpuRefPhold
+from shadow_tpu.engine import EngineConfig, init_state
+from shadow_tpu.engine.round import bootstrap, round_body_debug, run_until
+from shadow_tpu.graph import NetworkGraph, compute_routing
+from shadow_tpu.models import PholdModel
+from shadow_tpu.netstack import (
+    CODEL_INTERVAL_NS,
+    CODEL_TARGET_NS,
+    MTU_BYTES,
+    REFILL_INTERVAL_NS,
+    CoDelRef,
+    TokenBucketRef,
+)
+from shadow_tpu.simtime import NS_PER_MS
+
+
+def test_tb_depart_matches_integer_reference():
+    rng_py = random.Random(3)
+    for refill in [0, 100, 1500, 12500]:
+        ref = TokenBucketRef(refill)
+        tokens = jnp.asarray([ref.tokens], jnp.int64)
+        last = jnp.asarray([0], jnp.int64)
+        refill_a = jnp.asarray([refill], jnp.int64)
+        now = 0
+        for _ in range(200):
+            now += rng_py.randrange(0, 3 * REFILL_INTERVAL_NS)
+            size = rng_py.randrange(0, MTU_BYTES + 1)
+            dep, tokens, last = netstack.tb_depart(
+                tokens, last, refill_a, jnp.asarray([now], jnp.int64),
+                jnp.asarray([size], jnp.int64), jnp.asarray([True]),
+            )
+            dep_ref = ref.depart(now, size)
+            assert int(dep[0]) == dep_ref, (refill, now, size)
+            assert int(tokens[0]) == ref.tokens
+            assert int(last[0]) == ref.last
+            # a departing packet never leaves before presentation
+            assert dep_ref >= now
+            # once the bucket served it, the next packet can't depart earlier
+            now = max(now, dep_ref)
+
+
+def test_tb_rate_limit_long_run():
+    # sustained back-to-back sends settle at exactly refill bytes/interval
+    refill = 1000
+    tb = TokenBucketRef(refill)
+    now, sent = 0, 0
+    for _ in range(100):
+        dep = tb.depart(now, 500)
+        now = dep
+        sent += 500
+    # 50_000 bytes at 1000/interval -> ~50 intervals (minus initial burst)
+    expected_intervals = (sent - (refill + MTU_BYTES)) / refill
+    assert now >= (expected_intervals - 1) * REFILL_INTERVAL_NS
+    assert now <= (expected_intervals + 1) * REFILL_INTERVAL_NS
+
+
+def test_codel_vector_matches_integer_reference():
+    rng_py = random.Random(9)
+    ref = CoDelRef()
+    net = netstack.create(1)
+    drops_v, drops_r = 0, 0
+    now = 0
+    for i in range(400):
+        now += rng_py.randrange(1, 20) * NS_PER_MS
+        # alternate phases of overload (high sojourn) and drain
+        overload = (i // 50) % 2 == 0
+        sojourn = (
+            rng_py.randrange(CODEL_TARGET_NS, 4 * CODEL_TARGET_NS)
+            if overload
+            else rng_py.randrange(0, CODEL_TARGET_NS // 2)
+        )
+        backlog = 5 * MTU_BYTES if overload else 0
+        net = net.replace(rx_backlog_bytes=jnp.asarray([backlog], jnp.int64))
+        drop, net = netstack.codel_dequeue(
+            net, jnp.asarray([now], jnp.int64), jnp.asarray([sojourn], jnp.int64),
+            jnp.asarray([True]),
+        )
+        rdrop = ref.dequeue(now, sojourn, backlog)
+        assert bool(drop[0]) == rdrop, i
+        drops_v += bool(drop[0])
+        drops_r += rdrop
+    assert drops_v == drops_r
+    assert drops_v > 0  # the overload phases actually triggered the AQM
+
+
+def test_codel_starts_dropping_after_interval():
+    ref = CoDelRef()
+    t = 0
+    drops = []
+    for i in range(30):
+        t += 10 * NS_PER_MS
+        drops.append(ref.dequeue(t, 2 * CODEL_TARGET_NS, 10 * MTU_BYTES))
+    # no drop before a full INTERVAL above target, then drops begin
+    first_drop = drops.index(True)
+    assert first_drop * 10 * NS_PER_MS >= CODEL_INTERVAL_NS
+    assert sum(drops) >= 2  # control law keeps dropping under sustained load
+
+
+def _net_setup(num_hosts=6, seed=13, refill_bytes=2000, ball_bytes=1200,
+               bootstrap_end_ns=0, loss=0.0):
+    n_nodes = 3
+    rng_py = random.Random(seed)
+    lines = ["graph [", "  directed 0"]
+    for i in range(n_nodes):
+        lines.append(f"  node [ id {i} ]")
+        lines.append(f'  edge [ source {i} target {i} latency "500 us" packet_loss {loss} ]')
+    for i in range(n_nodes):
+        for j in range(i + 1, n_nodes):
+            lines.append(
+                f'  edge [ source {i} target {j} latency "{rng_py.randrange(1, 6)} ms" packet_loss {loss} ]'
+            )
+    lines.append("]")
+    graph = NetworkGraph.from_gml("\n".join(lines))
+    host_node = [i % n_nodes for i in range(num_hosts)]
+    tables = compute_routing(graph, block=8).with_hosts(host_node)
+    cfg = EngineConfig(
+        num_hosts=num_hosts,
+        queue_capacity=128,
+        outbox_capacity=8,
+        runahead_ns=graph.min_latency_ns(),
+        seed=seed,
+        use_netstack=True,
+        bootstrap_end_ns=bootstrap_end_ns,
+    )
+    model = PholdModel(
+        num_hosts=num_hosts, min_delay_ns=1 * NS_PER_MS, max_delay_ns=6 * NS_PER_MS,
+        ball_bytes=ball_bytes,
+    )
+    tx = rx = refill_bytes
+    st = init_state(cfg, model.init(), tx_bytes_per_interval=tx, rx_bytes_per_interval=rx)
+    st = bootstrap(st, model, cfg)
+    return cfg, model, tables, host_node, st, tx, rx
+
+
+def _engine_trace_run(st, end_time, model, tables, cfg):
+    trace = []
+    while True:
+        start = int(jnp.min(equeue.next_time(st.queue)))
+        if start >= end_time:
+            break
+        window_end = min(start + cfg.runahead_ns, end_time)
+        st = round_body_debug(st, window_end, model, tables, cfg, trace=trace)
+    return st, trace
+
+
+@pytest.mark.parametrize("loss", [0.0, 0.15])
+def test_engine_netstack_matches_cpu_reference(loss):
+    cfg, model, tables, host_node, st, tx, rx = _net_setup(loss=loss)
+    end = 80 * NS_PER_MS
+
+    ref = CpuRefPhold(cfg, model, tables, host_node,
+                      tx_bytes_per_interval=tx, rx_bytes_per_interval=rx)
+    ref.bootstrap()
+    ref.run_until(end)
+
+    st, trace = _engine_trace_run(st, end, model, tables, cfg)
+
+    key = lambda e: (e[0], e[1])
+    assert sorted(trace, key=key) == sorted(ref.trace, key=key)
+    assert len(trace) > 20
+
+    assert [int(x) for x in st.model.recv_count] == ref.recv
+    assert [int(x) for x in st.model.send_count] == ref.send
+    assert [int(x) for x in st.packets_sent] == ref.packets_sent
+    assert [int(x) for x in st.packets_dropped] == ref.packets_dropped
+    assert [int(x) for x in st.seq] == ref.seq
+    assert [int(x) for x in st.rng_counter] == ref.ctr
+    assert [int(x) for x in st.net.bytes_sent] == ref.bytes_sent
+    assert [int(x) for x in st.net.bytes_recv] == ref.bytes_recv
+    assert [int(x) for x in st.net.codel_dropped] == ref.codel_dropped
+
+    for h in range(cfg.num_hosts):
+        dev = equeue.debug_sorted_events(st.queue, h)
+        assert dev == ref.queue_contents(h), f"host {h}"
+
+    # shaping actually happened: some packet was delayed past raw latency
+    assert int(np.asarray(st.net.bytes_recv).sum()) > 0
+
+
+def test_netstack_jit_matches_debug_and_shapes_traffic():
+    cfg, model, tables, host_node, st0, tx, rx = _net_setup(seed=29, refill_bytes=1500)
+    end = 60 * NS_PER_MS
+
+    st_debug, _ = _engine_trace_run(st0, end, model, tables, cfg)
+    st_jit = run_until(st0, end, model, tables, cfg, rounds_per_chunk=8)
+
+    for name in ["seq", "rng_counter", "packets_sent", "packets_dropped"]:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(st_jit, name)), np.asarray(getattr(st_debug, name))
+        )
+    for name in ["bytes_sent", "bytes_recv", "codel_dropped", "rx_backlog_bytes",
+                 "tx_tokens", "rx_tokens", "codel_count"]:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(st_jit.net, name)), np.asarray(getattr(st_debug.net, name))
+        )
+    for h in range(cfg.num_hosts):
+        assert equeue.debug_sorted_events(st_jit.queue, h) == equeue.debug_sorted_events(
+            st_debug.queue, h
+        )
+
+
+def test_netstack_unlimited_is_noop():
+    # refill 0 = unshaped: identical timeline to use_netstack=False
+    cfg_on, model, tables, host_node, st_on, _, _ = _net_setup(refill_bytes=0)
+    import dataclasses
+
+    cfg_off = dataclasses.replace(cfg_on, use_netstack=False)
+    st_off = bootstrap(init_state(cfg_off, model.init()), model, cfg_off)
+
+    end = 50 * NS_PER_MS
+    _, trace_on = _engine_trace_run(st_on, end, model, tables, cfg_on)
+    _, trace_off = _engine_trace_run(st_off, end, model, tables, cfg_off)
+    assert trace_on == trace_off
+
+
+def test_bootstrap_period_exempt_from_shaping():
+    # with the whole run inside the bootstrap window, shaping is off
+    cfg_b, model, tables, host_node, st_b, tx, rx = _net_setup(
+        refill_bytes=800, bootstrap_end_ns=10_000 * NS_PER_MS
+    )
+    cfg_u, _, _, _, st_u, _, _ = _net_setup(refill_bytes=0)
+    end = 40 * NS_PER_MS
+    _, trace_b = _engine_trace_run(st_b, end, model, tables, cfg_b)
+    _, trace_u = _engine_trace_run(st_u, end, model, tables, cfg_u)
+    assert trace_b == trace_u
