@@ -20,6 +20,7 @@ import numpy as np
 
 from shadow_tpu import rng
 from shadow_tpu.engine.state import EngineConfig
+from shadow_tpu.equeue import PAYLOAD_LANES
 from shadow_tpu.events import KIND_PACKET, pack_tie, tie_src_host
 from shadow_tpu.models.phold import KIND_SEND, PholdModel
 from shadow_tpu.netstack import AUX_SHAPED_BIT, AUX_SIZE_MASK, CoDelRef, TokenBucketRef
@@ -84,7 +85,7 @@ class CpuRefPhold:
             offset = self._u_int(host, 1, m.min_delay_ns, m.max_delay_ns)
             tie = pack_tie(KIND_SEND, host, self.seq[host])
             self.seq[host] += 1
-            heapq.heappush(self.queues[host], (offset, tie, KIND_SEND, (dst, 0, 0, 0), 0))
+            heapq.heappush(self.queues[host], (offset, tie, KIND_SEND, (dst,) + (0,) * (PAYLOAD_LANES - 1), 0))
             self.ctr[host] = m.BOOTSTRAP_DRAWS
 
     def _ingress(self, host, t, tie, kind, data, aux) -> bool:
@@ -160,11 +161,11 @@ class CpuRefPhold:
             delay = self._u_int(host, base + 1, m.min_delay_ns, m.max_delay_ns)
             ltie = pack_tie(KIND_SEND, host, self.seq[host])
             self.seq[host] += 1
-            heapq.heappush(self.queues[host], (t + delay, ltie, KIND_SEND, (dst, 0, 0, 0), 0))
+            heapq.heappush(self.queues[host], (t + delay, ltie, KIND_SEND, (dst,) + (0,) * (PAYLOAD_LANES - 1), 0))
         elif kind == KIND_SEND:
             self.send[host] += 1
             self._send_packet(
-                host, t, data[0], (0, 0, 0, 0), m.ball_bytes,
+                host, t, data[0], (0,) * PAYLOAD_LANES, m.ball_bytes,
                 base + m.DRAWS_PER_EVENT + 0, window_end, outbox,
             )
         else:

@@ -26,8 +26,10 @@ from shadow_tpu.events import KIND_INVALID, pack_tie, tie_src_host
 from shadow_tpu.simtime import TIME_MAX
 
 # Number of i32 payload lanes carried by every event. Models/packets pack
-# their data into these (see engine/state.py for layouts).
-PAYLOAD_LANES = 4
+# their data into these (see engine/state.py for layouts). Transport packets
+# use lanes as headers: ports, seq, ack, flags|len, wnd (transport/header.py);
+# the reference's C packet headers are packet.h:20-40.
+PAYLOAD_LANES = 6
 
 _I64_MAX = jnp.iinfo(jnp.int64).max
 

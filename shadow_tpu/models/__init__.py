@@ -1,3 +1,4 @@
+from shadow_tpu.models.bulk import BulkTcpModel
 from shadow_tpu.models.phold import PholdModel
 
-__all__ = ["PholdModel"]
+__all__ = ["BulkTcpModel", "PholdModel"]

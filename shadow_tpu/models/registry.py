@@ -7,8 +7,30 @@ process layer will plug into the same seam.
 
 from __future__ import annotations
 
+from shadow_tpu.models.bulk import BulkTcpModel
 from shadow_tpu.models.phold import PholdModel
 from shadow_tpu.simtime import parse_time_ns
+from shadow_tpu.transport.tcp import TcpParams
+
+
+def _build_bulk_tcp(num_hosts: int, args: dict) -> BulkTcpModel:
+    kwargs = {}
+    if "pairs" in args:
+        kwargs["num_pairs"] = int(args["pairs"])
+    else:
+        kwargs["num_pairs"] = num_hosts // 2
+    for k in ("total_bytes", "port", "client_port"):
+        if k in args:
+            kwargs[k] = int(args[k])
+    if "start" in args:
+        kwargs["start_ns"] = parse_time_ns(args["start"])
+    tcp_kwargs = {}
+    for k in ("num_sockets", "mss", "rcv_wnd", "init_cwnd_segs"):
+        if k in args:
+            tcp_kwargs[k] = int(args[k])
+    if tcp_kwargs:
+        kwargs["tcp_params"] = TcpParams(**tcp_kwargs)
+    return BulkTcpModel(num_hosts=num_hosts, **kwargs)
 
 
 def _build_phold(num_hosts: int, args: dict) -> PholdModel:
@@ -24,6 +46,7 @@ def _build_phold(num_hosts: int, args: dict) -> PholdModel:
 
 _REGISTRY = {
     "phold": _build_phold,
+    "bulk-tcp": _build_bulk_tcp,  # iperf-like bulk transfer over the TCP stack
 }
 
 

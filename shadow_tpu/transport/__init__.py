@@ -1,0 +1,17 @@
+"""Transport stacks (L4 of the reference, rebuilt as vectorized state
+machines over the host axis): header lane packing, the TCP flow table, and
+UDP helpers. Reference: src/main/host/descriptor/tcp.c,
+src/main/host/descriptor/socket/inet/udp.rs, src/main/routing/packet.h.
+"""
+
+from shadow_tpu.transport.header import (  # noqa: F401
+    FLAG_ACK,
+    FLAG_FIN,
+    FLAG_RST,
+    FLAG_SYN,
+    pack_flags_len,
+    pack_ports,
+    unpack_flags_len,
+    unpack_ports,
+)
+from shadow_tpu.transport.tcp import TcpParams, TcpState  # noqa: F401

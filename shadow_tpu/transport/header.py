@@ -1,0 +1,64 @@
+"""Packet header lanes.
+
+The reference's C packet carries real IPv4/TCP/UDP-ish headers
+(reference: src/main/routing/packet.h:20-40 — src/dst ip+port, seq, ack,
+flags, window, payload length). Here a packet's PAYLOAD_LANES i32 lanes
+carry the same information; payload *content* is not simulated, only sizes
+(the reference stores real bytes because managed processes read them; the
+device engine's scripted models only observe lengths — the CPU host layer
+keeps real bytes for managed processes, see hostk/).
+
+lane 0: (src_port << 16) | dst_port        (u16 each)
+lane 1: seq  (wire u32; i64 stream offsets are unwrapped via unwrap32)
+lane 2: ack  (wire u32)
+lane 3: flags | (payload_len << 8)         (flags: FIN/SYN/RST/ACK)
+lane 4: advertised receive window, bytes
+lane 5: free for app/model use (stream id, message marker, ...)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+LANE_PORTS = 0
+LANE_SEQ = 1
+LANE_ACK = 2
+LANE_FLAGS_LEN = 3
+LANE_WND = 4
+LANE_APP = 5
+
+# Standard TCP flag bit positions (low byte of lane 3).
+FLAG_FIN = 0x01
+FLAG_SYN = 0x02
+FLAG_RST = 0x04
+FLAG_ACK = 0x10
+
+
+def pack_ports(src_port, dst_port):
+    return (src_port.astype(jnp.int32) << 16) | (dst_port.astype(jnp.int32) & 0xFFFF)
+
+
+def unpack_ports(lane0):
+    return (lane0 >> 16) & 0xFFFF, lane0 & 0xFFFF
+
+
+def pack_flags_len(flags, payload_len):
+    return (flags.astype(jnp.int32) & 0xFF) | (payload_len.astype(jnp.int32) << 8)
+
+
+def unpack_flags_len(lane3):
+    return lane3 & 0xFF, (lane3 >> 8) & 0xFFFFFF
+
+
+def to_wire32(seq_i64):
+    """Low 32 bits of an absolute i64 stream offset, as the i32 wire lane."""
+    return (seq_i64 & 0xFFFFFFFF).astype(jnp.int32)
+
+
+def unwrap32(near_i64, wire_i32):
+    """Reconstruct the absolute i64 offset closest to `near` whose low 32
+    bits equal `wire` (standard serial-number unwrap; exact while pending
+    data spans < 2^31 bytes, which bounded windows guarantee)."""
+    wire_u = wire_i32.astype(jnp.int64) & 0xFFFFFFFF
+    delta = ((wire_u - (near_i64 & 0xFFFFFFFF) + (1 << 31)) & 0xFFFFFFFF) - (1 << 31)
+    return near_i64 + delta

