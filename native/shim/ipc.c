@@ -1,0 +1,83 @@
+/* Futex-parked single-slot channel operations, shared by the shim and the
+ * host-side library (reference: src/lib/vasi-sync/src/scchannel.rs state
+ * machine; simplified to the strict ping-pong the IPC actually uses —
+ * exactly one side runs at a time, reference ipc.rs:10-17). */
+
+#define _GNU_SOURCE
+#include "shadow_ipc.h"
+
+#include <errno.h>
+#include <linux/futex.h>
+#include <stddef.h>
+#include <string.h>
+#include <sys/syscall.h>
+#include <sys/time.h>
+#include <time.h>
+#include <unistd.h>
+
+static long sys_futex(shim_atomic_u32 *uaddr, int op, uint32_t val,
+                      const struct timespec *timeout) {
+    return syscall(SYS_futex, uaddr, op, val, timeout, NULL, 0);
+}
+
+void shim_channel_send(ShimChannel *ch, const ShimMsg *msg) {
+    /* ping-pong discipline: the slot is empty whenever we are entitled to
+     * send, so this never blocks */
+    size_t n = offsetof(ShimMsg, buf) + msg->buf_len;
+    memcpy((void *)&ch->msg, msg, n);
+    atomic_store_explicit(&ch->state, 1u, memory_order_release);
+    sys_futex(&ch->state, FUTEX_WAKE, 1, NULL);
+}
+
+/* returns 0 on success, -1 on timeout (timeout_ms < 0 = wait forever) */
+int shim_channel_recv(ShimChannel *ch, ShimMsg *out, int timeout_ms) {
+    struct timespec ts, *tsp = NULL;
+    if (timeout_ms >= 0) {
+        ts.tv_sec = timeout_ms / 1000;
+        ts.tv_nsec = (long)(timeout_ms % 1000) * 1000000L;
+        tsp = &ts;
+    }
+    while (atomic_load_explicit(&ch->state, memory_order_acquire) != 1u) {
+        long r = sys_futex(&ch->state, FUTEX_WAIT, 0u, tsp);
+        if (r == -1 && errno == ETIMEDOUT)
+            return -1;
+        /* EAGAIN (state changed) / EINTR: re-check the state */
+    }
+    size_t hdr = offsetof(ShimMsg, buf);
+    memcpy(out, (const void *)&ch->msg, hdr);
+    if (out->buf_len > SHIM_BUF_SIZE)
+        out->buf_len = SHIM_BUF_SIZE;
+    memcpy(out->buf, (const void *)ch->msg.buf, out->buf_len);
+    atomic_store_explicit(&ch->state, 0u, memory_order_release);
+    return 0;
+}
+
+int shim_channel_poll(ShimChannel *ch) {
+    return atomic_load_explicit(&ch->state, memory_order_acquire) == 1u;
+}
+
+void shim_shmem_init(ShimShmem *s, int64_t vdso_latency_ns,
+                     int64_t syscall_latency_ns, int64_t max_unapplied_ns) {
+    memset(s, 0, sizeof(*s));
+    s->magic = SHIM_MAGIC;
+    s->version = SHIM_VERSION;
+    s->vdso_latency_ns = vdso_latency_ns;
+    s->syscall_latency_ns = syscall_latency_ns;
+    s->max_unapplied_ns = max_unapplied_ns;
+}
+
+void shim_set_time(ShimShmem *s, int64_t now_ns, int64_t max_runahead_ns) {
+    atomic_store_explicit(&s->sim_time_ns, now_ns, memory_order_release);
+    atomic_store_explicit(&s->max_runahead_ns, max_runahead_ns,
+                          memory_order_release);
+}
+
+int64_t shim_get_time(ShimShmem *s) {
+    return atomic_load_explicit(&s->sim_time_ns, memory_order_acquire);
+}
+
+/* layout exports so the Python host side never hardcodes offsets */
+int shim_layout_size(void) { return (int)sizeof(ShimShmem); }
+int shim_layout_to_shadow(void) { return (int)offsetof(ShimShmem, to_shadow); }
+int shim_layout_to_shim(void) { return (int)offsetof(ShimShmem, to_shim); }
+int shim_layout_msg_size(void) { return (int)sizeof(ShimMsg); }

@@ -1,0 +1,96 @@
+/* Shared-memory IPC between Shadow and managed processes.
+ *
+ * TPU-native rebuild of the reference's shim IPC substrate
+ * (reference: src/lib/shadow-shim-helper-rs/src/ipc.rs:10-17 — two
+ * single-slot channels, strict ping-pong; src/lib/vasi-sync/src/scchannel.rs
+ * — futex-parked state machine; src/lib/shadow-shim-helper-rs/src/
+ * shim_shmem.rs:52-304 — shared sim_time/max_runahead blocks).
+ *
+ * Layout notes: everything here lives in one shm file mapped by both the
+ * simulator (via Python ctypes over libshadow_host.so) and the managed
+ * process (via the LD_PRELOAD shim). No pointers cross the boundary
+ * (the reference enforces this with the VirtualAddressSpaceIndependent
+ * trait; here the structs are plain PODs by construction).
+ */
+#ifndef SHADOW_IPC_H
+#define SHADOW_IPC_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+#include <atomic>
+typedef std::atomic<uint32_t> shim_atomic_u32;
+typedef std::atomic<int64_t> shim_atomic_i64;
+#else
+#include <stdatomic.h>
+typedef _Atomic uint32_t shim_atomic_u32;
+typedef _Atomic int64_t shim_atomic_i64;
+#endif
+
+#define SHIM_MAGIC 0x53485457u /* "SHTW" */
+#define SHIM_VERSION 1
+#define SHIM_BUF_SIZE 65536
+
+/* message kinds (the event protocol, reference shim_event.rs:48-90) */
+enum {
+    SHIM_MSG_NONE = 0,
+    SHIM_MSG_START_REQ = 1,  /* shim -> shadow: process is up            */
+    SHIM_MSG_START_RES = 2,  /* shadow -> shim: config in a[]            */
+    SHIM_MSG_SYSCALL = 3,    /* shim -> shadow: a[0]=vsys, a[1..5]=args  */
+    SHIM_MSG_SYSCALL_DONE = 4, /* shadow -> shim: ret (+ buf payload)    */
+    SHIM_MSG_PROC_EXIT = 5,  /* shim -> shadow: destructor ran           */
+};
+
+/* virtual syscall codes (a[0] of SHIM_MSG_SYSCALL). The reference
+ * dispatches real syscall numbers (src/main/host/syscall_handler.c:229-463);
+ * the preload shim normalizes to these portable codes instead. */
+enum {
+    VSYS_NANOSLEEP = 1,  /* a[1]=ns */
+    VSYS_SOCKET = 2,     /* a[1]=domain a[2]=type a[3]=proto */
+    VSYS_BIND = 3,       /* a[1]=fd a[2]=ip(be) a[3]=port(host order) */
+    VSYS_SENDTO = 4,     /* a[1]=fd a[2]=ip a[3]=port, buf=payload */
+    VSYS_RECVFROM = 5,   /* a[1]=fd a[2]=flags(MSG_DONTWAIT bit) -> buf, a[2]=src ip a[3]=src port */
+    VSYS_CLOSE = 6,      /* a[1]=fd */
+    VSYS_GETPID = 7,
+    VSYS_CONNECT = 8,    /* a[1]=fd a[2]=ip a[3]=port */
+    VSYS_GETSOCKNAME = 9, /* a[1]=fd -> a[2]=ip a[3]=port */
+    VSYS_YIELD = 10,     /* a[1]=unapplied ns; shadow folds into host clock */
+    VSYS_EXIT = 11,      /* a[1]=exit code */
+    VSYS_CLOCK_GETTIME = 12, /* explicit slow-path time read */
+};
+
+typedef struct {
+    uint32_t kind;
+    uint32_t tid;      /* reserved for thread support */
+    int64_t a[6];
+    int64_t ret;
+    uint32_t buf_len;
+    uint32_t _pad;
+    char buf[SHIM_BUF_SIZE];
+} ShimMsg;
+
+/* single-slot ping-pong channel: state 0 = empty, 1 = full */
+typedef struct {
+    shim_atomic_u32 state;
+    uint32_t _pad;
+    ShimMsg msg;
+} ShimChannel;
+
+typedef struct {
+    uint32_t magic;
+    uint32_t version;
+    /* written by shadow before transferring control
+     * (reference managed_thread.rs:368-404 continue_plugin) */
+    shim_atomic_i64 sim_time_ns;
+    shim_atomic_i64 max_runahead_ns;
+    /* time-model config (reference shim_sys.c:22-90 local syscall serving) */
+    int64_t vdso_latency_ns;
+    int64_t syscall_latency_ns;
+    int64_t max_unapplied_ns;
+    ShimChannel to_shadow; /* plugin writes, shadow reads */
+    ShimChannel to_shim;   /* shadow writes, plugin reads */
+} ShimShmem;
+
+#define SHIM_SHMEM_SIZE sizeof(ShimShmem)
+
+#endif /* SHADOW_IPC_H */

@@ -1,0 +1,34 @@
+"""Build/locate the native shim libraries (native/Makefile)."""
+
+from __future__ import annotations
+
+import pathlib
+import subprocess
+
+_NATIVE = pathlib.Path(__file__).resolve().parent.parent.parent / "native"
+_BUILD = _NATIVE / "build"
+
+
+def ensure_built() -> None:
+    srcs = list((_NATIVE / "shim").glob("*.c")) + list((_NATIVE / "shim").glob("*.h"))
+    shim = _BUILD / "libshadow_shim.so"
+    host = _BUILD / "libshadow_host.so"
+    if shim.exists() and host.exists():
+        newest_src = max(p.stat().st_mtime for p in srcs)
+        if shim.stat().st_mtime >= newest_src and host.stat().st_mtime >= newest_src:
+            return
+    r = subprocess.run(["make", "-C", str(_NATIVE)], capture_output=True, text=True)
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"native shim build failed (make -C {_NATIVE}):\n{r.stdout}\n{r.stderr}"
+        )
+
+
+def shim_lib_path() -> str:
+    ensure_built()
+    return str(_BUILD / "libshadow_shim.so")
+
+
+def host_lib_path() -> str:
+    ensure_built()
+    return str(_BUILD / "libshadow_host.so")

@@ -1,0 +1,197 @@
+"""ctypes bindings over native/shim: shmem blocks + futex channels.
+
+Host side of the IPC substrate (reference: src/lib/shmem/src/allocator.rs
+block management + src/lib/vasi-sync/src/scchannel.rs; the serialized
+block handle passed through the environment mirrors SHADOW_IPC_BLK,
+managed_thread.rs:94-102 — here it is simply the shm file path in
+SHADOW_SHM)."""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import pathlib
+import tempfile
+
+from shadow_tpu.hostk.build import host_lib_path
+
+SHIM_BUF_SIZE = 65536
+
+# message kinds (native/shim/shadow_ipc.h)
+MSG_START_REQ = 1
+MSG_START_RES = 2
+MSG_SYSCALL = 3
+MSG_SYSCALL_DONE = 4
+MSG_PROC_EXIT = 5
+
+# virtual syscall codes
+VSYS_NANOSLEEP = 1
+VSYS_SOCKET = 2
+VSYS_BIND = 3
+VSYS_SENDTO = 4
+VSYS_RECVFROM = 5
+VSYS_CLOSE = 6
+VSYS_GETPID = 7
+VSYS_CONNECT = 8
+VSYS_GETSOCKNAME = 9
+VSYS_YIELD = 10
+VSYS_EXIT = 11
+VSYS_CLOCK_GETTIME = 12
+
+VSYS_NAMES = {
+    VSYS_NANOSLEEP: "nanosleep",
+    VSYS_SOCKET: "socket",
+    VSYS_BIND: "bind",
+    VSYS_SENDTO: "sendto",
+    VSYS_RECVFROM: "recvfrom",
+    VSYS_CLOSE: "close",
+    VSYS_GETPID: "getpid",
+    VSYS_CONNECT: "connect",
+    VSYS_GETSOCKNAME: "getsockname",
+    VSYS_YIELD: "yield",
+    VSYS_EXIT: "exit",
+    VSYS_CLOCK_GETTIME: "clock_gettime",
+}
+
+
+class ShimMsg(ctypes.Structure):
+    _fields_ = [
+        ("kind", ctypes.c_uint32),
+        ("tid", ctypes.c_uint32),
+        ("a", ctypes.c_int64 * 6),
+        ("ret", ctypes.c_int64),
+        ("buf_len", ctypes.c_uint32),
+        ("_pad", ctypes.c_uint32),
+        ("buf", ctypes.c_char * SHIM_BUF_SIZE),
+    ]
+
+
+class _Lib:
+    _instance = None
+
+    def __init__(self):
+        lib = ctypes.CDLL(host_lib_path())
+        lib.shim_channel_send.argtypes = [ctypes.c_void_p, ctypes.POINTER(ShimMsg)]
+        lib.shim_channel_send.restype = None
+        lib.shim_channel_recv.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ShimMsg),
+            ctypes.c_int,
+        ]
+        lib.shim_channel_recv.restype = ctypes.c_int
+        lib.shim_channel_poll.argtypes = [ctypes.c_void_p]
+        lib.shim_channel_poll.restype = ctypes.c_int
+        lib.shim_shmem_init.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+        ]
+        lib.shim_shmem_init.restype = None
+        lib.shim_set_time.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]
+        lib.shim_set_time.restype = None
+        lib.shim_get_time.argtypes = [ctypes.c_void_p]
+        lib.shim_get_time.restype = ctypes.c_int64
+        for f in (
+            lib.shim_layout_size,
+            lib.shim_layout_to_shadow,
+            lib.shim_layout_to_shim,
+            lib.shim_layout_msg_size,
+        ):
+            f.argtypes = []
+            f.restype = ctypes.c_int
+        self.lib = lib
+
+    @classmethod
+    def get(cls):
+        if cls._instance is None:
+            cls._instance = _Lib()
+        return cls._instance
+
+
+def _layout():
+    """Struct offsets exported by the C library (never duplicated here)."""
+    lib = _Lib.get().lib
+    assert lib.shim_layout_msg_size() == ctypes.sizeof(ShimMsg), (
+        "ShimMsg ctypes mirror out of sync with shadow_ipc.h"
+    )
+    return (
+        lib.shim_layout_size(),
+        lib.shim_layout_to_shadow(),
+        lib.shim_layout_to_shim(),
+    )
+
+
+class IpcBlock:
+    """One managed process's shared block + its two channels."""
+
+    def __init__(
+        self,
+        tag: str,
+        vdso_latency_ns: int = 10,
+        syscall_latency_ns: int = 1_000,
+        max_unapplied_ns: int = 1_000_000,
+        dir: str | None = None,
+    ):
+        size, self._to_shadow_off, self._to_shim_off = _layout()
+        base = pathlib.Path(dir or "/dev/shm")
+        fd, path = tempfile.mkstemp(prefix=f"shadow-tpu-{tag}-", dir=str(base))
+        os.ftruncate(fd, size)
+        self.path = path
+        self._mm = mmap.mmap(fd, size)
+        os.close(fd)
+        self._addr = ctypes.addressof(ctypes.c_char.from_buffer(self._mm))
+        self._lib = _Lib.get().lib
+        self._lib.shim_shmem_init(
+            self._addr, vdso_latency_ns, syscall_latency_ns, max_unapplied_ns
+        )
+
+    # channels
+    def send_to_shim(self, msg: ShimMsg) -> None:
+        self._lib.shim_channel_send(self._addr + self._to_shim_off, ctypes.byref(msg))
+
+    def recv_from_shim(self, timeout_ms: int = -1) -> ShimMsg | None:
+        out = ShimMsg()
+        r = self._lib.shim_channel_recv(
+            self._addr + self._to_shadow_off, ctypes.byref(out), timeout_ms
+        )
+        return out if r == 0 else None
+
+    def poll_from_shim(self) -> bool:
+        return bool(self._lib.shim_channel_poll(self._addr + self._to_shadow_off))
+
+    def set_time(self, now_ns: int, max_runahead_ns: int) -> None:
+        self._lib.shim_set_time(self._addr, now_ns, max_runahead_ns)
+
+    def close(self) -> None:
+        if self._mm is not None:
+            del self._addr
+            self._mm.close()
+            self._mm = None
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+_BUF_OFFSET = ShimMsg.buf.offset
+
+
+def msg_payload(m: ShimMsg) -> bytes:
+    """The message's buf as raw bytes. (A c_char array *field* has value
+    semantics in ctypes — it copies and truncates at NUL — so payload
+    access must go through the struct's address.)"""
+    return ctypes.string_at(ctypes.addressof(m) + _BUF_OFFSET, m.buf_len)
+
+
+def make_msg(kind: int, a=(), ret: int = 0, buf: bytes = b"") -> ShimMsg:
+    m = ShimMsg()
+    m.kind = kind
+    for i, v in enumerate(a):
+        m.a[i] = int(v)
+    m.ret = ret
+    if buf:
+        m.buf_len = len(buf)
+        ctypes.memmove(ctypes.addressof(m) + _BUF_OFFSET, buf, len(buf))
+    return m

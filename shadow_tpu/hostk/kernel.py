@@ -1,0 +1,448 @@
+"""The CPU-side simulation kernel for managed (real) processes.
+
+Rebuilds the reference's managed-process control plane (reference:
+src/main/host/managed_thread.rs:156-267 run-until-syscall loop;
+src/main/host/process.rs spawn/resume; src/main/host/syscall/handler/
+socket.rs + time.rs syscall emulation; src/main/core/worker.rs:328-413
+send_packet) as a serial discrete-event loop over real child processes
+parked on futex channels.
+
+Determinism contract shared with the device engine: packet loss draws use
+the same threefry per-host counter streams (shadow_tpu/rng), latencies
+come from the same RoutingTables, sim time starts at the same 2000-01-01
+epoch (simtime.SIM_START_UNIX_NS; reference emulated_time.rs:25-34), and
+all scheduling decisions derive from (time, seq) heap order — two runs of
+the same config produce identical syscall traces and identical guest-
+visible timestamps.
+
+Time model: a process's clock advances by `syscall_latency_ns` per
+emulated syscall plus whatever unapplied vdso-read latency the shim
+accumulated locally (the reference's model_unblocked_syscall_latency,
+shim_sys.c:182-217). Pure native compute does not advance sim time (the
+reference models CPU time only behind an experimental flag; same stance).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import os
+import pathlib
+import shutil
+import subprocess
+from collections import deque
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu import rng
+from shadow_tpu.graph.routing import RoutingTables
+from shadow_tpu.hostk import ipc as I
+from shadow_tpu.hostk.build import shim_lib_path
+from shadow_tpu.simtime import SIM_START_UNIX_NS, TIME_MAX
+
+EPHEMERAL_PORT_BASE = 10_000
+VFD_BASE = 1000
+
+
+class SimPanic(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass
+class UdpSocket:
+    fd: int
+    bound_port: int = 0  # 0 = unbound
+    peer: Optional[tuple[int, int]] = None  # (ip, port) after connect()
+    recvq: deque = dataclasses.field(default_factory=deque)  # (data, ip, port)
+    blocked: bool = False  # a recvfrom is parked on this socket
+
+
+@dataclasses.dataclass
+class ProcessSpec:
+    host: str
+    args: list[str]
+    start_ns: int = 0
+    expected_final_state: str = "exited"  # "exited" | "running"
+
+
+class ManagedProcess:
+    def __init__(self, kernel: "NetKernel", spec: ProcessSpec, host: "HostKernel", vpid: int):
+        self.kernel = kernel
+        self.spec = spec
+        self.host = host
+        self.vpid = vpid
+        self.now = 0
+        self.ipc: Optional[I.IpcBlock] = None
+        self.popen: Optional[subprocess.Popen] = None
+        self.sockets: dict[int, UdpSocket] = {}
+        self.next_fd = VFD_BASE
+        self.state = "pending"  # pending -> running -> blocked -> exited
+        self.pending_sleep = False
+        self.syscall_log: list[tuple[int, str, tuple]] = []
+        self.exit_code: Optional[int] = None
+        self._stdout_path = None
+
+    # --- lifecycle -------------------------------------------------------
+
+    def spawn(self, now_ns: int) -> None:
+        self.now = now_ns
+        self.ipc = I.IpcBlock(
+            tag=f"h{self.host.host_id}p{self.vpid}",
+            vdso_latency_ns=self.kernel.vdso_latency_ns,
+            syscall_latency_ns=self.kernel.syscall_latency_ns,
+            max_unapplied_ns=self.kernel.max_unapplied_ns,
+        )
+        self.ipc.set_time(SIM_START_UNIX_NS + now_ns, 0)
+        env = dict(os.environ)
+        env["LD_PRELOAD"] = shim_lib_path()
+        env["SHADOW_SHM"] = self.ipc.path
+        outdir = self.kernel.data_dir / self.host.name
+        outdir.mkdir(parents=True, exist_ok=True)
+        self._stdout_path = outdir / f"{pathlib.Path(self.spec.args[0]).name}.{self.vpid}.stdout"
+        self._stderr_path = outdir / f"{pathlib.Path(self.spec.args[0]).name}.{self.vpid}.stderr"
+        self.popen = subprocess.Popen(
+            self.spec.args,
+            env=env,
+            stdout=open(self._stdout_path, "wb"),
+            stderr=open(self._stderr_path, "wb"),
+            stdin=subprocess.DEVNULL,
+        )
+        # shim constructor sends START_REQ before main() runs
+        msg = self._recv()
+        if msg is None or msg.kind != I.MSG_START_REQ:
+            raise SimPanic(
+                f"{self.host.name}: process failed to attach "
+                f"(kind={getattr(msg, 'kind', None)}, rc={self.popen.poll()})"
+            )
+        self.state = "running"
+
+    def stdout(self) -> bytes:
+        return pathlib.Path(self._stdout_path).read_bytes() if self._stdout_path else b""
+
+    def kill(self) -> None:
+        if self.popen and self.popen.poll() is None:
+            self.popen.kill()
+            self.popen.wait()
+        if self.ipc:
+            self.ipc.close()
+            self.ipc = None
+
+    # --- channel helpers -------------------------------------------------
+
+    def _recv(self) -> Optional[I.ShimMsg]:
+        """Blocking receive with child-death detection (the reference pairs
+        this with ChildPidWatcher closing the channel,
+        utility/childpid_watcher.rs)."""
+        while True:
+            msg = self.ipc.recv_from_shim(timeout_ms=100)
+            if msg is not None:
+                return msg
+            if self.popen.poll() is not None:
+                return None
+
+    def _reply(self, ret: int = 0, a=(), buf: bytes = b"") -> None:
+        self.ipc.set_time(SIM_START_UNIX_NS + self.now, 0)
+        m = I.make_msg(I.MSG_SYSCALL_DONE, a=a, ret=ret, buf=buf)
+        self.ipc.send_to_shim(m)
+
+
+class HostKernel:
+    """Per-host world on the CPU side: ports, IP, deterministic counters
+    (the CPU sibling of a row in the device engine's SimState; reference
+    src/main/host/host.rs:96-205)."""
+
+    def __init__(self, kernel: "NetKernel", name: str, host_id: int, node: int, ip: int):
+        self.kernel = kernel
+        self.name = name
+        self.host_id = host_id
+        self.node = node
+        self.ip = ip
+        self.ports: dict[int, tuple[ManagedProcess, int]] = {}  # port -> (proc, fd)
+        self.next_port = EPHEMERAL_PORT_BASE
+        self.rng_counter = 0
+        self.procs: list[ManagedProcess] = []
+        self.packets_sent = 0
+        self.packets_dropped = 0
+
+    def alloc_port(self) -> int:
+        while self.next_port in self.ports:
+            self.next_port += 1
+        p = self.next_port
+        self.next_port += 1
+        return p
+
+
+class NetKernel:
+    """The serial event loop driving all managed processes."""
+
+    def __init__(
+        self,
+        tables: RoutingTables,
+        host_names: list[str],
+        host_nodes: list[int],
+        seed: int = 1,
+        data_dir: str | os.PathLike = "shadow-tpu-data",
+        syscall_latency_ns: int = 1_000,
+        vdso_latency_ns: int = 10,
+        max_unapplied_ns: int = 1_000_000,
+    ):
+        self.tables = tables
+        self.lat = np.asarray(tables.lat_ns)
+        self.rel = np.asarray(tables.rel)
+        self.seed = seed
+        self.syscall_latency_ns = syscall_latency_ns
+        self.vdso_latency_ns = vdso_latency_ns
+        self.max_unapplied_ns = max_unapplied_ns
+        self.data_dir = pathlib.Path(data_dir)
+        if self.data_dir.exists():
+            shutil.rmtree(self.data_dir)
+        self.data_dir.mkdir(parents=True)
+
+        self.hosts: list[HostKernel] = []
+        self.host_by_ip: dict[int, HostKernel] = {}
+        self.host_by_name: dict[str, HostKernel] = {}
+        base_ip = (11 << 24) | 1  # 11.0.0.1, reference ip auto-assign graph/mod.rs:356-422
+        for i, (name, node) in enumerate(zip(host_names, host_nodes)):
+            hk = HostKernel(self, name, i, node, base_ip + i)
+            self.hosts.append(hk)
+            self.host_by_ip[hk.ip] = hk
+            self.host_by_name[name] = hk
+        self._keys = rng.host_keys(seed, len(self.hosts))
+
+        self.now = 0
+        self._seq = 0
+        self.events: list[tuple[int, int, Callable[[], None]]] = []
+        self.procs: list[ManagedProcess] = []
+        self.event_log: list[tuple[int, str]] = []
+
+    # --- deterministic draws (same threefry streams as the engine) -------
+
+    def _loss_draw(self, src: HostKernel) -> float:
+        u = float(
+            rng.uniform_f32(
+                self._keys[src.host_id : src.host_id + 1],
+                jnp.array([src.rng_counter], jnp.uint32),
+            )[0]
+        )
+        src.rng_counter += 1
+        return u
+
+    # --- config ----------------------------------------------------------
+
+    def add_process(self, spec: ProcessSpec) -> ManagedProcess:
+        host = self.host_by_name[spec.host]
+        proc = ManagedProcess(self, spec, host, vpid=1000 + len(self.procs))
+        self.procs.append(proc)
+        host.procs.append(proc)
+        self._push(spec.start_ns, lambda p=proc: self._start_proc(p))
+        return proc
+
+    # --- event machinery --------------------------------------------------
+
+    def _push(self, t: int, fn: Callable[[], None]) -> None:
+        heapq.heappush(self.events, (t, self._seq, fn))
+        self._seq += 1
+
+    def run(self, until_ns: int) -> None:
+        try:
+            while self.events:
+                t, _, fn = heapq.heappop(self.events)
+                if t > until_ns:
+                    heapq.heappush(self.events, (t, 0, fn))
+                    break
+                self.now = max(self.now, t)
+                fn()
+        finally:
+            self.shutdown_check()
+
+    def shutdown(self) -> None:
+        for p in self.procs:
+            p.kill()
+
+    def shutdown_check(self) -> None:
+        """Reap naturally-exited children (expected_final_state,
+        reference configuration.rs:582 + worker.rs:485-487)."""
+        for p in self.procs:
+            if p.state == "exited" and p.popen is not None:
+                p.exit_code = p.popen.wait()
+
+    # --- process driving --------------------------------------------------
+
+    def _start_proc(self, proc: ManagedProcess) -> None:
+        proc.spawn(self.now)
+        self.event_log.append((self.now, f"start {proc.host.name} vpid={proc.vpid}"))
+        # reply START_RES: a[0] = virtual pid
+        proc.ipc.set_time(SIM_START_UNIX_NS + self.now, 0)
+        proc.ipc.send_to_shim(I.make_msg(I.MSG_START_RES, a=(proc.vpid,)))
+        self._service(proc)
+
+    def _service(self, proc: ManagedProcess) -> None:
+        """Run the process until it blocks or exits, emulating each syscall
+        (the ManagedThread::resume loop, managed_thread.rs:156-267)."""
+        while True:
+            msg = proc._recv()
+            if msg is None:
+                proc.state = "exited"
+                self.event_log.append((proc.now, f"exit-native {proc.host.name}/{proc.vpid}"))
+                return
+            if msg.kind == I.MSG_PROC_EXIT:
+                proc._reply(0)
+                proc.state = "exited"
+                self.event_log.append((proc.now, f"exit {proc.host.name}/{proc.vpid}"))
+                return
+            if msg.kind != I.MSG_SYSCALL:
+                raise SimPanic(f"unexpected msg kind {msg.kind}")
+            if not self._syscall(proc, msg):
+                proc.state = "blocked"
+                return  # reply deferred to a later event
+
+    def _syscall(self, proc: ManagedProcess, msg: I.ShimMsg) -> bool:
+        """Emulate one syscall; returns False if the reply is deferred
+        (blocking). Mirrors the dispatch seam syscall_handler.c:229-463."""
+        code = msg.a[0]
+        # fold shim-accumulated local latency, then charge the syscall cost
+        proc.now += int(msg.a[4]) + self.syscall_latency_ns
+        host = proc.host
+        name = I.VSYS_NAMES.get(code, str(code))
+        proc.syscall_log.append((proc.now, name, tuple(int(x) for x in msg.a[1:4])))
+
+        if code == I.VSYS_YIELD:
+            proc._reply(0)
+            return True
+
+        if code == I.VSYS_CLOCK_GETTIME:
+            proc._reply(0, a=(0, SIM_START_UNIX_NS + proc.now))
+            return True
+
+        if code == I.VSYS_GETPID:
+            proc._reply(proc.vpid)
+            return True
+
+        if code == I.VSYS_NANOSLEEP:
+            wake_at = proc.now + int(msg.a[1])
+            self._push(wake_at, lambda p=proc, t=wake_at: self._wake_sleep(p, t))
+            return False
+
+        if code == I.VSYS_SOCKET:
+            fd = proc.next_fd
+            proc.next_fd += 1
+            proc.sockets[fd] = UdpSocket(fd=fd)
+            proc._reply(fd)
+            return True
+
+        sock = proc.sockets.get(int(msg.a[1]))
+        if sock is None:
+            proc._reply(-9)  # EBADF
+            return True
+
+        if code == I.VSYS_BIND:
+            port = int(msg.a[3]) or host.alloc_port()
+            if port in host.ports:
+                proc._reply(-98)  # EADDRINUSE
+                return True
+            host.ports[port] = (proc, sock.fd)
+            sock.bound_port = port
+            proc._reply(0)
+            return True
+
+        if code == I.VSYS_CONNECT:
+            sock.peer = (int(msg.a[2]), int(msg.a[3]))
+            proc._reply(0)
+            return True
+
+        if code == I.VSYS_GETSOCKNAME:
+            proc._reply(0, a=(0, 0, host.ip, sock.bound_port))
+            return True
+
+        if code == I.VSYS_SENDTO:
+            ip, port = int(msg.a[2]), int(msg.a[3])
+            if ip == -1:  # send() on a connected socket
+                if sock.peer is None:
+                    proc._reply(-89)  # EDESTADDRREQ
+                    return True
+                ip, port = sock.peer
+            data = I.msg_payload(msg)
+            if sock.bound_port == 0:  # implicit bind on first send
+                sock.bound_port = host.alloc_port()
+                host.ports[sock.bound_port] = (proc, sock.fd)
+            self._send_packet(host, proc.now, ip, port, host.ip, sock.bound_port, data)
+            proc._reply(len(data))
+            return True
+
+        if code == I.VSYS_RECVFROM:
+            if sock.recvq:
+                data, sip, sport = sock.recvq.popleft()
+                proc._reply(len(data), a=(0, 0, sip, sport), buf=data)
+                return True
+            if int(msg.a[2]):  # MSG_DONTWAIT
+                proc._reply(-11)  # EAGAIN
+                return True
+            sock.blocked = True
+            return False
+
+        if code == I.VSYS_CLOSE:
+            if sock.bound_port and host.ports.get(sock.bound_port, (None, None))[0] is proc:
+                del host.ports[sock.bound_port]
+            del proc.sockets[sock.fd]
+            proc._reply(0)
+            return True
+
+        if code == I.VSYS_EXIT:
+            proc._reply(0)
+            return True
+
+        proc._reply(-38)  # ENOSYS
+        return True
+
+    def _wake_sleep(self, proc: ManagedProcess, t: int) -> None:
+        proc.now = max(proc.now, t)
+        proc.state = "running"
+        proc._reply(0)
+        self._service(proc)
+
+    # --- the data plane (Worker::send_packet, worker.rs:328-413) ---------
+
+    def _send_packet(
+        self, src: HostKernel, t: int, dst_ip: int, dst_port: int,
+        src_ip: int, src_port: int, data: bytes,
+    ) -> None:
+        dst = self.host_by_ip.get(dst_ip)
+        u = self._loss_draw(src)  # drawn even for unroutable, like the engine
+        if dst is None:
+            return  # no such host: UDP silently drops
+        lat = int(self.lat[src.node, dst.node])
+        relv = float(self.rel[src.node, dst.node])
+        if lat >= TIME_MAX:
+            return
+        if not (u < relv):
+            src.packets_dropped += 1
+            self.event_log.append((t, f"drop {src.name}->{dst.name}:{dst_port}"))
+            return
+        src.packets_sent += 1
+        deliver = t + lat
+        self._push(
+            deliver,
+            lambda: self._deliver(dst, dst_port, data, src_ip, src_port),
+        )
+
+    def _deliver(
+        self, dst: HostKernel, port: int, data: bytes, src_ip: int, src_port: int
+    ) -> None:
+        entry = dst.ports.get(port)
+        self.event_log.append((self.now, f"deliver {dst.name}:{port} {len(data)}B"))
+        if entry is None:
+            return  # nobody bound: drop (no ICMP in v1)
+        proc, fd = entry
+        sock = proc.sockets.get(fd)
+        if sock is None:
+            return
+        sock.recvq.append((data, src_ip, src_port))
+        if sock.blocked:
+            sock.blocked = False
+            data2, sip, sport = sock.recvq.popleft()
+            proc.now = max(proc.now, self.now)
+            proc.state = "running"
+            proc._reply(len(data2), a=(0, 0, sip, sport), buf=data2)
+            self._service(proc)

@@ -827,6 +827,11 @@ def tcp_handle(
         new_rtt_ts = jnp.where(start_rtt, now, new_rtt_ts)
 
         cursor = cursor + jnp.where(send_data, dlen, 0) + send_fin
+        if i == 0:
+            # fast retransmit / NewReno hole repair resends ONLY the hole
+            # (one segment per RTT, tcp_cong_reno.c); subsequent lanes jump
+            # back to the new-data frontier
+            cursor = jnp.where(is_first_rtx, jnp.maximum(cursor, o.snd_nxt), cursor)
         fin_goes = fin_goes | send_fin
         sent_any = sent_any | lane_used
 

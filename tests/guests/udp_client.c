@@ -1,0 +1,53 @@
+/* Guest test program: UDP client. Usage: udp_client <ip> <port> <n> <gap_ms>
+ * Sends n datagrams, waits for each echo, prints simulated-clock RTTs. */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+static long long now_ns(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    return (long long)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+int main(int argc, char **argv) {
+    if (argc < 5)
+        return 2;
+    int port = atoi(argv[2]);
+    int n = atoi(argv[3]);
+    int gap_ms = atoi(argv[4]);
+    int fd = socket(AF_INET, SOCK_DGRAM, 0);
+    if (fd < 0)
+        return 3;
+    struct sockaddr_in dst;
+    memset(&dst, 0, sizeof(dst));
+    dst.sin_family = AF_INET;
+    dst.sin_port = htons((unsigned short)port);
+    if (inet_pton(AF_INET, argv[1], &dst.sin_addr) != 1)
+        return 4;
+    char msg[256], buf[4096];
+    for (int i = 0; i < n; i++) {
+        int len = snprintf(msg, sizeof(msg), "ping-%d", i);
+        long long t0 = now_ns();
+        sendto(fd, msg, (size_t)len, 0, (struct sockaddr *)&dst, sizeof(dst));
+        ssize_t r = recvfrom(fd, buf, sizeof(buf) - 1, 0, NULL, NULL);
+        long long t1 = now_ns();
+        if (r < 0)
+            return 5;
+        buf[r] = 0;
+        printf("rtt %d %lld ns reply=%s\n", i, t1 - t0, buf);
+        if (gap_ms > 0) {
+            struct timespec ts = {gap_ms / 1000,
+                                  (long)(gap_ms % 1000) * 1000000L};
+            nanosleep(&ts, NULL);
+        }
+    }
+    close(fd);
+    printf("client done t=%lld\n", now_ns());
+    return 0;
+}

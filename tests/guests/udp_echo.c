@@ -1,0 +1,45 @@
+/* Guest test program: UDP echo server. Usage: udp_echo <port> <n_echoes>
+ * The managed-process analogue of the reference's paired socket tests
+ * (reference: src/test/socket_utils.rs patterns). Runs under the shim. */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+    if (argc < 3)
+        return 2;
+    int port = atoi(argv[1]);
+    int n = atoi(argv[2]);
+    int fd = socket(AF_INET, SOCK_DGRAM, 0);
+    if (fd < 0)
+        return 3;
+    struct sockaddr_in addr;
+    memset(&addr, 0, sizeof(addr));
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons((unsigned short)port);
+    if (bind(fd, (struct sockaddr *)&addr, sizeof(addr)) != 0)
+        return 4;
+    char buf[4096];
+    for (int i = 0; i < n; i++) {
+        struct sockaddr_in src;
+        socklen_t slen = sizeof(src);
+        ssize_t r = recvfrom(fd, buf, sizeof(buf), 0, (struct sockaddr *)&src,
+                             &slen);
+        if (r < 0)
+            return 5;
+        struct timespec ts;
+        clock_gettime(CLOCK_REALTIME, &ts);
+        printf("echo %d len=%zd t=%lld.%09ld\n", i, r, (long long)ts.tv_sec,
+               ts.tv_nsec);
+        sendto(fd, buf, (size_t)r, 0, (struct sockaddr *)&src, slen);
+    }
+    close(fd);
+    printf("server done pid=%d\n", (int)getpid());
+    return 0;
+}

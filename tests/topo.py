@@ -1,0 +1,21 @@
+"""Shared test topologies (used by the TCP and hostk suites)."""
+
+from shadow_tpu.graph import NetworkGraph
+
+
+def two_node_graph(latency_ms=10, loss=0.0) -> NetworkGraph:
+    """Two graph nodes with 1 ms self-loops and one lossy inter-node edge."""
+    return NetworkGraph.from_gml(
+        "\n".join(
+            [
+                "graph [",
+                "  directed 0",
+                "  node [ id 0 ]",
+                "  node [ id 1 ]",
+                '  edge [ source 0 target 0 latency "1 ms" ]',
+                '  edge [ source 1 target 1 latency "1 ms" ]',
+                f'  edge [ source 0 target 1 latency "{latency_ms} ms" packet_loss {loss} ]',
+                "]",
+            ]
+        )
+    )
