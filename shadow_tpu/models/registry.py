@@ -44,9 +44,34 @@ def _build_phold(num_hosts: int, args: dict) -> PholdModel:
     return PholdModel(num_hosts=num_hosts, **kwargs)
 
 
+def _build_tgen(num_hosts: int, args: dict):
+    from shadow_tpu.models.tgen import TgenModel
+
+    # when only one side is given, the other takes the remaining hosts
+    if "clients" in args:
+        clients = int(args["clients"])
+        servers = int(args.get("servers", num_hosts - clients))
+    elif "servers" in args:
+        servers = int(args["servers"])
+        clients = num_hosts - servers
+    else:
+        clients = num_hosts // 2
+        servers = num_hosts - clients
+    kwargs = {"num_clients": clients, "num_servers": servers}
+    for k in ("req_bytes", "resp_bytes", "port"):
+        if k in args:
+            kwargs[k] = int(args[k])
+    if "pause" in args:
+        kwargs["pause_ns"] = parse_time_ns(args["pause"])
+    if "start" in args:
+        kwargs["start_ns"] = parse_time_ns(args["start"])
+    return TgenModel(num_hosts=num_hosts, **kwargs)
+
+
 _REGISTRY = {
     "phold": _build_phold,
     "bulk-tcp": _build_bulk_tcp,  # iperf-like bulk transfer over the TCP stack
+    "tgen": _build_tgen,  # repeated request/response streams (src/test/tgen/)
 }
 
 

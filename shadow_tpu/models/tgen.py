@@ -1,0 +1,205 @@
+"""tgen-style traffic generator: repeated request/response TCP streams.
+
+The reference's benchmark workloads are tgen client/server matrices
+(reference: src/test/tgen/ — clients repeatedly fetch fixed-size
+transfers from servers over TCP, with pauses between streams; also the
+driver's primary metric per BASELINE.md). Rebuilt as a scripted device
+model over the vectorized TCP stack:
+
+  hosts [0, C)        clients — each runs an endless stream loop:
+                      connect (fresh local port) -> send `req_bytes`
+                      request -> read `resp_bytes` response -> server
+                      closes -> client closes back -> CLOSED -> pause ->
+                      next stream (server chosen round-robin)
+  hosts [C, C+S)      servers — listen; when a child connection has
+                      received the full request, write the response and
+                      close (HTTP/1.0 style: the server is the first
+                      closer, so TIMEWAIT parks on server slots, and
+                      clients recycle their slots immediately)
+
+"Request fully received" and "response already written" are derived from
+TCP state itself (delivered >= req_bytes, snd_end == 1), so the model
+adds no per-connection state of its own. Stream scheduling is
+deterministic (round-robin servers, fixed pause), so the model consumes
+no RNG draws; all variability comes from the network (loss, shaping).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from shadow_tpu.engine.state import EngineConfig, LocalEmits, PacketEmits
+from shadow_tpu.equeue import PAYLOAD_LANES
+from shadow_tpu.events import KIND_PACKET
+from shadow_tpu.simtime import NS_PER_MS, NS_PER_SEC
+from shadow_tpu.transport import tcp
+from shadow_tpu.transport.tcp import (
+    KIND_TCP_FLUSH,
+    TCP_KIND_USER_BASE,
+    TcpParams,
+    TcpState,
+)
+
+KIND_STREAM_START = TCP_KIND_USER_BASE
+
+# servers are the first closer; short 2MSL keeps their slots recyclable
+# (the tcp_tw_reuse-style divergence is deliberate and noted here)
+TGEN_TCP = TcpParams(num_sockets=4, timewait_ns=1 * NS_PER_SEC)
+
+
+@flax.struct.dataclass
+class TgenState:
+    tcp: TcpState
+    streams_started: jax.Array  # [H] i64 (client)
+    streams_done: jax.Array  # [H] i64 (client: stream fully closed)
+    bytes_down: jax.Array  # [H] i64 (client: response bytes consumed)
+    resets: jax.Array  # [H] i64
+
+
+@dataclasses.dataclass(frozen=True)
+class TgenModel:
+    num_hosts: int
+    num_clients: int
+    num_servers: int
+    req_bytes: int = 64
+    resp_bytes: int = 100_000
+    pause_ns: int = 500 * NS_PER_MS
+    port: int = 80
+    start_ns: int = 1 * NS_PER_MS
+    tcp_params: TcpParams = TGEN_TCP
+
+    DRAWS_PER_EVENT = 0
+    BOOTSTRAP_DRAWS = 0
+
+    @property
+    def LOCAL_EMITS(self):  # noqa: N802
+        return self.tcp_params.local_lanes + 2  # + model flush + next-stream
+
+    @property
+    def PACKET_EMITS(self):  # noqa: N802
+        return self.tcp_params.packet_lanes
+
+    def __post_init__(self):
+        if self.num_clients + self.num_servers > self.num_hosts:
+            raise ValueError("need num_hosts >= num_clients + num_servers")
+
+    def _roles(self, host_id):
+        is_client = host_id < self.num_clients
+        is_server = (host_id >= self.num_clients) & (
+            host_id < self.num_clients + self.num_servers
+        )
+        return is_client, is_server
+
+    def init(self) -> TgenState:
+        h = self.num_hosts
+        ts = tcp.create(h, self.tcp_params)
+        host_id = jnp.arange(h, dtype=jnp.int32)
+        _, is_server = self._roles(host_id)
+        ts = tcp.listen(
+            ts,
+            is_server,
+            jnp.zeros((h,), jnp.int32),
+            jnp.full((h,), self.port, jnp.int32),
+        )
+        z = jnp.zeros((h,), jnp.int64)
+        return TgenState(
+            tcp=ts, streams_started=z, streams_done=z, bytes_down=z, resets=z
+        )
+
+    def bootstrap(self, draw, host_id) -> LocalEmits:
+        h = host_id.shape[0]
+        is_client, _ = self._roles(host_id)
+        return LocalEmits(
+            valid=is_client[:, None],
+            time=jnp.full((h, 1), self.start_ns, jnp.int64),
+            kind=jnp.full((h, 1), KIND_STREAM_START, jnp.int32),
+            data=jnp.zeros((h, 1, PAYLOAD_LANES), jnp.int32),
+        )
+
+    def handle(self, state: TgenState, ev, draw, cfg: EngineConfig, host_id):
+        h = host_id.shape[0]
+        p = self.tcp_params
+        ts = state.tcp
+        is_client, is_server = self._roles(host_id)
+
+        # --- client: start the next stream on a free (CLOSED) slot -------
+        m_start = ev.valid & (ev.kind == KIND_STREAM_START) & is_client
+        free = ts.st == tcp.CLOSED
+        cslot = jnp.argmax(free, axis=1).astype(jnp.int32)
+        can = m_start & jnp.any(free, axis=1)
+        # fresh local port per stream: the server's previous child for this
+        # (ip, port) pair may still be in TIMEWAIT
+        lport = (40_000 + (state.streams_started % 20_000)).astype(jnp.int32)
+        server = (
+            self.num_clients
+            + (host_id.astype(jnp.int64) + state.streams_started) % self.num_servers
+        ).astype(jnp.int32)
+        ts = tcp.connect(
+            ts, can, cslot, lport, server, jnp.full((h,), self.port, jnp.int32), p
+        )
+        ts = tcp.app_write(ts, can, cslot, jnp.int64(self.req_bytes))
+        state = state.replace(streams_started=state.streams_started + can)
+
+        is_tcp_packet = ev.valid & (ev.kind == KIND_PACKET)
+        bytes_before = jnp.sum(ts.delivered, axis=1)
+        ts, emits, sig = tcp.tcp_handle(
+            ts, ev, host_id, p, is_tcp_packet, app_slot=cslot, app_mask=can
+        )
+        sslot = jnp.where(sig.slot >= 0, sig.slot, 0).astype(jnp.int32)
+        v = tcp.gather_slot(ts, sslot)
+
+        # --- server: request complete -> respond + close -----------------
+        # (snd_end == 1 <=> nothing written yet on this child)
+        m_resp = (
+            is_server
+            & (sig.slot >= 0)
+            & (v.st == tcp.ESTABLISHED)
+            & (v.delivered >= self.req_bytes)
+            & (v.snd_end == 1)
+        )
+        ts = tcp.app_write(ts, m_resp, sslot, jnp.int64(self.resp_bytes))
+        ts = tcp.app_close(ts, m_resp, sslot)
+
+        # --- client: server closed -> close back (-> LASTACK -> CLOSED) --
+        m_eof = sig.fin_seen & is_client
+        ts = tcp.app_close(ts, m_eof, sslot)
+        need_flush = m_resp | m_eof
+
+        # --- client: stream fully torn down -> schedule the next ---------
+        m_done = sig.closed & is_client
+        state = state.replace(
+            streams_done=state.streams_done + m_done,
+            bytes_down=state.bytes_down
+            + jnp.where(is_client, jnp.sum(ts.delivered, axis=1) - bytes_before, 0),
+            resets=state.resets + sig.reset,
+            tcp=ts,
+        )
+
+        el = self.LOCAL_EMITS
+        l_valid = jnp.zeros((h, el), bool)
+        l_time = jnp.zeros((h, el), jnp.int64)
+        l_kind = jnp.zeros((h, el), jnp.int32)
+        l_data = jnp.zeros((h, el, PAYLOAD_LANES), jnp.int32)
+        l_valid = l_valid.at[:, :2].set(emits.l_valid)
+        l_time = l_time.at[:, :2].set(emits.l_time)
+        l_kind = l_kind.at[:, :2].set(emits.l_kind)
+        l_data = l_data.at[:, :2, :].set(emits.l_data)
+        l_valid = l_valid.at[:, 2].set(need_flush)
+        l_time = l_time.at[:, 2].set(ev.time)
+        l_kind = l_kind.at[:, 2].set(KIND_TCP_FLUSH)
+        l_data = l_data.at[:, 2, 0].set(sslot)
+        # next stream after the pause; a start that found no free slot
+        # (all in teardown) retries after the same pause
+        l_valid = l_valid.at[:, 3].set(m_done | (m_start & ~can))
+        l_time = l_time.at[:, 3].set(ev.time + self.pause_ns)
+        l_kind = l_kind.at[:, 3].set(KIND_STREAM_START)
+
+        lemits = LocalEmits(valid=l_valid, time=l_time, kind=l_kind, data=l_data)
+        pemits = PacketEmits(
+            valid=emits.p_valid, dst=emits.p_dst, data=emits.p_data, size=emits.p_size
+        )
+        return state, lemits, pemits

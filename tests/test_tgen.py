@@ -1,0 +1,71 @@
+"""tgen traffic-generator model tests: repeated request/response streams
+over the TCP stack (the reference's tgen matrix workloads, src/test/tgen/)."""
+
+import numpy as np
+import pytest
+
+from shadow_tpu.engine import EngineConfig, init_state
+from shadow_tpu.engine.round import bootstrap, run_until
+from shadow_tpu.graph import compute_routing
+from shadow_tpu.models.tgen import TgenModel
+from shadow_tpu.simtime import NS_PER_MS, NS_PER_SEC
+from tests.topo import two_node_graph
+
+
+def _setup(clients=2, servers=2, resp=20_000, pause_ms=100, loss=0.0, seed=3):
+    num_hosts = clients + servers
+    graph = two_node_graph(latency_ms=10, loss=loss)
+    host_node = [0] * clients + [1] * servers
+    tables = compute_routing(graph).with_hosts(host_node)
+    cfg = EngineConfig(
+        num_hosts=num_hosts,
+        queue_capacity=512,
+        outbox_capacity=128,
+        runahead_ns=graph.min_latency_ns(),
+        seed=seed,
+    )
+    model = TgenModel(
+        num_hosts=num_hosts,
+        num_clients=clients,
+        num_servers=servers,
+        resp_bytes=resp,
+        pause_ns=pause_ms * NS_PER_MS,
+    )
+    st = bootstrap(init_state(cfg, model.init()), model, cfg)
+    return cfg, model, tables, st
+
+
+@pytest.mark.parametrize("loss", [0.0, 0.02])
+def test_streams_cycle(loss):
+    clients, resp = 2, 20_000
+    cfg, model, tables, st = _setup(clients=clients, resp=resp, loss=loss)
+    st = run_until(st, 10 * NS_PER_SEC, model, tables, cfg, rounds_per_chunk=64, max_chunks=50_000)
+
+    done = np.asarray(st.model.streams_done)[:clients]
+    down = np.asarray(st.model.bytes_down)[:clients]
+    # each client cycles multiple streams in 10 s of sim time
+    assert (done >= 3).all(), done
+    # every completed stream delivered the full response
+    assert (down >= done * resp).all(), (down, done)
+    assert int(np.asarray(st.model.resets).sum()) == 0
+    assert int(st.queue.overflow.sum()) == 0
+    assert int(st.outbox.overflow.sum()) == 0
+
+
+def test_streams_deterministic():
+    cfg, model, tables, st0 = _setup(loss=0.03, seed=11)
+    a = run_until(st0, 5 * NS_PER_SEC, model, tables, cfg, rounds_per_chunk=64, max_chunks=50_000)
+    b = run_until(st0, 5 * NS_PER_SEC, model, tables, cfg, rounds_per_chunk=64, max_chunks=50_000)
+    for name in ("streams_done", "streams_started", "bytes_down"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a.model, name)), np.asarray(getattr(b.model, name))
+        )
+    np.testing.assert_array_equal(np.asarray(a.packets_sent), np.asarray(b.packets_sent))
+
+
+def test_many_to_few_servers():
+    # 6 clients share 2 servers round-robin
+    cfg, model, tables, st = _setup(clients=6, servers=2, resp=10_000, pause_ms=200)
+    st = run_until(st, 8 * NS_PER_SEC, model, tables, cfg, rounds_per_chunk=64, max_chunks=50_000)
+    done = np.asarray(st.model.streams_done)[:6]
+    assert (done >= 2).all(), done
