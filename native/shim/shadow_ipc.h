@@ -57,6 +57,30 @@ enum {
     VSYS_YIELD = 10,     /* a[1]=unapplied ns; shadow folds into host clock */
     VSYS_EXIT = 11,      /* a[1]=exit code */
     VSYS_CLOCK_GETTIME = 12, /* explicit slow-path time read */
+    VSYS_LISTEN = 13,    /* a[1]=fd a[2]=backlog */
+    VSYS_ACCEPT = 14,    /* a[1]=fd a[2]=child nonblock -> ret fd, a[2]=ip a[3]=port */
+    VSYS_SHUTDOWN = 15,  /* a[1]=fd a[2]=how */
+    VSYS_GETPEERNAME = 16, /* a[1]=fd -> a[2]=ip a[3]=port */
+    VSYS_SETSOCKOPT = 17, /* a[1]=fd a[2]=level a[3]=optname, buf=optval */
+    VSYS_GETSOCKOPT = 18, /* a[1]=fd a[2]=level a[3]=optname -> a[2]=value */
+    VSYS_FCNTL = 19,     /* a[1]=fd a[2]=cmd a[3]=arg */
+    VSYS_IOCTL = 20,     /* a[1]=fd a[2]=req -> a[2]=value */
+    VSYS_PIPE2 = 21,     /* a[1]=flags -> a[2]=rfd a[3]=wfd */
+    VSYS_READ = 22,      /* a[1]=fd a[2]=n a[3]=dontwait -> buf */
+    VSYS_WRITE = 23,     /* a[1]=fd a[3]=dontwait, buf=data */
+    VSYS_EVENTFD = 24,   /* a[1]=initval a[2]=flags -> fd */
+    VSYS_TIMERFD_CREATE = 25, /* a[1]=clockid a[2]=flags -> fd */
+    VSYS_TIMERFD_SETTIME = 26, /* a[1]=fd a[2]=flags, buf=2x i64 (value,interval) -> a[2],a[3]=old */
+    VSYS_TIMERFD_GETTIME = 27, /* a[1]=fd -> a[2]=value a[3]=interval */
+    VSYS_EPOLL_CREATE = 28, /* -> fd */
+    VSYS_EPOLL_CTL = 29, /* a[1]=epfd a[2]=op a[3]=fd, buf=packed epoll_event */
+    VSYS_EPOLL_WAIT = 30, /* a[1]=epfd a[2]=maxevents a[3]=timeout ns -> buf events */
+    VSYS_POLL = 31,      /* a[1]=nfds a[2]=timeout ns, buf=pollfd[] -> buf updated */
+    VSYS_GETHOSTNAME = 32, /* -> buf */
+    VSYS_UNAME = 33,     /* -> buf nodename */
+    VSYS_RESOLVE = 34,   /* buf=name -> a[2]=ip */
+    VSYS_GETRANDOM = 35, /* a[1]=n -> buf */
+    VSYS_DUP = 36,       /* a[1]=fd -> new fd */
 };
 
 typedef struct {

@@ -31,6 +31,7 @@
 #include <errno.h>
 #include <fcntl.h>
 #include <netinet/in.h>
+#include <poll.h>
 #include <stddef.h>
 #include <stdio.h>
 #include <stdlib.h>
@@ -39,6 +40,7 @@
 #include <sys/socket.h>
 #include <sys/stat.h>
 #include <sys/syscall.h>
+#include <sys/utsname.h>
 #include <time.h>
 #include <unistd.h>
 
@@ -251,10 +253,13 @@ static void parts_to_addr(int64_t ip, int64_t port, struct sockaddr *addr,
 }
 
 int socket(int domain, int type, int protocol) {
+    int base = type & 0xFF;
     if (!g_active || domain != AF_INET ||
-        (type & 0xFF) != SOCK_DGRAM)
+        (base != SOCK_DGRAM && base != SOCK_STREAM))
         return (int)syscall(SYS_socket, domain, type, protocol);
-    int64_t r = vsys(VSYS_SOCKET, domain, type, protocol, NULL, 0, NULL);
+    /* forward base type + the SOCK_NONBLOCK bit (== O_NONBLOCK) */
+    int64_t vtype = base | (type & SOCK_NONBLOCK ? 0x800 : 0);
+    int64_t r = vsys(VSYS_SOCKET, domain, vtype, protocol, NULL, 0, NULL);
     if (r < 0) {
         errno = (int)-r;
         return -1;
@@ -320,8 +325,8 @@ ssize_t recvfrom(int fd, void *buf, size_t n, int flags,
     if (!g_active || !is_vfd(fd))
         return syscall(SYS_recvfrom, fd, buf, n, flags, addr, len);
     ShimMsg reply;
-    int64_t r = vsys(VSYS_RECVFROM, fd, (int64_t)(flags & MSG_DONTWAIT), 0,
-                     NULL, 0, &reply);
+    int64_t r = vsys(VSYS_RECVFROM, fd, (int64_t)(flags & MSG_DONTWAIT) != 0,
+                     (int64_t)n, NULL, 0, &reply);
     if (r < 0) {
         errno = (int)-r;
         return -1;
@@ -361,4 +366,580 @@ int close(int fd) {
         return -1;
     }
     return 0;
+}
+
+/* ---- TCP socket API (kernel side: hostk/tcp.py state machine) ---- */
+
+int listen(int fd, int backlog) {
+    if (!g_active || !is_vfd(fd))
+        return (int)syscall(SYS_listen, fd, backlog);
+    int64_t r = vsys(VSYS_LISTEN, fd, backlog, 0, NULL, 0, NULL);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    return 0;
+}
+
+int accept4(int fd, struct sockaddr *addr, socklen_t *len, int flags) {
+    if (!g_active || !is_vfd(fd))
+        return (int)syscall(SYS_accept4, fd, addr, len, flags);
+    ShimMsg reply;
+    int64_t r = vsys(VSYS_ACCEPT, fd, (flags & SOCK_NONBLOCK) ? 1 : 0, 0, NULL,
+                     0, &reply);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    if (addr && len)
+        parts_to_addr(reply.a[2], reply.a[3], addr, len);
+    return (int)r;
+}
+
+int accept(int fd, struct sockaddr *addr, socklen_t *len) {
+    return accept4(fd, addr, len, 0);
+}
+
+int shutdown(int fd, int how) {
+    if (!g_active || !is_vfd(fd))
+        return (int)syscall(SYS_shutdown, fd, how);
+    int64_t r = vsys(VSYS_SHUTDOWN, fd, how, 0, NULL, 0, NULL);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    return 0;
+}
+
+int getpeername(int fd, struct sockaddr *addr, socklen_t *len) {
+    if (!g_active || !is_vfd(fd))
+        return (int)syscall(SYS_getpeername, fd, addr, len);
+    ShimMsg reply;
+    int64_t r = vsys(VSYS_GETPEERNAME, fd, 0, 0, NULL, 0, &reply);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    parts_to_addr(reply.a[2], reply.a[3], addr, len);
+    return 0;
+}
+
+int setsockopt(int fd, int level, int optname, const void *optval,
+               socklen_t optlen) {
+    if (!g_active || !is_vfd(fd))
+        return (int)syscall(SYS_setsockopt, fd, level, optname, optval, optlen);
+    int64_t r = vsys(VSYS_SETSOCKOPT, fd, level, optname, optval, optlen, NULL);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    return 0;
+}
+
+int getsockopt(int fd, int level, int optname, void *optval, socklen_t *optlen) {
+    if (!g_active || !is_vfd(fd))
+        return (int)syscall(SYS_getsockopt, fd, level, optname, optval, optlen);
+    ShimMsg reply;
+    int64_t r = vsys(VSYS_GETSOCKOPT, fd, level, optname, NULL, 0, &reply);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    if (optval && optlen && *optlen >= (socklen_t)sizeof(int)) {
+        *(int *)optval = (int)reply.a[2];
+        *optlen = sizeof(int);
+    }
+    return 0;
+}
+
+/* ---- generic fd ops ---- */
+
+#include <stdarg.h>
+
+int fcntl(int fd, int cmd, ...) {
+    va_list ap;
+    va_start(ap, cmd);
+    long arg = va_arg(ap, long);
+    va_end(ap);
+    if (!g_active || !is_vfd(fd))
+        return (int)syscall(SYS_fcntl, fd, cmd, arg);
+    int64_t r = vsys(VSYS_FCNTL, fd, cmd, arg, NULL, 0, NULL);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    return (int)r;
+}
+
+int ioctl(int fd, unsigned long req, ...) {
+    va_list ap;
+    va_start(ap, req);
+    void *argp = va_arg(ap, void *);
+    va_end(ap);
+    if (!g_active || !is_vfd(fd))
+        return (int)syscall(SYS_ioctl, fd, req, argp);
+    ShimMsg reply;
+    int64_t r = vsys(VSYS_IOCTL, fd, (int64_t)req, 0, NULL, 0, &reply);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    if (argp)
+        *(int *)argp = (int)reply.a[2];
+    return 0;
+}
+
+ssize_t read(int fd, void *buf, size_t n) {
+    if (!g_active || !is_vfd(fd))
+        return syscall(SYS_read, fd, buf, n);
+    ShimMsg reply;
+    int64_t r = vsys(VSYS_READ, fd, (int64_t)n, 0, NULL, 0, &reply);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    size_t cp = (size_t)r < n ? (size_t)r : n;
+    if (cp > reply.buf_len)
+        cp = reply.buf_len;
+    memcpy(buf, reply.buf, cp);
+    return (ssize_t)cp;
+}
+
+ssize_t write(int fd, const void *buf, size_t n) {
+    if (!g_active || !is_vfd(fd))
+        return syscall(SYS_write, fd, buf, n);
+    int64_t r = vsys(VSYS_WRITE, fd, 0, 0, buf, (uint32_t)n, NULL);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    return (ssize_t)r;
+}
+
+int pipe2(int fds[2], int flags) {
+    if (!g_active)
+        return (int)syscall(SYS_pipe2, fds, flags);
+    ShimMsg reply;
+    int64_t r = vsys(VSYS_PIPE2, flags, 0, 0, NULL, 0, &reply);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    fds[0] = (int)reply.a[1];
+    fds[1] = (int)reply.a[2];
+    return 0;
+}
+
+int pipe(int fds[2]) {
+    if (!g_active)
+        return (int)syscall(SYS_pipe2, fds, 0);
+    return pipe2(fds, 0);
+}
+
+int dup(int fd) {
+    if (!g_active || !is_vfd(fd))
+        return (int)syscall(SYS_dup, fd);
+    int64_t r = vsys(VSYS_DUP, fd, 0, 0, NULL, 0, NULL);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    return (int)r;
+}
+
+/* ---- eventfd / timerfd ---- */
+
+int eventfd(unsigned int initval, int flags) {
+    if (!g_active)
+        return (int)syscall(SYS_eventfd2, initval, flags);
+    int64_t r = vsys(VSYS_EVENTFD, initval, flags, 0, NULL, 0, NULL);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    return (int)r;
+}
+
+struct itimerspec; /* avoid including sys/timerfd.h (conflicts are possible
+                      with older glibc headers); layout is 4x time fields */
+
+int timerfd_create(int clockid, int flags) {
+    if (!g_active)
+        return (int)syscall(SYS_timerfd_create, clockid, flags);
+    int64_t r = vsys(VSYS_TIMERFD_CREATE, clockid, flags, 0, NULL, 0, NULL);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    return (int)r;
+}
+
+int timerfd_settime(int fd, int flags, const void *new_value, void *old_value) {
+    if (!g_active || !is_vfd(fd))
+        return (int)syscall(SYS_timerfd_settime, fd, flags, new_value,
+                            old_value);
+    /* struct itimerspec = { it_interval (timespec), it_value (timespec) } */
+    const struct timespec *ts = (const struct timespec *)new_value;
+    int64_t interval_ns = (int64_t)ts[0].tv_sec * 1000000000LL + ts[0].tv_nsec;
+    int64_t value_ns = (int64_t)ts[1].tv_sec * 1000000000LL + ts[1].tv_nsec;
+    int64_t payload[2] = {value_ns, interval_ns};
+    ShimMsg reply;
+    int64_t r = vsys(VSYS_TIMERFD_SETTIME, fd, flags, 0, payload,
+                     sizeof(payload), &reply);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    if (old_value) {
+        struct timespec *old = (struct timespec *)old_value;
+        old[0].tv_sec = reply.a[3] / 1000000000LL;
+        old[0].tv_nsec = reply.a[3] % 1000000000LL;
+        old[1].tv_sec = reply.a[2] / 1000000000LL;
+        old[1].tv_nsec = reply.a[2] % 1000000000LL;
+    }
+    return 0;
+}
+
+int timerfd_gettime(int fd, void *curr_value) {
+    if (!g_active || !is_vfd(fd))
+        return (int)syscall(SYS_timerfd_gettime, fd, curr_value);
+    ShimMsg reply;
+    int64_t r = vsys(VSYS_TIMERFD_GETTIME, fd, 0, 0, NULL, 0, &reply);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    struct timespec *curr = (struct timespec *)curr_value;
+    curr[0].tv_sec = reply.a[3] / 1000000000LL;
+    curr[0].tv_nsec = reply.a[3] % 1000000000LL;
+    curr[1].tv_sec = reply.a[2] / 1000000000LL;
+    curr[1].tv_nsec = reply.a[2] % 1000000000LL;
+    return 0;
+}
+
+/* ---- epoll ---- */
+
+struct shim_epoll_event { /* packed x86-64 epoll_event layout */
+    uint32_t events;
+    uint64_t data;
+} __attribute__((packed));
+
+int epoll_create1(int flags) {
+    if (!g_active)
+        return (int)syscall(SYS_epoll_create1, flags);
+    int64_t r = vsys(VSYS_EPOLL_CREATE, flags, 0, 0, NULL, 0, NULL);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    return (int)r;
+}
+
+int epoll_create(int size) {
+    (void)size;
+    if (!g_active)
+        return (int)syscall(SYS_epoll_create1, 0);
+    return epoll_create1(0);
+}
+
+int epoll_ctl(int epfd, int op, int fd, void *event) {
+    if (!g_active || !is_vfd(epfd))
+        return (int)syscall(SYS_epoll_ctl, epfd, op, fd, event);
+    struct shim_epoll_event ev = {0, 0};
+    if (event)
+        memcpy(&ev, event, sizeof(ev));
+    int64_t r = vsys(VSYS_EPOLL_CTL, epfd, op, fd, &ev, sizeof(ev), NULL);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    return 0;
+}
+
+int epoll_wait(int epfd, void *events, int maxevents, int timeout) {
+    if (!g_active || !is_vfd(epfd))
+        return (int)syscall(SYS_epoll_wait, epfd, events, maxevents, timeout);
+    int64_t timeout_ns = timeout < 0 ? -1 : (int64_t)timeout * 1000000LL;
+    ShimMsg reply;
+    int64_t r =
+        vsys(VSYS_EPOLL_WAIT, epfd, maxevents, timeout_ns, NULL, 0, &reply);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    size_t n = (size_t)r * sizeof(struct shim_epoll_event);
+    if (n > reply.buf_len)
+        n = reply.buf_len;
+    memcpy(events, reply.buf, n);
+    return (int)r;
+}
+
+int epoll_pwait(int epfd, void *events, int maxevents, int timeout,
+                const void *sigmask) {
+    (void)sigmask;
+    return epoll_wait(epfd, events, maxevents, timeout);
+}
+
+/* ---- poll / select ---- */
+
+struct shim_pollfd {
+    int fd;
+    short events;
+    short revents;
+};
+
+static int any_vfd(const struct shim_pollfd *fds, unsigned long n) {
+    for (unsigned long i = 0; i < n; i++)
+        if (is_vfd(fds[i].fd))
+            return 1;
+    return 0;
+}
+
+static int shim_poll_ns(struct shim_pollfd *fds, unsigned long nfds,
+                        int64_t timeout_ns) {
+    ShimMsg reply;
+    int64_t r = vsys(VSYS_POLL, (int64_t)nfds, timeout_ns, 0, fds,
+                     (uint32_t)(nfds * sizeof(struct shim_pollfd)), &reply);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    size_t n = nfds * sizeof(struct shim_pollfd);
+    if (n > reply.buf_len)
+        n = reply.buf_len;
+    memcpy(fds, reply.buf, n);
+    return (int)r;
+}
+
+int poll(struct pollfd *fds, nfds_t nfds, int timeout) {
+    if (g_active && nfds == 0 && timeout >= 0) {
+        /* pure-timeout poll is a sleep idiom: advance sim time, not wall */
+        struct timespec ts = {timeout / 1000, (long)(timeout % 1000) * 1000000L};
+        nanosleep(&ts, NULL);
+        return 0;
+    }
+    if (!g_active || !any_vfd((struct shim_pollfd *)fds, nfds))
+        return (int)syscall(SYS_poll, fds, nfds, timeout);
+    /* any vfd in the set: route through the kernel so sim time advances
+     * (native fds in a mixed set are treated as never-ready) */
+    int64_t timeout_ns = timeout < 0 ? -1 : (int64_t)timeout * 1000000LL;
+    return shim_poll_ns((struct shim_pollfd *)fds, nfds, timeout_ns);
+}
+
+int ppoll(struct pollfd *fds, nfds_t nfds, const struct timespec *tmo,
+          const sigset_t *sigmask) {
+    (void)sigmask;
+    if (!g_active || !any_vfd((struct shim_pollfd *)fds, nfds))
+        return (int)syscall(SYS_ppoll, fds, nfds, tmo, NULL, 0);
+    int64_t timeout_ns =
+        tmo ? (int64_t)tmo->tv_sec * 1000000000LL + tmo->tv_nsec : -1;
+    return shim_poll_ns((struct shim_pollfd *)fds, nfds, timeout_ns);
+}
+
+#include <sys/select.h>
+
+int select(int nfds, fd_set *readfds, fd_set *writefds, fd_set *exceptfds,
+           struct timeval *tv) {
+    if (!g_active)
+        return (int)syscall(SYS_select, nfds, readfds, writefds, exceptfds, tv);
+    if (nfds == 0 && tv) { /* sleep idiom: advance sim time, not wall */
+        struct timespec ts = {tv->tv_sec, tv->tv_usec * 1000L};
+        nanosleep(&ts, NULL);
+        return 0;
+    }
+    /* convert to poll over the set members (vfd sets only; a mixed set
+     * with no vfds passes through). FD_SETSIZE bounds nfds. */
+    struct shim_pollfd pfds[FD_SETSIZE];
+    int np = 0, has_v = 0;
+    if (nfds > FD_SETSIZE)
+        nfds = FD_SETSIZE;
+    for (int fd = 0; fd < nfds && np < FD_SETSIZE; fd++) {
+        short ev = 0;
+        if (readfds && FD_ISSET(fd, readfds))
+            ev |= POLLIN;
+        if (writefds && FD_ISSET(fd, writefds))
+            ev |= POLLOUT;
+        if (exceptfds && FD_ISSET(fd, exceptfds))
+            ev |= POLLPRI;
+        if (ev) {
+            pfds[np].fd = fd;
+            pfds[np].events = ev;
+            pfds[np].revents = 0;
+            if (is_vfd(fd))
+                has_v = 1;
+            np++;
+        }
+    }
+    if (!has_v)
+        return (int)syscall(SYS_select, nfds, readfds, writefds, exceptfds, tv);
+    int64_t timeout_ns =
+        tv ? (int64_t)tv->tv_sec * 1000000000LL + (int64_t)tv->tv_usec * 1000LL
+           : -1;
+    int r = shim_poll_ns(pfds, (unsigned long)np, timeout_ns);
+    if (r < 0)
+        return -1;
+    if (readfds)
+        FD_ZERO(readfds);
+    if (writefds)
+        FD_ZERO(writefds);
+    if (exceptfds)
+        FD_ZERO(exceptfds);
+    int count = 0;
+    for (int i = 0; i < np; i++) {
+        int fd = pfds[i].fd;
+        int hit = 0;
+        if (readfds && (pfds[i].revents & (POLLIN | POLLHUP | POLLERR))) {
+            FD_SET(fd, readfds);
+            hit = 1;
+        }
+        if (writefds && (pfds[i].revents & (POLLOUT | POLLERR))) {
+            FD_SET(fd, writefds);
+            hit = 1;
+        }
+        if (hit)
+            count++;
+    }
+    return count;
+}
+
+/* ---- identity / DNS ---- */
+
+int gethostname(char *name, size_t len) {
+    if (!g_active) {
+        struct utsname un;
+        if (syscall(SYS_uname, &un) != 0)
+            return -1;
+        strncpy(name, un.nodename, len);
+        if (len > 0)
+            name[len - 1] = '\0';
+        return 0;
+    }
+    ShimMsg reply;
+    int64_t r = vsys(VSYS_GETHOSTNAME, 0, 0, 0, NULL, 0, &reply);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    size_t n = reply.buf_len < len ? reply.buf_len : len;
+    memcpy(name, reply.buf, n);
+    if (n > 0)
+        name[n - 1] = '\0';
+    return 0;
+}
+
+int uname(struct utsname *buf) {
+    if (!g_active)
+        return (int)syscall(SYS_uname, buf);
+    ShimMsg reply;
+    int64_t r = vsys(VSYS_UNAME, 0, 0, 0, NULL, 0, &reply);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    memset(buf, 0, sizeof(*buf));
+    strncpy(buf->sysname, "Linux", sizeof(buf->sysname) - 1);
+    size_t n = reply.buf_len < sizeof(buf->nodename) ? reply.buf_len
+                                                     : sizeof(buf->nodename);
+    memcpy(buf->nodename, reply.buf, n);
+    buf->nodename[sizeof(buf->nodename) - 1] = '\0';
+    strncpy(buf->release, "5.15.0-shadow-tpu", sizeof(buf->release) - 1);
+    strncpy(buf->version, "#1 SMP shadow-tpu", sizeof(buf->version) - 1);
+    strncpy(buf->machine, "x86_64", sizeof(buf->machine) - 1);
+    return 0;
+}
+
+#include <netdb.h>
+
+int getaddrinfo(const char *node, const char *service,
+                const struct addrinfo *hints, struct addrinfo **res) {
+    if (!g_active) {
+        /* no simple passthrough (libc internal); fail conservatively */
+        return EAI_FAIL;
+    }
+    if (!node)
+        node = "127.0.0.1";
+    uint16_t port = 0;
+    if (service)
+        port = (uint16_t)strtoul(service, NULL, 10);
+    ShimMsg reply;
+    int64_t r = vsys(VSYS_RESOLVE, 0, 0, 0, node, (uint32_t)strlen(node) + 1,
+                     &reply);
+    if (r < 0)
+        return EAI_NONAME;
+    int socktype = hints && hints->ai_socktype ? hints->ai_socktype : SOCK_STREAM;
+    int proto = socktype == SOCK_DGRAM ? IPPROTO_UDP : IPPROTO_TCP;
+    /* one contiguous allocation: addrinfo + sockaddr_in */
+    char *blk = calloc(1, sizeof(struct addrinfo) + sizeof(struct sockaddr_in));
+    if (!blk)
+        return EAI_MEMORY;
+    struct addrinfo *ai = (struct addrinfo *)blk;
+    struct sockaddr_in *sa =
+        (struct sockaddr_in *)(blk + sizeof(struct addrinfo));
+    sa->sin_family = AF_INET;
+    sa->sin_addr.s_addr = htonl((uint32_t)reply.a[2]);
+    sa->sin_port = htons(port);
+    ai->ai_family = AF_INET;
+    ai->ai_socktype = socktype;
+    ai->ai_protocol = proto;
+    ai->ai_addrlen = sizeof(struct sockaddr_in);
+    ai->ai_addr = (struct sockaddr *)sa;
+    ai->ai_next = NULL;
+    *res = ai;
+    return 0;
+}
+
+void freeaddrinfo(struct addrinfo *res) {
+    /* our results are single contiguous blocks; real ones never reach here
+     * because getaddrinfo above handles every g_active case */
+    free(res);
+}
+
+struct hostent *gethostbyname(const char *name) {
+    static __thread struct hostent he;
+    static __thread uint32_t addr_be;
+    static __thread char *addr_list[2];
+    static __thread char hname[256];
+    if (!g_active)
+        return NULL;
+    ShimMsg reply;
+    int64_t r = vsys(VSYS_RESOLVE, 0, 0, 0, name, (uint32_t)strlen(name) + 1,
+                     &reply);
+    if (r < 0)
+        return NULL;
+    addr_be = htonl((uint32_t)reply.a[2]);
+    strncpy(hname, name, sizeof(hname) - 1);
+    hname[sizeof(hname) - 1] = '\0';
+    addr_list[0] = (char *)&addr_be;
+    addr_list[1] = NULL;
+    he.h_name = hname;
+    he.h_aliases = NULL;
+    he.h_addrtype = AF_INET;
+    he.h_length = 4;
+    he.h_addr_list = addr_list;
+    return &he;
+}
+
+/* ---- deterministic randomness (reference handler/random.rs + the
+ * openssl_preload rng override serve the same purpose) ---- */
+
+ssize_t getrandom(void *buf, size_t buflen, unsigned int flags) {
+    if (!g_active)
+        return syscall(SYS_getrandom, buf, buflen, flags);
+    if (buflen > SHIM_BUF_SIZE)
+        buflen = SHIM_BUF_SIZE;
+    ShimMsg reply;
+    int64_t r = vsys(VSYS_GETRANDOM, (int64_t)buflen, 0, 0, NULL, 0, &reply);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    size_t n = (size_t)r < buflen ? (size_t)r : buflen;
+    memcpy(buf, reply.buf, n);
+    return (ssize_t)n;
+}
+
+int getentropy(void *buf, size_t buflen) {
+    if (!g_active)
+        return (int)syscall(SYS_getrandom, buf, buflen, 0) >= 0 ? 0 : -1;
+    return getrandom(buf, buflen, 0) == (ssize_t)buflen ? 0 : -1;
 }

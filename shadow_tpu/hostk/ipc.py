@@ -25,7 +25,7 @@ MSG_SYSCALL = 3
 MSG_SYSCALL_DONE = 4
 MSG_PROC_EXIT = 5
 
-# virtual syscall codes
+# virtual syscall codes (mirrors native/shim/shadow_ipc.h)
 VSYS_NANOSLEEP = 1
 VSYS_SOCKET = 2
 VSYS_BIND = 3
@@ -38,6 +38,30 @@ VSYS_GETSOCKNAME = 9
 VSYS_YIELD = 10
 VSYS_EXIT = 11
 VSYS_CLOCK_GETTIME = 12
+VSYS_LISTEN = 13
+VSYS_ACCEPT = 14
+VSYS_SHUTDOWN = 15
+VSYS_GETPEERNAME = 16
+VSYS_SETSOCKOPT = 17
+VSYS_GETSOCKOPT = 18
+VSYS_FCNTL = 19
+VSYS_IOCTL = 20
+VSYS_PIPE2 = 21
+VSYS_READ = 22
+VSYS_WRITE = 23
+VSYS_EVENTFD = 24
+VSYS_TIMERFD_CREATE = 25
+VSYS_TIMERFD_SETTIME = 26
+VSYS_TIMERFD_GETTIME = 27
+VSYS_EPOLL_CREATE = 28
+VSYS_EPOLL_CTL = 29
+VSYS_EPOLL_WAIT = 30
+VSYS_POLL = 31
+VSYS_GETHOSTNAME = 32
+VSYS_UNAME = 33
+VSYS_RESOLVE = 34
+VSYS_GETRANDOM = 35
+VSYS_DUP = 36
 
 VSYS_NAMES = {
     VSYS_NANOSLEEP: "nanosleep",
@@ -52,6 +76,30 @@ VSYS_NAMES = {
     VSYS_YIELD: "yield",
     VSYS_EXIT: "exit",
     VSYS_CLOCK_GETTIME: "clock_gettime",
+    VSYS_LISTEN: "listen",
+    VSYS_ACCEPT: "accept",
+    VSYS_SHUTDOWN: "shutdown",
+    VSYS_GETPEERNAME: "getpeername",
+    VSYS_SETSOCKOPT: "setsockopt",
+    VSYS_GETSOCKOPT: "getsockopt",
+    VSYS_FCNTL: "fcntl",
+    VSYS_IOCTL: "ioctl",
+    VSYS_PIPE2: "pipe2",
+    VSYS_READ: "read",
+    VSYS_WRITE: "write",
+    VSYS_EVENTFD: "eventfd2",
+    VSYS_TIMERFD_CREATE: "timerfd_create",
+    VSYS_TIMERFD_SETTIME: "timerfd_settime",
+    VSYS_TIMERFD_GETTIME: "timerfd_gettime",
+    VSYS_EPOLL_CREATE: "epoll_create1",
+    VSYS_EPOLL_CTL: "epoll_ctl",
+    VSYS_EPOLL_WAIT: "epoll_wait",
+    VSYS_POLL: "poll",
+    VSYS_GETHOSTNAME: "gethostname",
+    VSYS_UNAME: "uname",
+    VSYS_RESOLVE: "getaddrinfo",
+    VSYS_GETRANDOM: "getrandom",
+    VSYS_DUP: "dup",
 }
 
 

@@ -2,10 +2,11 @@
 
 Rebuilds the reference's managed-process control plane (reference:
 src/main/host/managed_thread.rs:156-267 run-until-syscall loop;
-src/main/host/process.rs spawn/resume; src/main/host/syscall/handler/
-socket.rs + time.rs syscall emulation; src/main/core/worker.rs:328-413
-send_packet) as a serial discrete-event loop over real child processes
-parked on futex channels.
+src/main/host/process.rs spawn/resume; src/main/host/syscall/handler/*
+syscall emulation + the ~160-entry dispatch seam syscall_handler.c:229-463;
+src/main/host/syscall_condition.c blocked-syscall wakeups;
+src/main/core/worker.rs:328-413 send_packet) as a serial discrete-event
+loop over real child processes parked on futex channels.
 
 Determinism contract shared with the device engine: packet loss draws use
 the same threefry per-host counter streams (shadow_tpu/rng), latencies
@@ -29,8 +30,8 @@ import heapq
 import os
 import pathlib
 import shutil
+import struct
 import subprocess
-from collections import deque
 from typing import Callable, Optional
 
 import jax.numpy as jnp
@@ -39,24 +40,51 @@ import numpy as np
 from shadow_tpu import rng
 from shadow_tpu.graph.routing import RoutingTables
 from shadow_tpu.hostk import ipc as I
+from shadow_tpu.hostk import tcp as T
 from shadow_tpu.hostk.build import shim_lib_path
+from shadow_tpu.hostk.descriptor import (
+    EAGAIN,
+    EBADF,
+    EADDRINUSE,
+    EDESTADDRREQ,
+    EINPROGRESS,
+    EINVAL,
+    EISCONN,
+    EMSGSIZE,
+    ENOSYS,
+    ENOTCONN,
+    ENOTSOCK,
+    EPOLLIN,
+    EPOLLOUT,
+    PROTO_TCP,
+    PROTO_UDP,
+    DescriptorTable,
+    Epoll,
+    EventFd,
+    File,
+    PipeEnd,
+    TimerFd,
+    UdpSocket,
+    make_pipe,
+)
+from shadow_tpu.hostk.dns import Dns
+from shadow_tpu.hostk.strace import StraceFile
 from shadow_tpu.simtime import SIM_START_UNIX_NS, TIME_MAX
 
 EPHEMERAL_PORT_BASE = 10_000
 VFD_BASE = 1000
+LOOPBACK_LATENCY_NS = 1_000  # same-host delivery when the graph has no self-path
+
+O_NONBLOCK = 0x800
+F_GETFL = 3
+F_SETFL = 4
+FIONREAD = 0x541B
+SOL_SOCKET = 1
+SO_ERROR = 4
 
 
 class SimPanic(RuntimeError):
     pass
-
-
-@dataclasses.dataclass
-class UdpSocket:
-    fd: int
-    bound_port: int = 0  # 0 = unbound
-    peer: Optional[tuple[int, int]] = None  # (ip, port) after connect()
-    recvq: deque = dataclasses.field(default_factory=deque)  # (data, ip, port)
-    blocked: bool = False  # a recvfrom is parked on this socket
 
 
 @dataclasses.dataclass
@@ -65,6 +93,66 @@ class ProcessSpec:
     args: list[str]
     start_ns: int = 0
     expected_final_state: str = "exited"  # "exited" | "running"
+    environment: dict = dataclasses.field(default_factory=dict)
+
+
+class Waiter:
+    """A blocked syscall: re-checks its wake condition on every state
+    change of the files it watches, with an optional timeout (reference:
+    SysCallCondition, syscall_condition.c:22-48 — trigger + Timer +
+    StatusListener with edge filters; restart semantics live in check())."""
+
+    def __init__(
+        self,
+        kernel: "NetKernel",
+        proc: "ManagedProcess",
+        files: "list[File]",
+        check: "Callable[[], bool]",
+        timeout_at: Optional[int] = None,
+        on_timeout: Optional[Callable[[], None]] = None,
+    ):
+        self.kernel = kernel
+        self.proc = proc
+        self.files = files
+        self.check = check
+        self.done = False
+        self.on_timeout = on_timeout
+        proc.waiter = self
+        for f in files:
+            f.add_listener(self._cb)
+        if timeout_at is not None:
+            kernel._push(timeout_at, self._timeout_fire)
+
+    def _detach(self) -> None:
+        self.done = True
+        for f in self.files:
+            f.remove_listener(self._cb)
+        if self.proc.waiter is self:
+            self.proc.waiter = None
+
+    def _cb(self, _f: File) -> None:
+        if self.done:
+            return
+        self.proc.now = max(self.proc.now, self.kernel.now)
+        if self.check():
+            self._detach()
+            self.proc.state = "running"
+            self.kernel._service(self.proc)
+
+    def _timeout_fire(self) -> None:
+        if self.done:
+            return
+        self.proc.now = max(self.proc.now, self.kernel.now)
+        if self.check():  # raced: became ready at the same instant
+            self._detach()
+            self.proc.state = "running"
+            self.kernel._service(self.proc)
+            return
+        self._detach()
+        if self.on_timeout is not None:
+            self.on_timeout()
+        self.proc.state = "running"
+        self.kernel._service(self.proc)
 
 
 class ManagedProcess:
@@ -76,13 +164,14 @@ class ManagedProcess:
         self.now = 0
         self.ipc: Optional[I.IpcBlock] = None
         self.popen: Optional[subprocess.Popen] = None
-        self.sockets: dict[int, UdpSocket] = {}
-        self.next_fd = VFD_BASE
+        self.fdtab = DescriptorTable()
         self.state = "pending"  # pending -> running -> blocked -> exited
-        self.pending_sleep = False
+        self.waiter: Optional[Waiter] = None
         self.syscall_log: list[tuple[int, str, tuple]] = []
         self.exit_code: Optional[int] = None
         self._stdout_path = None
+        self.strace: Optional[StraceFile] = None
+        self._pending: Optional[tuple[str, str]] = None  # (name, args) awaiting reply
 
     # --- lifecycle -------------------------------------------------------
 
@@ -96,12 +185,19 @@ class ManagedProcess:
         )
         self.ipc.set_time(SIM_START_UNIX_NS + now_ns, 0)
         env = dict(os.environ)
+        env.update(self.spec.environment)
         env["LD_PRELOAD"] = shim_lib_path()
         env["SHADOW_SHM"] = self.ipc.path
+        env["SHADOW_HOSTNAME"] = self.host.name
+        env["SHADOW_HOSTS_FILE"] = str(self.kernel.hosts_file)
         outdir = self.kernel.data_dir / self.host.name
         outdir.mkdir(parents=True, exist_ok=True)
-        self._stdout_path = outdir / f"{pathlib.Path(self.spec.args[0]).name}.{self.vpid}.stdout"
-        self._stderr_path = outdir / f"{pathlib.Path(self.spec.args[0]).name}.{self.vpid}.stderr"
+        exe = pathlib.Path(self.spec.args[0]).name
+        self._stdout_path = outdir / f"{exe}.{self.vpid}.stdout"
+        self._stderr_path = outdir / f"{exe}.{self.vpid}.stderr"
+        self.strace = StraceFile(
+            outdir / f"{exe}.{self.vpid}.strace", self.vpid, mode=self.kernel.strace_mode
+        )
         self.popen = subprocess.Popen(
             self.spec.args,
             env=env,
@@ -121,10 +217,16 @@ class ManagedProcess:
     def stdout(self) -> bytes:
         return pathlib.Path(self._stdout_path).read_bytes() if self._stdout_path else b""
 
+    def stderr(self) -> bytes:
+        return pathlib.Path(self._stderr_path).read_bytes() if self._stderr_path else b""
+
     def kill(self) -> None:
         if self.popen and self.popen.poll() is None:
             self.popen.kill()
             self.popen.wait()
+        if self.strace:
+            self.strace.close()
+            self.strace = None
         if self.ipc:
             self.ipc.close()
             self.ipc = None
@@ -143,6 +245,10 @@ class ManagedProcess:
                 return None
 
     def _reply(self, ret: int = 0, a=(), buf: bytes = b"") -> None:
+        if self._pending is not None and self.strace is not None:
+            name, args = self._pending
+            self.strace.log(self.now, name, args, ret)
+        self._pending = None
         self.ipc.set_time(SIM_START_UNIX_NS + self.now, 0)
         m = I.make_msg(I.MSG_SYSCALL_DONE, a=a, ret=ret, buf=buf)
         self.ipc.send_to_shim(m)
@@ -159,19 +265,42 @@ class HostKernel:
         self.host_id = host_id
         self.node = node
         self.ip = ip
-        self.ports: dict[int, tuple[ManagedProcess, int]] = {}  # port -> (proc, fd)
+        # (proto, port) -> socket File (UdpSocket or listening TcpSocket)
+        self.ports: dict[tuple[int, int], File] = {}
+        # established/handshaking TCP, keyed (local_port, remote_ip, remote_port)
+        self.tcp_conns: dict[tuple[int, int, int], T.TcpSocket] = {}
         self.next_port = EPHEMERAL_PORT_BASE
         self.rng_counter = 0
         self.procs: list[ManagedProcess] = []
         self.packets_sent = 0
         self.packets_dropped = 0
+        self.bytes_sent = 0
+        self.bytes_recv = 0
 
-    def alloc_port(self) -> int:
-        while self.next_port in self.ports:
+    def alloc_port(self, proto: int) -> int:
+        while (proto, self.next_port) in self.ports:
             self.next_port += 1
         p = self.next_port
         self.next_port += 1
         return p
+
+    # --- TCP bookkeeping (tcp demux tables) ------------------------------
+
+    def bind_tcp_ephemeral(self, sock: T.TcpSocket) -> None:
+        port = self.alloc_port(PROTO_TCP)
+        sock.bound_port = port
+        self.ports[(PROTO_TCP, port)] = sock
+
+    def add_tcp_conn(self, sock: T.TcpSocket) -> None:
+        self.tcp_conns[sock.conn_key()] = sock
+
+    def drop_tcp_conn(self, sock: T.TcpSocket) -> None:
+        key = sock.conn_key()
+        if self.tcp_conns.get(key) is sock:
+            del self.tcp_conns[key]
+        pkey = (PROTO_TCP, sock.bound_port)
+        if sock.bound_port and self.ports.get(pkey) is sock:
+            del self.ports[pkey]
 
 
 class NetKernel:
@@ -187,6 +316,8 @@ class NetKernel:
         syscall_latency_ns: int = 1_000,
         vdso_latency_ns: int = 10,
         max_unapplied_ns: int = 1_000_000,
+        strace_mode: str = "standard",
+        pcap: bool = False,
     ):
         self.tables = tables
         self.lat = np.asarray(tables.lat_ns)
@@ -195,11 +326,13 @@ class NetKernel:
         self.syscall_latency_ns = syscall_latency_ns
         self.vdso_latency_ns = vdso_latency_ns
         self.max_unapplied_ns = max_unapplied_ns
+        self.strace_mode = strace_mode
         self.data_dir = pathlib.Path(data_dir)
         if self.data_dir.exists():
             shutil.rmtree(self.data_dir)
         self.data_dir.mkdir(parents=True)
 
+        self.dns = Dns()
         self.hosts: list[HostKernel] = []
         self.host_by_ip: dict[int, HostKernel] = {}
         self.host_by_name: dict[str, HostKernel] = {}
@@ -209,6 +342,9 @@ class NetKernel:
             self.hosts.append(hk)
             self.host_by_ip[hk.ip] = hk
             self.host_by_name[name] = hk
+            self.dns.register(name, hk.ip)
+        self.hosts_file = self.data_dir / "hosts"
+        self.dns.write_hosts_file(self.hosts_file)
         self._keys = rng.host_keys(seed, len(self.hosts))
 
         self.now = 0
@@ -216,6 +352,11 @@ class NetKernel:
         self.events: list[tuple[int, int, Callable[[], None]]] = []
         self.procs: list[ManagedProcess] = []
         self.event_log: list[tuple[int, str]] = []
+        self.pcap = None
+        if pcap:
+            from shadow_tpu.utils.pcap import PcapDir
+
+            self.pcap = PcapDir(self.data_dir, [h.name for h in self.hosts])
 
     # --- deterministic draws (same threefry streams as the engine) -------
 
@@ -228,6 +369,11 @@ class NetKernel:
         )
         src.rng_counter += 1
         return u
+
+    def _random_bytes(self, host: HostKernel, n: int) -> bytes:
+        out = rng.raw_bytes(self._keys[host.host_id], host.rng_counter, n)
+        host.rng_counter += 1
+        return out
 
     # --- config ----------------------------------------------------------
 
@@ -260,6 +406,8 @@ class NetKernel:
     def shutdown(self) -> None:
         for p in self.procs:
             p.kill()
+        if self.pcap:
+            self.pcap.close()
 
     def shutdown_check(self) -> None:
         """Reap naturally-exited children (expected_final_state,
@@ -267,6 +415,18 @@ class NetKernel:
         for p in self.procs:
             if p.state == "exited" and p.popen is not None:
                 p.exit_code = p.popen.wait()
+
+    def unexpected_final_states(self) -> "list[str]":
+        out = []
+        for p in self.procs:
+            want = p.spec.expected_final_state
+            got = "exited" if p.state == "exited" else "running"
+            if want != got or (want == "exited" and (p.exit_code or 0) != 0):
+                out.append(
+                    f"{p.host.name}/{pathlib.Path(p.spec.args[0]).name}: "
+                    f"expected {want}, got {got} (exit_code={p.exit_code})"
+                )
+        return out
 
     # --- process driving --------------------------------------------------
 
@@ -298,103 +458,71 @@ class NetKernel:
                 proc.state = "blocked"
                 return  # reply deferred to a later event
 
+    # --- syscall dispatch (syscall_handler.c:229-463 analogue) ------------
+
     def _syscall(self, proc: ManagedProcess, msg: I.ShimMsg) -> bool:
         """Emulate one syscall; returns False if the reply is deferred
-        (blocking). Mirrors the dispatch seam syscall_handler.c:229-463."""
-        code = msg.a[0]
+        (blocking)."""
+        code = int(msg.a[0])
         # fold shim-accumulated local latency, then charge the syscall cost
         proc.now += int(msg.a[4]) + self.syscall_latency_ns
-        host = proc.host
         name = I.VSYS_NAMES.get(code, str(code))
-        proc.syscall_log.append((proc.now, name, tuple(int(x) for x in msg.a[1:4])))
+        args = tuple(int(x) for x in msg.a[1:4])
+        proc.syscall_log.append((proc.now, name, args))
+        proc._pending = (name, ", ".join(str(a) for a in args))
 
-        if code == I.VSYS_YIELD:
-            proc._reply(0)
+        handler = _DISPATCH.get(code)
+        if handler is None:
+            proc._reply(-ENOSYS)
             return True
+        return handler(self, proc, msg)
 
-        if code == I.VSYS_CLOCK_GETTIME:
-            proc._reply(0, a=(0, SIM_START_UNIX_NS + proc.now))
-            return True
+    # --- generic helpers --------------------------------------------------
 
-        if code == I.VSYS_GETPID:
-            proc._reply(proc.vpid)
-            return True
+    def _file(self, proc: ManagedProcess, fd: int) -> Optional[File]:
+        return proc.fdtab.get(fd)
 
-        if code == I.VSYS_NANOSLEEP:
-            wake_at = proc.now + int(msg.a[1])
-            self._push(wake_at, lambda p=proc, t=wake_at: self._wake_sleep(p, t))
-            return False
+    def _close_fd(self, proc: ManagedProcess, fd: int) -> int:
+        host = proc.host
+        f = proc.fdtab.remove(fd)  # None = missing fd or other refs remain
+        if f is not None:
+            # epoll(7): the kernel auto-deregisters an fd from every epoll
+            # interest list once all descriptors for the file are closed
+            for other in list(proc.fdtab._files.values()):
+                if isinstance(other, Epoll):
+                    w = other.watches.get(fd)
+                    if w is not None and w.file is f:
+                        other.ctl(2, fd, None, 0, 0)  # EPOLL_CTL_DEL
+            # release port bindings on last close
+            if isinstance(f, UdpSocket) and f.bound_port:
+                pk = (PROTO_UDP, f.bound_port)
+                if host.ports.get(pk) is f:
+                    del host.ports[pk]
+            if isinstance(f, T.TcpSocket):
+                pk = (PROTO_TCP, f.bound_port)
+                if f.bound_port and host.ports.get(pk) is f and f.state in (T.CLOSED, T.LISTEN):
+                    del host.ports[pk]
+            f.on_close(self, proc)
+        return 0
 
-        if code == I.VSYS_SOCKET:
-            fd = proc.next_fd
-            proc.next_fd += 1
-            proc.sockets[fd] = UdpSocket(fd=fd)
-            proc._reply(fd)
-            return True
+    # --- time & identity --------------------------------------------------
 
-        sock = proc.sockets.get(int(msg.a[1]))
-        if sock is None:
-            proc._reply(-9)  # EBADF
-            return True
-
-        if code == I.VSYS_BIND:
-            port = int(msg.a[3]) or host.alloc_port()
-            if port in host.ports:
-                proc._reply(-98)  # EADDRINUSE
-                return True
-            host.ports[port] = (proc, sock.fd)
-            sock.bound_port = port
-            proc._reply(0)
-            return True
-
-        if code == I.VSYS_CONNECT:
-            sock.peer = (int(msg.a[2]), int(msg.a[3]))
-            proc._reply(0)
-            return True
-
-        if code == I.VSYS_GETSOCKNAME:
-            proc._reply(0, a=(0, 0, host.ip, sock.bound_port))
-            return True
-
-        if code == I.VSYS_SENDTO:
-            ip, port = int(msg.a[2]), int(msg.a[3])
-            if ip == -1:  # send() on a connected socket
-                if sock.peer is None:
-                    proc._reply(-89)  # EDESTADDRREQ
-                    return True
-                ip, port = sock.peer
-            data = I.msg_payload(msg)
-            if sock.bound_port == 0:  # implicit bind on first send
-                sock.bound_port = host.alloc_port()
-                host.ports[sock.bound_port] = (proc, sock.fd)
-            self._send_packet(host, proc.now, ip, port, host.ip, sock.bound_port, data)
-            proc._reply(len(data))
-            return True
-
-        if code == I.VSYS_RECVFROM:
-            if sock.recvq:
-                data, sip, sport = sock.recvq.popleft()
-                proc._reply(len(data), a=(0, 0, sip, sport), buf=data)
-                return True
-            if int(msg.a[2]):  # MSG_DONTWAIT
-                proc._reply(-11)  # EAGAIN
-                return True
-            sock.blocked = True
-            return False
-
-        if code == I.VSYS_CLOSE:
-            if sock.bound_port and host.ports.get(sock.bound_port, (None, None))[0] is proc:
-                del host.ports[sock.bound_port]
-            del proc.sockets[sock.fd]
-            proc._reply(0)
-            return True
-
-        if code == I.VSYS_EXIT:
-            proc._reply(0)
-            return True
-
-        proc._reply(-38)  # ENOSYS
+    def _sys_yield(self, proc, msg):
+        proc._reply(0)
         return True
+
+    def _sys_clock_gettime(self, proc, msg):
+        proc._reply(0, a=(0, SIM_START_UNIX_NS + proc.now))
+        return True
+
+    def _sys_getpid(self, proc, msg):
+        proc._reply(proc.vpid)
+        return True
+
+    def _sys_nanosleep(self, proc, msg):
+        wake_at = proc.now + int(msg.a[1])
+        self._push(wake_at, lambda p=proc, t=wake_at: self._wake_sleep(p, t))
+        return False
 
     def _wake_sleep(self, proc: ManagedProcess, t: int) -> None:
         proc.now = max(proc.now, t)
@@ -402,7 +530,618 @@ class NetKernel:
         proc._reply(0)
         self._service(proc)
 
+    def _sys_gethostname(self, proc, msg):
+        proc._reply(0, buf=proc.host.name.encode() + b"\0")
+        return True
+
+    def _sys_uname(self, proc, msg):
+        # buf: nodename only; the shim fills the static fields
+        proc._reply(0, buf=proc.host.name.encode() + b"\0")
+        return True
+
+    def _sys_resolve(self, proc, msg):
+        name = I.msg_payload(msg).split(b"\0")[0].decode(errors="replace")
+        if name == proc.host.name:
+            proc._reply(0, a=(0, 0, proc.host.ip))
+            return True
+        ip = self.dns.resolve(name)
+        if ip is None:
+            proc._reply(-2)  # maps to EAI_NONAME in the shim
+            return True
+        proc._reply(0, a=(0, 0, ip))
+        return True
+
+    def _sys_getrandom(self, proc, msg):
+        n = min(int(msg.a[1]), I.SHIM_BUF_SIZE)
+        proc._reply(n, buf=self._random_bytes(proc.host, n))
+        return True
+
+    def _sys_exit(self, proc, msg):
+        proc._reply(0)
+        return True
+
+    # --- descriptor ops ---------------------------------------------------
+
+    def _sys_close(self, proc, msg):
+        fd = int(msg.a[1])
+        if self._file(proc, fd) is None:
+            proc._reply(-EBADF)
+            return True
+        self._close_fd(proc, fd)
+        proc._reply(0)
+        return True
+
+    def _sys_dup(self, proc, msg):
+        nfd = proc.fdtab.dup(int(msg.a[1]))
+        proc._reply(nfd if nfd is not None else -EBADF)
+        return True
+
+    def _sys_fcntl(self, proc, msg):
+        f = self._file(proc, int(msg.a[1]))
+        if f is None:
+            proc._reply(-EBADF)
+            return True
+        cmd, arg = int(msg.a[2]), int(msg.a[3])
+        if cmd == F_GETFL:
+            proc._reply(O_NONBLOCK if f.nonblock else 0)
+        elif cmd == F_SETFL:
+            f.nonblock = bool(arg & O_NONBLOCK)
+            proc._reply(0)
+        else:
+            proc._reply(0)  # accept-and-ignore (F_SETFD etc.)
+        return True
+
+    def _sys_ioctl(self, proc, msg):
+        f = self._file(proc, int(msg.a[1]))
+        if f is None:
+            proc._reply(-EBADF)
+            return True
+        req = int(msg.a[2])
+        if req == FIONREAD:
+            if isinstance(f, T.TcpSocket):
+                n = len(f.rcv_buf)
+            elif isinstance(f, UdpSocket):
+                n = len(f.recvq[0][0]) if f.recvq else 0
+            elif isinstance(f, PipeEnd):
+                n = len(f.buf.data) if f.is_read else 0
+            else:
+                n = 0
+            proc._reply(0, a=(0, 0, n))
+            return True
+        proc._reply(-EINVAL)
+        return True
+
+    def _sys_pipe2(self, proc, msg):
+        r, w = make_pipe()
+        flags = int(msg.a[1])
+        r.nonblock = w.nonblock = bool(flags & O_NONBLOCK)
+        rfd = proc.fdtab.alloc(r)
+        wfd = proc.fdtab.alloc(w)
+        proc._reply(0, a=(0, rfd, wfd))
+        return True
+
+    def _sys_eventfd(self, proc, msg):
+        ef = EventFd(int(msg.a[1]), int(msg.a[2]))
+        ef.nonblock = bool(int(msg.a[2]) & 0x800)  # EFD_NONBLOCK == O_NONBLOCK
+        proc._reply(proc.fdtab.alloc(ef))
+        return True
+
+    def _sys_timerfd_create(self, proc, msg):
+        tf = TimerFd(self)
+        tf.nonblock = bool(int(msg.a[2]) & 0x800)  # TFD_NONBLOCK
+        proc._reply(proc.fdtab.alloc(tf))
+        return True
+
+    def _sys_timerfd_settime(self, proc, msg):
+        f = self._file(proc, int(msg.a[1]))
+        if not isinstance(f, TimerFd):
+            proc._reply(-EBADF if f is None else -EINVAL)
+            return True
+        payload = I.msg_payload(msg)
+        value_ns, interval_ns = struct.unpack("<qq", payload[:16])
+        flags = int(msg.a[2])
+        if (flags & 1) and value_ns > 0:  # TFD_TIMER_ABSTIME on CLOCK_REALTIME
+            # a past abstime must fire immediately (clamp to 1, not 0 —
+            # 0 would disarm)
+            value_ns = max(value_ns - SIM_START_UNIX_NS - self.now, 1)
+            flags &= ~1
+        old_value, old_interval = f.settime(value_ns, interval_ns, flags)
+        proc._reply(0, a=(0, 0, old_value, old_interval))
+        return True
+
+    def _sys_timerfd_gettime(self, proc, msg):
+        f = self._file(proc, int(msg.a[1]))
+        if not isinstance(f, TimerFd):
+            proc._reply(-EBADF if f is None else -EINVAL)
+            return True
+        value, interval = f.gettime()
+        proc._reply(0, a=(0, 0, value, interval))
+        return True
+
+    # --- read/write on any vfd -------------------------------------------
+
+    def _sys_read(self, proc, msg):
+        fd, n = int(msg.a[1]), min(int(msg.a[2]), I.SHIM_BUF_SIZE)
+        f = self._file(proc, fd)
+        if f is None:
+            proc._reply(-EBADF)
+            return True
+        dontwait = bool(int(msg.a[3]))
+        return self._do_read(proc, f, n, dontwait)
+
+    def _do_read(self, proc, f: File, n: int, dontwait: bool) -> bool:
+        if isinstance(f, T.TcpSocket):
+            return self._tcp_recv(proc, f, n, dontwait)
+        if isinstance(f, UdpSocket):
+            return self._udp_recv(proc, f, n, dontwait)
+        if isinstance(f, (PipeEnd, EventFd, TimerFd)):
+            r = f.read(n)
+            if isinstance(r, int) and r == -EAGAIN and not (f.nonblock or dontwait):
+                def check(pf=f, pn=n):
+                    rr = pf.read(pn)
+                    if isinstance(rr, int) and rr == -EAGAIN:
+                        return False
+                    if isinstance(rr, int):
+                        proc._reply(rr)
+                    else:
+                        proc._reply(len(rr), buf=rr)
+                    return True
+
+                Waiter(self, proc, [f], check)
+                return False
+            if isinstance(r, int):
+                proc._reply(r)
+            else:
+                proc._reply(len(r), buf=r)
+            return True
+        proc._reply(-EINVAL)
+        return True
+
+    def _sys_write(self, proc, msg):
+        fd = int(msg.a[1])
+        data = I.msg_payload(msg)
+        f = self._file(proc, fd)
+        if f is None:
+            proc._reply(-EBADF)
+            return True
+        dontwait = bool(int(msg.a[3]))
+        return self._do_write(proc, f, data, dontwait)
+
+    def _do_write(self, proc, f: File, data: bytes, dontwait: bool) -> bool:
+        if isinstance(f, T.TcpSocket):
+            return self._tcp_send(proc, f, data, dontwait)
+        if isinstance(f, UdpSocket):
+            return self._udp_sendto(proc, f, data, -1, -1)
+        if isinstance(f, (PipeEnd, EventFd)):
+            r = f.write(data)
+            if r == -EAGAIN and not (f.nonblock or dontwait):
+                def check(pf=f, pd=data):
+                    rr = pf.write(pd)
+                    if rr == -EAGAIN:
+                        return False
+                    proc._reply(rr)
+                    return True
+
+                Waiter(self, proc, [f], check)
+                return False
+            proc._reply(r)
+            return True
+        proc._reply(-EINVAL)
+        return True
+
+    # --- sockets ----------------------------------------------------------
+
+    def _sys_socket(self, proc, msg):
+        stype = int(msg.a[2]) & 0xFF
+        nonblock = bool(int(msg.a[2]) & 0x800)  # SOCK_NONBLOCK
+        if stype == 2:  # SOCK_DGRAM
+            s: File = UdpSocket()
+        elif stype == 1:  # SOCK_STREAM
+            s = T.TcpSocket(proc.host)
+        else:
+            proc._reply(-EINVAL)
+            return True
+        s.nonblock = nonblock
+        proc._reply(proc.fdtab.alloc(s))
+        return True
+
+    def _sys_bind(self, proc, msg):
+        f = self._file(proc, int(msg.a[1]))
+        host = proc.host
+        if f is None:
+            proc._reply(-EBADF)
+            return True
+        port = int(msg.a[3])
+        if isinstance(f, UdpSocket):
+            proto = PROTO_UDP
+        elif isinstance(f, T.TcpSocket):
+            proto = PROTO_TCP
+        else:
+            proc._reply(-ENOTSOCK)
+            return True
+        port = port or host.alloc_port(proto)
+        if (proto, port) in host.ports:
+            proc._reply(-EADDRINUSE)
+            return True
+        host.ports[(proto, port)] = f
+        f.bound_port = port
+        if isinstance(f, T.TcpSocket):
+            f.local_ip = host.ip
+            f.local_port = port
+        proc._reply(0)
+        return True
+
+    def _sys_listen(self, proc, msg):
+        f = self._file(proc, int(msg.a[1]))
+        if f is None:
+            proc._reply(-EBADF)
+            return True
+        if not isinstance(f, T.TcpSocket):
+            proc._reply(-ENOTSOCK if not isinstance(f, UdpSocket) else -EINVAL)
+            return True
+        if f.bound_port == 0:  # listen() without bind: ephemeral (POSIX allows)
+            proc.host.bind_tcp_ephemeral(f)
+            f.local_ip = proc.host.ip
+            f.local_port = f.bound_port
+        proc._reply(f.listen(int(msg.a[2])))
+        return True
+
+    def _sys_accept(self, proc, msg):
+        f = self._file(proc, int(msg.a[1]))
+        if f is None:
+            proc._reply(-EBADF)
+            return True
+        if not isinstance(f, T.TcpSocket) or f.state != T.LISTEN:
+            proc._reply(-EINVAL)
+            return True
+        nonblock_child = bool(int(msg.a[2]))
+
+        def try_accept() -> bool:
+            child = f.accept_pop()
+            if child is None:
+                return False
+            child.nonblock = nonblock_child
+            cfd = proc.fdtab.alloc(child)
+            proc._reply(cfd, a=(0, 0, child.remote_ip, child.remote_port))
+            return True
+
+        if try_accept():
+            return True
+        if f.nonblock:
+            proc._reply(-EAGAIN)
+            return True
+        Waiter(self, proc, [f], try_accept)
+        return False
+
+    def _sys_connect(self, proc, msg):
+        f = self._file(proc, int(msg.a[1]))
+        if f is None:
+            proc._reply(-EBADF)
+            return True
+        ip, port = int(msg.a[2]), int(msg.a[3])
+        if isinstance(f, UdpSocket):
+            f.peer = (ip, port)
+            proc._reply(0)
+            return True
+        if not isinstance(f, T.TcpSocket):
+            proc._reply(-ENOTSOCK)
+            return True
+        if f.state == T.ESTABLISHED:
+            proc._reply(-EISCONN)
+            return True
+        r = f.connect(ip, port)
+        if r != -EINPROGRESS:
+            proc._reply(r)
+            return True
+        if f.nonblock:
+            proc._reply(-EINPROGRESS)
+            return True
+
+        def check() -> bool:
+            if f.state == T.ESTABLISHED:
+                proc._reply(0)
+                return True
+            if f.error:
+                e, f.error = f.error, 0
+                proc._reply(-e)
+                return True
+            return False
+
+        Waiter(self, proc, [f], check)
+        return False
+
+    def _sys_shutdown(self, proc, msg):
+        f = self._file(proc, int(msg.a[1]))
+        if not isinstance(f, T.TcpSocket):
+            proc._reply(-EBADF if f is None else -ENOTSOCK)
+            return True
+        how = int(msg.a[2])
+        if how in (1, 2):  # SHUT_WR / SHUT_RDWR
+            proc._reply(f.shutdown_write())
+        else:
+            proc._reply(0)  # SHUT_RD: no-op in this model
+        return True
+
+    def _sys_getsockname(self, proc, msg):
+        f = self._file(proc, int(msg.a[1]))
+        host = proc.host
+        if isinstance(f, UdpSocket):
+            proc._reply(0, a=(0, 0, host.ip, f.bound_port))
+        elif isinstance(f, T.TcpSocket):
+            proc._reply(0, a=(0, 0, f.local_ip or host.ip, f.local_port or f.bound_port))
+        else:
+            proc._reply(-EBADF if f is None else -ENOTSOCK)
+        return True
+
+    def _sys_getpeername(self, proc, msg):
+        f = self._file(proc, int(msg.a[1]))
+        if isinstance(f, UdpSocket):
+            if f.peer is None:
+                proc._reply(-ENOTCONN)
+            else:
+                proc._reply(0, a=(0, 0, f.peer[0], f.peer[1]))
+        elif isinstance(f, T.TcpSocket):
+            if f.state in (T.CLOSED, T.LISTEN):
+                proc._reply(-ENOTCONN)
+            else:
+                proc._reply(0, a=(0, 0, f.remote_ip, f.remote_port))
+        else:
+            proc._reply(-EBADF if f is None else -ENOTSOCK)
+        return True
+
+    def _sys_setsockopt(self, proc, msg):
+        f = self._file(proc, int(msg.a[1]))
+        if f is None:
+            proc._reply(-EBADF)
+            return True
+        proc._reply(0)  # accept-and-ignore (SO_REUSEADDR, TCP_NODELAY, bufs…)
+        return True
+
+    def _sys_getsockopt(self, proc, msg):
+        f = self._file(proc, int(msg.a[1]))
+        if f is None:
+            proc._reply(-EBADF)
+            return True
+        level, opt = int(msg.a[2]), int(msg.a[3])
+        if level == SOL_SOCKET and opt == SO_ERROR:
+            e = 0
+            if isinstance(f, T.TcpSocket):
+                e, f.error = f.error, 0
+            proc._reply(0, a=(0, 0, e))
+            return True
+        proc._reply(0, a=(0, 0, 0))
+        return True
+
+    # --- UDP data path ----------------------------------------------------
+
+    def _sys_sendto(self, proc, msg):
+        f = self._file(proc, int(msg.a[1]))
+        if f is None:
+            proc._reply(-EBADF)
+            return True
+        data = I.msg_payload(msg)
+        ip, port = int(msg.a[2]), int(msg.a[3])
+        if isinstance(f, T.TcpSocket):
+            return self._tcp_send(proc, f, data, dontwait=False)
+        if isinstance(f, UdpSocket):
+            return self._udp_sendto(proc, f, data, ip, port)
+        proc._reply(-ENOTSOCK)
+        return True
+
+    def _udp_sendto(self, proc, sock: UdpSocket, data: bytes, ip: int, port: int) -> bool:
+        host = proc.host
+        if ip == -1:  # send() on a connected socket
+            if sock.peer is None:
+                proc._reply(-EDESTADDRREQ)
+                return True
+            ip, port = sock.peer
+        if len(data) > 65507:  # real UDP: datagram exceeds IPv4 payload max
+            proc._reply(-EMSGSIZE)
+            return True
+        if sock.bound_port == 0:  # implicit bind on first send
+            sock.bound_port = host.alloc_port(PROTO_UDP)
+            host.ports[(PROTO_UDP, sock.bound_port)] = sock
+        self._send_packet(host, proc.now, ip, port, host.ip, sock.bound_port, data)
+        proc._reply(len(data))
+        return True
+
+    def _sys_recvfrom(self, proc, msg):
+        f = self._file(proc, int(msg.a[1]))
+        if f is None:
+            proc._reply(-EBADF)
+            return True
+        dontwait = bool(int(msg.a[2]))
+        n = int(msg.a[3]) or I.SHIM_BUF_SIZE
+        if isinstance(f, T.TcpSocket):
+            return self._tcp_recv(proc, f, min(n, I.SHIM_BUF_SIZE), dontwait)
+        if isinstance(f, UdpSocket):
+            return self._udp_recv(proc, f, min(n, I.SHIM_BUF_SIZE), dontwait)
+        proc._reply(-ENOTSOCK)
+        return True
+
+    def _udp_recv(self, proc, sock: UdpSocket, n: int, dontwait: bool) -> bool:
+        def check() -> bool:
+            if not sock.recvq:
+                return False
+            data, sip, sport = sock.take()
+            proc._reply(len(data), a=(0, 0, sip, sport), buf=data[:n])
+            return True
+
+        if check():
+            return True
+        if sock.nonblock or dontwait:
+            proc._reply(-EAGAIN)
+            return True
+        Waiter(self, proc, [sock], check)
+        return False
+
+    # --- TCP data path ----------------------------------------------------
+
+    def _tcp_send(self, proc, sock: T.TcpSocket, data: bytes, dontwait: bool) -> bool:
+        r = sock.send(data)
+        if r == -EAGAIN and not (sock.nonblock or dontwait):
+            def check() -> bool:
+                rr = sock.send(data)
+                if rr == -EAGAIN:
+                    return False
+                proc._reply(rr)
+                return True
+
+            Waiter(self, proc, [sock], check)
+            return False
+        proc._reply(r)
+        return True
+
+    def _tcp_recv(self, proc, sock: T.TcpSocket, n: int, dontwait: bool) -> bool:
+        def check() -> bool:
+            r = sock.recv(n)
+            if isinstance(r, int):
+                if r == -EAGAIN:
+                    return False
+                proc._reply(r)
+                return True
+            proc._reply(len(r), a=(0, 0, sock.remote_ip, sock.remote_port), buf=r)
+            return True
+
+        if check():
+            return True
+        if sock.nonblock or dontwait:
+            proc._reply(-EAGAIN)
+            return True
+        Waiter(self, proc, [sock], check)
+        return False
+
+    # --- poll / select / epoll -------------------------------------------
+
+    def _sys_poll(self, proc, msg):
+        nfds = int(msg.a[1])
+        timeout_ns = int(msg.a[2])
+        raw = I.msg_payload(msg)
+        entries = []  # (fd, events)
+        for i in range(nfds):
+            fd, events, _rev = struct.unpack_from("<ihh", raw, i * 8)
+            entries.append((fd, events))
+
+        def ready_map() -> "tuple[int, bytes]":
+            out = bytearray(raw[: nfds * 8])
+            count = 0
+            for i, (fd, events) in enumerate(entries):
+                f = self._file(proc, fd)
+                if fd >= VFD_BASE and f is None:
+                    rev = 0x20  # POLLNVAL: virtual fd that was never/no longer open
+                elif f is None:
+                    rev = 0  # native fd in a mixed set: treated as never-ready
+                else:
+                    mask = f.poll_mask()
+                    rev = 0
+                    if (events & 0x1) and (mask & EPOLLIN):
+                        rev |= 0x1  # POLLIN
+                    if (events & 0x4) and (mask & EPOLLOUT):
+                        rev |= 0x4  # POLLOUT
+                    if mask & 0x8:
+                        rev |= 0x8  # POLLERR
+                    if mask & 0x10:
+                        rev |= 0x10  # POLLHUP
+                struct.pack_into("<ihh", out, i * 8, fd, events, rev)
+                if rev:
+                    count += 1
+            return count, bytes(out)
+
+        count, out = ready_map()
+        if count > 0 or timeout_ns == 0:
+            proc._reply(count, buf=out)
+            return True
+        files = [
+            self._file(proc, fd) for fd, _ in entries if fd >= 0 and self._file(proc, fd)
+        ]
+
+        def check() -> bool:
+            c, o = ready_map()
+            if c == 0:
+                return False
+            proc._reply(c, buf=o)
+            return True
+
+        def on_timeout() -> None:
+            c, o = ready_map()
+            proc._reply(c, buf=o)
+
+        Waiter(
+            self,
+            proc,
+            files,
+            check,
+            timeout_at=(proc.now + timeout_ns) if timeout_ns > 0 else None,
+            on_timeout=on_timeout,
+        )
+        return False
+
+    def _sys_epoll_create(self, proc, msg):
+        proc._reply(proc.fdtab.alloc(Epoll()))
+        return True
+
+    def _sys_epoll_ctl(self, proc, msg):
+        ep = self._file(proc, int(msg.a[1]))
+        if not isinstance(ep, Epoll):
+            proc._reply(-EBADF if ep is None else -EINVAL)
+            return True
+        op, fd = int(msg.a[2]), int(msg.a[3])
+        target = self._file(proc, fd)
+        events = data = 0
+        payload = I.msg_payload(msg)
+        if len(payload) >= 12:
+            events, data = struct.unpack("<IQ", payload[:12])
+        proc._reply(ep.ctl(op, fd, target, events, data))
+        return True
+
+    def _sys_epoll_wait(self, proc, msg):
+        ep = self._file(proc, int(msg.a[1]))
+        if not isinstance(ep, Epoll):
+            proc._reply(-EBADF if ep is None else -EINVAL)
+            return True
+        maxevents = max(1, int(msg.a[2]))
+        timeout_ns = int(msg.a[3])
+
+        def try_report() -> bool:
+            got = ep.report(maxevents)
+            if not got:
+                return False
+            buf = b"".join(struct.pack("<IQ", hits, data) for data, hits in got)
+            proc._reply(len(got), buf=buf)
+            return True
+
+        if try_report():
+            return True
+        if timeout_ns == 0:
+            proc._reply(0)
+            return True
+
+        def on_timeout() -> None:
+            got = ep.report(maxevents)
+            buf = b"".join(struct.pack("<IQ", hits, data) for data, hits in got)
+            proc._reply(len(got), buf=buf)
+
+        Waiter(
+            self,
+            proc,
+            [ep],
+            try_report,
+            timeout_at=(proc.now + timeout_ns) if timeout_ns > 0 else None,
+            on_timeout=on_timeout,
+        )
+        return False
+
     # --- the data plane (Worker::send_packet, worker.rs:328-413) ---------
+
+    def _path(self, src: HostKernel, dst: HostKernel) -> "tuple[int, float]":
+        """(latency_ns, reliability); same-host traffic rides loopback
+        (exempt from loss + bandwidth, reference relay/mod.rs local exempt)."""
+        if src is dst:
+            lat = int(self.lat[src.node, dst.node])
+            if lat >= TIME_MAX:
+                lat = LOOPBACK_LATENCY_NS
+            return lat, 1.0
+        return int(self.lat[src.node, dst.node]), float(self.rel[src.node, dst.node])
 
     def _send_packet(
         self, src: HostKernel, t: int, dst_ip: int, dst_port: int,
@@ -412,15 +1151,17 @@ class NetKernel:
         u = self._loss_draw(src)  # drawn even for unroutable, like the engine
         if dst is None:
             return  # no such host: UDP silently drops
-        lat = int(self.lat[src.node, dst.node])
-        relv = float(self.rel[src.node, dst.node])
+        lat, relv = self._path(src, dst)
         if lat >= TIME_MAX:
             return
-        if not (u < relv):
+        if src is not dst and not (u < relv):
             src.packets_dropped += 1
             self.event_log.append((t, f"drop {src.name}->{dst.name}:{dst_port}"))
             return
         src.packets_sent += 1
+        src.bytes_sent += len(data)
+        if self.pcap:
+            self.pcap.udp(src.name, t, src_ip, src_port, dst_ip, dst_port, data)
         deliver = t + lat
         self._push(
             deliver,
@@ -430,19 +1171,109 @@ class NetKernel:
     def _deliver(
         self, dst: HostKernel, port: int, data: bytes, src_ip: int, src_port: int
     ) -> None:
-        entry = dst.ports.get(port)
         self.event_log.append((self.now, f"deliver {dst.name}:{port} {len(data)}B"))
-        if entry is None:
+        dst.bytes_recv += len(data)
+        if self.pcap:
+            self.pcap.udp(dst.name, self.now, src_ip, src_port, dst.ip, port, data)
+        sock = dst.ports.get((PROTO_UDP, port))
+        if not isinstance(sock, UdpSocket):
             return  # nobody bound: drop (no ICMP in v1)
-        proc, fd = entry
-        sock = proc.sockets.get(fd)
-        if sock is None:
+        sock.deliver(data, src_ip, src_port)
+
+    # --- TCP segment plane -------------------------------------------------
+
+    def send_segment(self, src: HostKernel, seg: T.Segment) -> None:
+        """Transmit one TCP segment through the simulated network (the
+        TCP-tier Worker::send_packet)."""
+        dst = self.host_by_ip.get(seg.dst_ip)
+        u = self._loss_draw(src)
+        if dst is None:
             return
-        sock.recvq.append((data, src_ip, src_port))
-        if sock.blocked:
-            sock.blocked = False
-            data2, sip, sport = sock.recvq.popleft()
-            proc.now = max(proc.now, self.now)
-            proc.state = "running"
-            proc._reply(len(data2), a=(0, 0, sip, sport), buf=data2)
-            self._service(proc)
+        lat, relv = self._path(src, dst)
+        if lat >= TIME_MAX:
+            return
+        if src is not dst and not (u < relv):
+            src.packets_dropped += 1
+            self.event_log.append(
+                (self.now, f"drop-tcp {src.name}->{dst.name} {seg.flag_str()} seq={seg.seq}")
+            )
+            return
+        src.packets_sent += 1
+        src.bytes_sent += seg.wire_len()
+        if self.pcap:
+            self.pcap.tcp(src.name, self.now, seg)
+        self._push(self.now + lat, lambda: self._deliver_segment(dst, seg))
+
+    def _deliver_segment(self, dst: HostKernel, seg: T.Segment) -> None:
+        dst.bytes_recv += seg.wire_len()
+        self.event_log.append(
+            (
+                self.now,
+                f"tcp {dst.name}:{seg.dst_port} {seg.flag_str()} "
+                f"seq={seg.seq} ack={seg.ack} {len(seg.payload)}B",
+            )
+        )
+        if self.pcap:
+            self.pcap.tcp(dst.name, self.now, seg)
+        conn = dst.tcp_conns.get((seg.dst_port, seg.src_ip, seg.src_port))
+        if conn is not None:
+            conn.on_segment(seg)
+            return
+        listener = dst.ports.get((PROTO_TCP, seg.dst_port))
+        if isinstance(listener, T.TcpSocket) and listener.state == T.LISTEN:
+            if seg.flags & T.FLAG_SYN and not (seg.flags & T.FLAG_ACK):
+                listener.on_syn(seg)
+                return
+        # closed port / dead connection: RST (unless this was an RST)
+        if not (seg.flags & T.FLAG_RST):
+            rst = T.Segment(
+                src_ip=seg.dst_ip,
+                src_port=seg.dst_port,
+                dst_ip=seg.src_ip,
+                dst_port=seg.src_port,
+                flags=T.FLAG_RST | T.FLAG_ACK,
+                seq=seg.ack,
+                ack=seg.seq + len(seg.payload) + (1 if seg.flags & T.FLAG_SYN else 0),
+                wnd=0,
+            )
+            self.send_segment(dst, rst)
+
+
+_DISPATCH = {
+    I.VSYS_YIELD: NetKernel._sys_yield,
+    I.VSYS_CLOCK_GETTIME: NetKernel._sys_clock_gettime,
+    I.VSYS_GETPID: NetKernel._sys_getpid,
+    I.VSYS_NANOSLEEP: NetKernel._sys_nanosleep,
+    I.VSYS_SOCKET: NetKernel._sys_socket,
+    I.VSYS_BIND: NetKernel._sys_bind,
+    I.VSYS_CONNECT: NetKernel._sys_connect,
+    I.VSYS_GETSOCKNAME: NetKernel._sys_getsockname,
+    I.VSYS_SENDTO: NetKernel._sys_sendto,
+    I.VSYS_RECVFROM: NetKernel._sys_recvfrom,
+    I.VSYS_CLOSE: NetKernel._sys_close,
+    I.VSYS_EXIT: NetKernel._sys_exit,
+    I.VSYS_LISTEN: NetKernel._sys_listen,
+    I.VSYS_ACCEPT: NetKernel._sys_accept,
+    I.VSYS_SHUTDOWN: NetKernel._sys_shutdown,
+    I.VSYS_GETPEERNAME: NetKernel._sys_getpeername,
+    I.VSYS_SETSOCKOPT: NetKernel._sys_setsockopt,
+    I.VSYS_GETSOCKOPT: NetKernel._sys_getsockopt,
+    I.VSYS_FCNTL: NetKernel._sys_fcntl,
+    I.VSYS_IOCTL: NetKernel._sys_ioctl,
+    I.VSYS_PIPE2: NetKernel._sys_pipe2,
+    I.VSYS_READ: NetKernel._sys_read,
+    I.VSYS_WRITE: NetKernel._sys_write,
+    I.VSYS_EVENTFD: NetKernel._sys_eventfd,
+    I.VSYS_TIMERFD_CREATE: NetKernel._sys_timerfd_create,
+    I.VSYS_TIMERFD_SETTIME: NetKernel._sys_timerfd_settime,
+    I.VSYS_TIMERFD_GETTIME: NetKernel._sys_timerfd_gettime,
+    I.VSYS_EPOLL_CREATE: NetKernel._sys_epoll_create,
+    I.VSYS_EPOLL_CTL: NetKernel._sys_epoll_ctl,
+    I.VSYS_EPOLL_WAIT: NetKernel._sys_epoll_wait,
+    I.VSYS_POLL: NetKernel._sys_poll,
+    I.VSYS_GETHOSTNAME: NetKernel._sys_gethostname,
+    I.VSYS_UNAME: NetKernel._sys_uname,
+    I.VSYS_RESOLVE: NetKernel._sys_resolve,
+    I.VSYS_GETRANDOM: NetKernel._sys_getrandom,
+    I.VSYS_DUP: NetKernel._sys_dup,
+}

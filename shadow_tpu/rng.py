@@ -52,6 +52,16 @@ def uniform_int(keys: jax.Array, counters: jax.Array, lo, hi) -> jax.Array:
     return jax.vmap(lambda k, a, b: random.randint(k, (), a, b, dtype=jnp.int64))(ks, lo_b, hi_b)
 
 
+def raw_bytes(key: jax.Array, counter: int, n: int):
+    """n deterministic bytes for draw #counter of one host key (serves
+    getrandom//dev/urandom in managed processes; the reference routes
+    these through the host RNG the same way, handler/random.rs)."""
+    import numpy as np
+
+    k = random.fold_in(key, jnp.uint32(counter))
+    return np.asarray(random.bits(k, (n,), jnp.uint8)).tobytes()
+
+
 def exponential_ns(keys: jax.Array, counters: jax.Array, mean_ns) -> jax.Array:
     """[H] i64 ~ Exp(mean_ns), rounded to ns (one draw per host).
 

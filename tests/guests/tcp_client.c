@@ -1,0 +1,106 @@
+/* TCP client guest: resolves the server by hostname (getaddrinfo -> the
+ * simulated DNS), connects, sends `nbytes` of patterned data in chunks,
+ * reads the echo back, verifies it, and prints the elapsed simulated time.
+ * Usage: tcp_client <server-hostname> <port> <nbytes> */
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+static int64_t now_ns(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    return (int64_t)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+int main(int argc, char **argv) {
+    if (argc < 4)
+        return 2;
+    const char *host = argv[1];
+    const char *port = argv[2];
+    long nbytes = atol(argv[3]);
+
+    struct addrinfo hints = {0}, *res;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    int gai = getaddrinfo(host, port, &hints, &res);
+    if (gai != 0) {
+        fprintf(stderr, "getaddrinfo failed: %d\n", gai);
+        return 1;
+    }
+
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) {
+        perror("socket");
+        return 1;
+    }
+    int64_t t0 = now_ns();
+    if (connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+        perror("connect");
+        return 1;
+    }
+    int64_t t_conn = now_ns();
+    printf("connected in %lld us\n", (long long)((t_conn - t0) / 1000));
+    freeaddrinfo(res);
+
+    char chunk[4096];
+    long sent = 0, rcvd = 0, errors = 0;
+    long recv_expect = 0;
+    char rbuf[8192];
+    while (sent < nbytes) {
+        long n = nbytes - sent < (long)sizeof(chunk) ? nbytes - sent
+                                                     : (long)sizeof(chunk);
+        for (long i = 0; i < n; i++)
+            chunk[i] = (char)((sent + i) % 251);
+        long off = 0;
+        while (off < n) {
+            ssize_t w = write(fd, chunk + off, n - off);
+            if (w < 0) {
+                perror("write");
+                return 1;
+            }
+            off += w;
+            sent += w;
+        }
+        /* drain whatever echo is available without blocking hard */
+        while (rcvd < sent) {
+            ssize_t r = recv(fd, rbuf, sizeof(rbuf),
+                             rcvd + (long)sizeof(rbuf) < sent ? 0 : MSG_DONTWAIT);
+            if (r < 0)
+                break; /* EAGAIN */
+            if (r == 0)
+                break;
+            for (ssize_t i = 0; i < r; i++)
+                if (rbuf[i] != (char)((recv_expect + i) % 251))
+                    errors++;
+            recv_expect += r;
+            rcvd += r;
+        }
+    }
+    shutdown(fd, SHUT_WR);
+    while (rcvd < nbytes) {
+        ssize_t r = read(fd, rbuf, sizeof(rbuf));
+        if (r < 0) {
+            perror("read");
+            return 1;
+        }
+        if (r == 0)
+            break;
+        for (ssize_t i = 0; i < r; i++)
+            if (rbuf[i] != (char)((recv_expect + i) % 251))
+                errors++;
+        recv_expect += r;
+        rcvd += r;
+    }
+    int64_t t1 = now_ns();
+    close(fd);
+    printf("echoed %ld/%ld bytes, %ld errors, %lld us\n", rcvd, nbytes, errors,
+           (long long)((t1 - t0) / 1000));
+    return (rcvd == nbytes && errors == 0) ? 0 : 1;
+}

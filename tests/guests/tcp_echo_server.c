@@ -1,0 +1,105 @@
+/* Epoll-based TCP echo server guest: accepts `nconns` connections, echoes
+ * every byte until peer EOF, then exits. Exercises listen/accept/epoll/
+ * nonblocking reads against the simulated TCP stack.
+ * Usage: tcp_echo_server <port> <nconns> */
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+    if (argc < 3)
+        return 2;
+    int port = atoi(argv[1]);
+    int want = atoi(argv[2]);
+
+    int lfd = socket(AF_INET, SOCK_STREAM, 0);
+    if (lfd < 0) {
+        perror("socket");
+        return 1;
+    }
+    int one = 1;
+    setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    struct sockaddr_in sa = {0};
+    sa.sin_family = AF_INET;
+    sa.sin_addr.s_addr = htonl(INADDR_ANY);
+    sa.sin_port = htons(port);
+    if (bind(lfd, (struct sockaddr *)&sa, sizeof(sa)) != 0) {
+        perror("bind");
+        return 1;
+    }
+    if (listen(lfd, 16) != 0) {
+        perror("listen");
+        return 1;
+    }
+
+    int ep = epoll_create1(0);
+    struct epoll_event ev = {0};
+    ev.events = EPOLLIN;
+    ev.data.fd = lfd;
+    epoll_ctl(ep, EPOLL_CTL_ADD, lfd, &ev);
+
+    int done = 0;
+    long total = 0;
+    char buf[8192];
+    while (done < want) {
+        struct epoll_event evs[16];
+        int n = epoll_wait(ep, evs, 16, 30000);
+        if (n < 0) {
+            perror("epoll_wait");
+            return 1;
+        }
+        if (n == 0) {
+            fprintf(stderr, "timeout\n");
+            return 1;
+        }
+        for (int i = 0; i < n; i++) {
+            int fd = evs[i].data.fd;
+            if (fd == lfd) {
+                struct sockaddr_in peer;
+                socklen_t pl = sizeof(peer);
+                int cfd = accept(lfd, (struct sockaddr *)&peer, &pl);
+                if (cfd < 0) {
+                    perror("accept");
+                    return 1;
+                }
+                printf("accept from %s:%d\n", inet_ntoa(peer.sin_addr),
+                       ntohs(peer.sin_port));
+                struct epoll_event cev = {0};
+                cev.events = EPOLLIN;
+                cev.data.fd = cfd;
+                epoll_ctl(ep, EPOLL_CTL_ADD, cfd, &cev);
+            } else {
+                ssize_t r = read(fd, buf, sizeof(buf));
+                if (r < 0) {
+                    perror("read");
+                    return 1;
+                }
+                if (r == 0) { /* peer EOF: close our side too */
+                    epoll_ctl(ep, EPOLL_CTL_DEL, fd, NULL);
+                    close(fd);
+                    done++;
+                    continue;
+                }
+                total += r;
+                ssize_t off = 0;
+                while (off < r) {
+                    ssize_t w = write(fd, buf + off, r - off);
+                    if (w < 0) {
+                        perror("write");
+                        return 1;
+                    }
+                    off += w;
+                }
+            }
+        }
+    }
+    printf("served %d conns, %ld bytes\n", done, total);
+    return 0;
+}
