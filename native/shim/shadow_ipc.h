@@ -81,6 +81,9 @@ enum {
     VSYS_RESOLVE = 34,   /* buf=name -> a[2]=ip */
     VSYS_GETRANDOM = 35, /* a[1]=n -> buf */
     VSYS_DUP = 36,       /* a[1]=fd -> new fd */
+    VSYS_OPEN = 37,      /* buf=path a[1]=flags a[2]=mode -> fd (virtual
+                          * paths only: /dev/urandom etc.; everything else
+                          * passes through natively inside the sandbox cwd) */
 };
 
 typedef struct {

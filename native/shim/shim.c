@@ -68,8 +68,8 @@ static void ipc_call(ShimMsg *m) {
     shim_channel_recv(&g_shm->to_shim, m, -1);
 }
 
-static int64_t vsys(int code, int64_t a1, int64_t a2, int64_t a3,
-                    const void *out_buf, uint32_t out_len, ShimMsg *reply) {
+static int64_t vsys_ex(int code, int64_t a1, int64_t a2, int64_t a3, int64_t a5,
+                       const void *out_buf, uint32_t out_len, ShimMsg *reply) {
     ShimMsg m;
     memset(&m, 0, offsetof(ShimMsg, buf));
     m.kind = SHIM_MSG_SYSCALL;
@@ -77,6 +77,7 @@ static int64_t vsys(int code, int64_t a1, int64_t a2, int64_t a3,
     m.a[1] = a1;
     m.a[2] = a2;
     m.a[3] = a3;
+    m.a[5] = a5;
     m.a[4] = g_unapplied; /* every trip reports accumulated local latency */
     g_unapplied = 0;
     m.buf_len = 0;
@@ -90,6 +91,11 @@ static int64_t vsys(int code, int64_t a1, int64_t a2, int64_t a3,
     if (reply)
         *reply = m;
     return m.ret;
+}
+
+static int64_t vsys(int code, int64_t a1, int64_t a2, int64_t a3,
+                    const void *out_buf, uint32_t out_len, ShimMsg *reply) {
+    return vsys_ex(code, a1, a2, a3, 0, out_buf, out_len, reply);
 }
 
 /* ---- local time (reference shim_sys.c:58-90) ---- */
@@ -306,7 +312,8 @@ ssize_t sendto(int fd, const void *buf, size_t n, int flags,
     int64_t ip = -1, port = -1;
     if (addr)
         addr_to_parts(addr, len, &ip, &port);
-    int64_t r = vsys(VSYS_SENDTO, fd, ip, port, buf, (uint32_t)n, NULL);
+    int64_t r = vsys_ex(VSYS_SENDTO, fd, ip, port, (flags & MSG_DONTWAIT) != 0,
+                        buf, (uint32_t)n, NULL);
     if (r < 0) {
         errno = (int)-r;
         return -1;
@@ -547,6 +554,65 @@ int dup(int fd) {
     return (int)r;
 }
 
+/* ---- open family: virtual device files ----
+ * The reference's RegularFile opens real files natively, special-casing
+ * /dev/null and /dev/*random for determinism (regular_file.c); the managed
+ * process is chdir'd into its per-host data dir (shim.c:383-470
+ * SHADOW_WORKING_DIR), so relative native opens are already sandboxed.
+ * We mirror that split: only the paths whose *content* must be simulated
+ * (deterministic randomness) become virtual fds; everything else is a raw
+ * native open inside the sandbox cwd. */
+
+static int is_virtual_path(const char *path) {
+    return path && (strcmp(path, "/dev/urandom") == 0 ||
+                    strcmp(path, "/dev/random") == 0);
+}
+
+int open(const char *path, int flags, ...) {
+    va_list ap;
+    va_start(ap, flags);
+    mode_t mode = (mode_t)va_arg(ap, unsigned int);
+    va_end(ap);
+    if (!g_active || !is_virtual_path(path))
+        return (int)syscall(SYS_open, path, flags, mode);
+    int64_t r = vsys(VSYS_OPEN, flags, mode, 0, path, (uint32_t)strlen(path) + 1, NULL);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    return (int)r;
+}
+
+int open64(const char *path, int flags, ...) {
+    va_list ap;
+    va_start(ap, flags);
+    mode_t mode = (mode_t)va_arg(ap, unsigned int);
+    va_end(ap);
+    return open(path, flags, mode);
+}
+
+int openat(int dirfd, const char *path, int flags, ...) {
+    va_list ap;
+    va_start(ap, flags);
+    mode_t mode = (mode_t)va_arg(ap, unsigned int);
+    va_end(ap);
+    if (!g_active || !is_virtual_path(path))
+        return (int)syscall(SYS_openat, dirfd, path, flags, mode);
+    return open(path, flags, mode);
+}
+
+int openat64(int dirfd, const char *path, int flags, ...) {
+    va_list ap;
+    va_start(ap, flags);
+    mode_t mode = (mode_t)va_arg(ap, unsigned int);
+    va_end(ap);
+    return openat(dirfd, path, flags, mode);
+}
+
+int creat(const char *path, mode_t mode) {
+    return open(path, O_CREAT | O_WRONLY | O_TRUNC, mode);
+}
+
 /* ---- eventfd / timerfd ---- */
 
 int eventfd(unsigned int initval, int flags) {
@@ -697,6 +763,10 @@ static int any_vfd(const struct shim_pollfd *fds, unsigned long n) {
 
 static int shim_poll_ns(struct shim_pollfd *fds, unsigned long nfds,
                         int64_t timeout_ns) {
+    if (nfds * sizeof(struct shim_pollfd) > SHIM_BUF_SIZE) {
+        errno = EINVAL; /* pollfd set exceeds the IPC payload window */
+        return -1;
+    }
     ShimMsg reply;
     int64_t r = vsys(VSYS_POLL, (int64_t)nfds, timeout_ns, 0, fds,
                      (uint32_t)(nfds * sizeof(struct shim_pollfd)), &reply);

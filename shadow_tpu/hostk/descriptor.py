@@ -448,6 +448,30 @@ class Epoll(File):
 
 
 # --------------------------------------------------------------------------
+# Deterministic random device (reference: regular_file.c special-cases
+# /dev/random + /dev/urandom so guests draw from the host RNG stream, not
+# the real kernel's)
+
+
+class RandomFile(File):
+    def __init__(self, draw: "Callable[[int], bytes]"):
+        super().__init__()
+        self._draw = draw
+
+    def readable(self) -> bool:
+        return True
+
+    def writable(self) -> bool:
+        return True
+
+    def read(self, n: int) -> "bytes | int":
+        return self._draw(n)
+
+    def write(self, data: bytes) -> int:
+        return len(data)  # writes to /dev/urandom are accepted and ignored
+
+
+# --------------------------------------------------------------------------
 # UDP socket (moved from kernel.py; reference: descriptor/socket/inet/udp.rs)
 
 

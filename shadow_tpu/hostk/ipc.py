@@ -62,6 +62,7 @@ VSYS_UNAME = 33
 VSYS_RESOLVE = 34
 VSYS_GETRANDOM = 35
 VSYS_DUP = 36
+VSYS_OPEN = 37
 
 VSYS_NAMES = {
     VSYS_NANOSLEEP: "nanosleep",
@@ -100,6 +101,7 @@ VSYS_NAMES = {
     VSYS_RESOLVE: "getaddrinfo",
     VSYS_GETRANDOM: "getrandom",
     VSYS_DUP: "dup",
+    VSYS_OPEN: "open",
 }
 
 

@@ -63,6 +63,7 @@ from shadow_tpu.hostk.descriptor import (
     EventFd,
     File,
     PipeEnd,
+    RandomFile,
     TimerFd,
     UdpSocket,
     make_pipe,
@@ -71,9 +72,11 @@ from shadow_tpu.hostk.dns import Dns
 from shadow_tpu.hostk.strace import StraceFile
 from shadow_tpu.simtime import SIM_START_UNIX_NS, TIME_MAX
 
+from shadow_tpu.hostk.descriptor import VFD_BASE
+
 EPHEMERAL_PORT_BASE = 10_000
-VFD_BASE = 1000
 LOOPBACK_LATENCY_NS = 1_000  # same-host delivery when the graph has no self-path
+LOCALHOST_NET = 127 << 24  # 127.0.0.0/8 -> the sending host itself
 
 O_NONBLOCK = 0x800
 F_GETFL = 3
@@ -198,9 +201,14 @@ class ManagedProcess:
         self.strace = StraceFile(
             outdir / f"{exe}.{self.vpid}.strace", self.vpid, mode=self.kernel.strace_mode
         )
+        # run the process chdir'd into its per-host data dir so native
+        # (non-interposed) relative file access is sandboxed there, exactly
+        # like the reference's SHADOW_WORKING_DIR chdir (shim.c:383-470)
+        args = [str(pathlib.Path(self.spec.args[0]).resolve())] + list(self.spec.args[1:])
         self.popen = subprocess.Popen(
-            self.spec.args,
+            args,
             env=env,
+            cwd=outdir,
             stdout=open(self._stdout_path, "wb"),
             stderr=open(self._stderr_path, "wb"),
             stdin=subprocess.DEVNULL,
@@ -541,7 +549,7 @@ class NetKernel:
 
     def _sys_resolve(self, proc, msg):
         name = I.msg_payload(msg).split(b"\0")[0].decode(errors="replace")
-        if name == proc.host.name:
+        if name == proc.host.name or name in ("localhost", "localhost.localdomain"):
             proc._reply(0, a=(0, 0, proc.host.ip))
             return True
         ip = self.dns.resolve(name)
@@ -558,6 +566,17 @@ class NetKernel:
 
     def _sys_exit(self, proc, msg):
         proc._reply(0)
+        return True
+
+    def _sys_open(self, proc, msg):
+        """Virtual-path open (reference regular_file.c special paths); the
+        shim passes everything else through natively in the sandbox cwd."""
+        path = I.msg_payload(msg).split(b"\0")[0].decode(errors="replace")
+        if path in ("/dev/urandom", "/dev/random"):
+            f = RandomFile(lambda n, h=proc.host: self._random_bytes(h, min(n, I.SHIM_BUF_SIZE)))
+            proc._reply(proc.fdtab.alloc(f))
+            return True
+        proc._reply(-ENOENT)
         return True
 
     # --- descriptor ops ---------------------------------------------------
@@ -674,7 +693,7 @@ class NetKernel:
             return self._tcp_recv(proc, f, n, dontwait)
         if isinstance(f, UdpSocket):
             return self._udp_recv(proc, f, n, dontwait)
-        if isinstance(f, (PipeEnd, EventFd, TimerFd)):
+        if isinstance(f, (PipeEnd, EventFd, TimerFd, RandomFile)):
             r = f.read(n)
             if isinstance(r, int) and r == -EAGAIN and not (f.nonblock or dontwait):
                 def check(pf=f, pn=n):
@@ -712,7 +731,7 @@ class NetKernel:
             return self._tcp_send(proc, f, data, dontwait)
         if isinstance(f, UdpSocket):
             return self._udp_sendto(proc, f, data, -1, -1)
-        if isinstance(f, (PipeEnd, EventFd)):
+        if isinstance(f, (PipeEnd, EventFd, RandomFile)):
             r = f.write(data)
             if r == -EAGAIN and not (f.nonblock or dontwait):
                 def check(pf=f, pd=data):
@@ -758,6 +777,9 @@ class NetKernel:
             proto = PROTO_TCP
         else:
             proc._reply(-ENOTSOCK)
+            return True
+        if f.bound_port:  # Linux: rebinding a bound socket is EINVAL
+            proc._reply(-EINVAL)
             return True
         port = port or host.alloc_port(proto)
         if (proto, port) in host.ports:
@@ -818,7 +840,7 @@ class NetKernel:
         if f is None:
             proc._reply(-EBADF)
             return True
-        ip, port = int(msg.a[2]), int(msg.a[3])
+        ip, port = self._norm_ip(proc.host, int(msg.a[2])), int(msg.a[3])
         if isinstance(f, UdpSocket):
             f.peer = (ip, port)
             proc._reply(0)
@@ -921,12 +943,21 @@ class NetKernel:
             return True
         data = I.msg_payload(msg)
         ip, port = int(msg.a[2]), int(msg.a[3])
+        if ip != -1:
+            ip = self._norm_ip(proc.host, ip)
+        dontwait = bool(int(msg.a[5]))  # MSG_DONTWAIT forwarded by the shim
         if isinstance(f, T.TcpSocket):
-            return self._tcp_send(proc, f, data, dontwait=False)
+            return self._tcp_send(proc, f, data, dontwait=dontwait)
         if isinstance(f, UdpSocket):
             return self._udp_sendto(proc, f, data, ip, port)
         proc._reply(-ENOTSOCK)
         return True
+
+    @staticmethod
+    def _norm_ip(host: HostKernel, ip: int) -> int:
+        """127.0.0.0/8 means the sending host itself (the reference routes
+        loopback via a dedicated localhost interface, namespace.rs:26)."""
+        return host.ip if (ip >> 24) == 127 else ip
 
     def _udp_sendto(self, proc, sock: UdpSocket, data: bytes, ip: int, port: int) -> bool:
         host = proc.host
@@ -1017,6 +1048,9 @@ class NetKernel:
         nfds = int(msg.a[1])
         timeout_ns = int(msg.a[2])
         raw = I.msg_payload(msg)
+        if nfds * 8 > len(raw):  # shim clamps payloads to SHIM_BUF_SIZE
+            proc._reply(-EINVAL)
+            return True
         entries = []  # (fd, events)
         for i in range(nfds):
             fd, events, _rev = struct.unpack_from("<ihh", raw, i * 8)
@@ -1178,6 +1212,8 @@ class NetKernel:
         sock = dst.ports.get((PROTO_UDP, port))
         if not isinstance(sock, UdpSocket):
             return  # nobody bound: drop (no ICMP in v1)
+        if sock.peer is not None and sock.peer != (src_ip, src_port):
+            return  # connected UDP sockets only accept their peer's datagrams
         sock.deliver(data, src_ip, src_port)
 
     # --- TCP segment plane -------------------------------------------------
@@ -1276,4 +1312,5 @@ _DISPATCH = {
     I.VSYS_RESOLVE: NetKernel._sys_resolve,
     I.VSYS_GETRANDOM: NetKernel._sys_getrandom,
     I.VSYS_DUP: NetKernel._sys_dup,
+    I.VSYS_OPEN: NetKernel._sys_open,
 }

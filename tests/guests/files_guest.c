@@ -1,0 +1,60 @@
+/* Exercises the file-system story of managed processes: native file I/O
+ * sandboxed into the per-host data dir (the kernel chdirs us there, like
+ * the reference's SHADOW_WORKING_DIR), and the virtualized deterministic
+ * /dev/urandom (reference regular_file.c special paths). */
+#define _GNU_SOURCE
+#include <fcntl.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#define CHECK(name, cond)                                                      \
+    printf("%s %s\n", (cond) ? "PASS" : "FAIL", name)
+
+int main(void) {
+    /* native relative I/O lands in the sandbox cwd */
+    FILE *f = fopen("guest_out.txt", "w");
+    CHECK("fopen_w", f != NULL);
+    CHECK("fwrite", fputs("written by guest", f) >= 0);
+    fclose(f);
+    char buf[64] = {0};
+    f = fopen("guest_out.txt", "r");
+    CHECK("fopen_r", f != NULL);
+    CHECK("fread", fgets(buf, sizeof(buf), f) != NULL);
+    CHECK("file_data", strcmp(buf, "written by guest") == 0);
+    fclose(f);
+
+    struct stat st;
+    CHECK("stat", stat("guest_out.txt", &st) == 0 && st.st_size == 16);
+    CHECK("mkdir", mkdir("subdir", 0755) == 0);
+    CHECK("access", access("guest_out.txt", R_OK | W_OK) == 0);
+
+    /* virtual /dev/urandom: deterministic per host seed */
+    int rfd = open("/dev/urandom", O_RDONLY);
+    CHECK("urandom_open", rfd >= 1000); /* a virtual fd, not the real device */
+    unsigned char rnd[16];
+    CHECK("urandom_read", read(rfd, rnd, sizeof(rnd)) == (ssize_t)sizeof(rnd));
+    printf("urand ");
+    for (unsigned i = 0; i < sizeof(rnd); i++)
+        printf("%02x", rnd[i]);
+    printf("\n");
+    /* writes are accepted and ignored */
+    CHECK("urandom_close", close(rfd) == 0);
+
+    int rfd2 = open("/dev/random", O_RDONLY);
+    unsigned char rnd2[16];
+    CHECK("random_read", read(rfd2, rnd2, sizeof(rnd2)) == (ssize_t)sizeof(rnd2));
+    CHECK("streams_differ", memcmp(rnd, rnd2, sizeof(rnd)) != 0);
+    close(rfd2);
+
+    /* /dev/null stays native */
+    int nfd = open("/dev/null", O_WRONLY);
+    CHECK("devnull", nfd >= 0 && nfd < 1000 && write(nfd, "x", 1) == 1);
+    close(nfd);
+
+    unlink("guest_out.txt");
+    CHECK("unlink", access("guest_out.txt", F_OK) != 0);
+    rmdir("subdir");
+    return 0;
+}

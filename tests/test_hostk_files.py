@@ -51,6 +51,55 @@ def test_descriptor_families(tmp_path, misc_bin):
     assert "host alpha / alpha" in out  # gethostname + uname nodename
 
 
+@pytest.fixture(scope="module")
+def files_bin(tmp_path_factory):
+    out = tmp_path_factory.mktemp("guests")
+    dst = out / "files_guest"
+    subprocess.run(["cc", "-O2", "-o", str(dst), str(GUESTS / "files_guest.c")], check=True)
+    return str(dst)
+
+
+def _run_files(tmp_path, files_bin, seed=1, subdir="f"):
+    graph = two_node_graph(10, 0.0)
+    tables = compute_routing(graph).with_hosts([0, 1])
+    k = NetKernel(
+        tables,
+        host_names=["alpha", "beta"],
+        host_nodes=[0, 1],
+        seed=seed,
+        data_dir=tmp_path / subdir,
+    )
+    proc = k.add_process(ProcessSpec(host="alpha", args=[files_bin]))
+    try:
+        k.run(30 * NS_PER_SEC)
+    finally:
+        k.shutdown()
+    return k, proc
+
+
+def test_file_sandbox_and_virtual_devices(tmp_path, files_bin):
+    k, proc = _run_files(tmp_path, files_bin)
+    out = proc.stdout().decode()
+    fails = [l for l in out.splitlines() if l.startswith("FAIL")]
+    assert not fails, f"guest checks failed: {fails}\nfull output:\n{out}"
+    assert proc.exit_code == 0
+    # the sandbox cwd is the per-host data dir: the guest's mkdir/unlink all
+    # happened under it, and its stdout file lives alongside
+    host_dir = tmp_path / "f" / "alpha"
+    assert host_dir.is_dir()
+
+
+def test_urandom_deterministic_per_seed(tmp_path, files_bin):
+    _, p1 = _run_files(tmp_path, files_bin, seed=7, subdir="u7a")
+    _, p2 = _run_files(tmp_path, files_bin, seed=7, subdir="u7b")
+    _, p3 = _run_files(tmp_path, files_bin, seed=8, subdir="u8")
+    u1 = [l for l in p1.stdout().decode().splitlines() if l.startswith("urand ")]
+    u2 = [l for l in p2.stdout().decode().splitlines() if l.startswith("urand ")]
+    u3 = [l for l in p3.stdout().decode().splitlines() if l.startswith("urand ")]
+    assert u1 and u1 == u2  # same seed -> same /dev/urandom stream
+    assert u1 != u3  # different seed -> different stream
+
+
 def test_random_deterministic_per_seed(tmp_path, misc_bin):
     _, p1 = _run(tmp_path, misc_bin, seed=7, subdir="s7a")
     _, p2 = _run(tmp_path, misc_bin, seed=7, subdir="s7b")
