@@ -108,6 +108,12 @@ class ExperimentalOptions:
     outbox_capacity: int = 16
     rounds_per_chunk: int = 256
     max_iters_per_round: int = 1_000_000
+    # managed-process options (reference: configuration.rs:298-455)
+    strace_logging_mode: str = "standard"  # "off" | "standard" | "deterministic"
+    use_pcap: bool = False
+    syscall_latency_ns: int = 1_000
+    vdso_latency_ns: int = 10
+    max_unapplied_cpu_latency_ns: int = 1_000_000
 
     @classmethod
     def from_dict(cls, d: dict) -> "ExperimentalOptions":
@@ -115,6 +121,13 @@ class ExperimentalOptions:
         if "runahead" in d:
             ra = d.pop("runahead")
             out.runahead_ns = None if ra is None else parse_time_ns(ra)
+        for lat_key, attr in (
+            ("syscall_latency", "syscall_latency_ns"),
+            ("vdso_latency", "vdso_latency_ns"),
+            ("max_unapplied_cpu_latency", "max_unapplied_cpu_latency_ns"),
+        ):
+            if lat_key in d:
+                setattr(out, attr, parse_time_ns(d.pop(lat_key)))
         for k in (
             "scheduler",
             "use_dynamic_runahead",
@@ -122,9 +135,16 @@ class ExperimentalOptions:
             "outbox_capacity",
             "rounds_per_chunk",
             "max_iters_per_round",
+            "strace_logging_mode",
+            "use_pcap",
         ):
             if k in d:
                 setattr(out, k, d.pop(k))
+        if out.strace_logging_mode not in ("off", "standard", "deterministic"):
+            raise ValueError(
+                f"unknown strace_logging_mode {out.strace_logging_mode!r} "
+                "(expected 'off', 'standard', or 'deterministic')"
+            )
         if out.scheduler not in ("tpu", "cpu-ref"):
             raise ValueError(f"unknown scheduler {out.scheduler!r} (expected 'tpu' or 'cpu-ref')")
         _reject_unknown("experimental", d)
@@ -133,17 +153,51 @@ class ExperimentalOptions:
 
 @dataclasses.dataclass
 class ProcessOptions:
-    path: str = ""  # registered model name (reference: executable path)
-    args: dict = dataclasses.field(default_factory=dict)
+    """One process on a host. `path` is either a registered scripted-model
+    name (on-device simulation) or a real executable path (managed process
+    under the LD_PRELOAD shim — the reference's only mode,
+    configuration.rs:560-640). Scripted models take `args` as a mapping;
+    executables take a string or list of argv words."""
+
+    path: str = ""
+    args: "dict | list" = dataclasses.field(default_factory=dict)
     start_time_ns: int = 0
+    environment: dict = dataclasses.field(default_factory=dict)
+    expected_final_state: str = "exited"  # "exited" | "running"
+    shutdown_time_ns: Optional[int] = None
 
     @classmethod
     def from_dict(cls, d: dict) -> "ProcessOptions":
+        import shlex
+
         out = cls()
         out.path = d.pop("path")
-        out.args = d.pop("args", {}) or {}
+        args = d.pop("args", {})
+        if args is None:
+            args = {}
+        if isinstance(args, str):
+            args = shlex.split(args)
+        if isinstance(args, list):
+            out.args = [str(a) for a in args]
+        elif isinstance(args, dict):
+            out.args = args
+        else:
+            raise ValueError(f"process.args must be a mapping, list, or string, got {type(args)}")
         if "start_time" in d:
             out.start_time_ns = parse_time_ns(d.pop("start_time"))
+        if "shutdown_time" in d:
+            st = d.pop("shutdown_time")
+            out.shutdown_time_ns = None if st is None else parse_time_ns(st)
+        env = d.pop("environment", {}) or {}
+        if not isinstance(env, dict):
+            raise ValueError("process.environment must be a mapping")
+        out.environment = {str(k): str(v) for k, v in env.items()}
+        efs = d.pop("expected_final_state", "exited")
+        if efs not in ("exited", "running"):
+            raise ValueError(
+                f"process.expected_final_state must be 'exited' or 'running', got {efs!r}"
+            )
+        out.expected_final_state = efs
         _reject_unknown("process", d)
         return out
 

@@ -97,6 +97,7 @@ class ProcessSpec:
     start_ns: int = 0
     expected_final_state: str = "exited"  # "exited" | "running"
     environment: dict = dataclasses.field(default_factory=dict)
+    shutdown_ns: Optional[int] = None  # kill the process at this sim time
 
 
 class Waiter:
@@ -134,7 +135,7 @@ class Waiter:
             self.proc.waiter = None
 
     def _cb(self, _f: File) -> None:
-        if self.done:
+        if self.done or self.proc.state == "exited":
             return
         self.proc.now = max(self.proc.now, self.kernel.now)
         if self.check():
@@ -143,7 +144,7 @@ class Waiter:
             self.kernel._service(self.proc)
 
     def _timeout_fire(self) -> None:
-        if self.done:
+        if self.done or self.proc.state == "exited":
             return
         self.proc.now = max(self.proc.now, self.kernel.now)
         if self.check():  # raced: became ready at the same instant
@@ -326,6 +327,8 @@ class NetKernel:
         max_unapplied_ns: int = 1_000_000,
         strace_mode: str = "standard",
         pcap: bool = False,
+        host_ips: "Optional[list[int]]" = None,
+        heartbeat_ns: int = 0,
     ):
         self.tables = tables
         self.lat = np.asarray(tables.lat_ns)
@@ -346,7 +349,8 @@ class NetKernel:
         self.host_by_name: dict[str, HostKernel] = {}
         base_ip = (11 << 24) | 1  # 11.0.0.1, reference ip auto-assign graph/mod.rs:356-422
         for i, (name, node) in enumerate(zip(host_names, host_nodes)):
-            hk = HostKernel(self, name, i, node, base_ip + i)
+            ip = host_ips[i] if host_ips is not None else base_ip + i
+            hk = HostKernel(self, name, i, node, ip)
             self.hosts.append(hk)
             self.host_by_ip[hk.ip] = hk
             self.host_by_name[name] = hk
@@ -360,6 +364,13 @@ class NetKernel:
         self.events: list[tuple[int, int, Callable[[], None]]] = []
         self.procs: list[ManagedProcess] = []
         self.event_log: list[tuple[int, str]] = []
+        self.heartbeat_ns = heartbeat_ns
+        self._next_hb = heartbeat_ns if heartbeat_ns > 0 else None
+        # per-syscall-name counts, aggregated like the reference's
+        # worker-local-then-merged counters (worker.rs:428-475, sim_stats.rs)
+        import collections
+
+        self.syscall_counts: "collections.Counter[str]" = collections.Counter()
         self.pcap = None
         if pcap:
             from shadow_tpu.utils.pcap import PcapDir
@@ -391,7 +402,24 @@ class NetKernel:
         self.procs.append(proc)
         host.procs.append(proc)
         self._push(spec.start_ns, lambda p=proc: self._start_proc(p))
+        if spec.shutdown_ns is not None:
+            # the reference sends shutdown_signal at shutdown_time
+            # (configuration.rs:560-640); signal plumbing is not built yet,
+            # so terminate natively — still at a deterministic sim time
+            self._push(spec.shutdown_ns, lambda p=proc: self._shutdown_proc(p))
         return proc
+
+    def _shutdown_proc(self, proc: ManagedProcess) -> None:
+        if proc.state == "exited":
+            return
+        self.event_log.append((self.now, f"shutdown {proc.host.name}/{proc.vpid}"))
+        if proc.waiter is not None:  # blocked: cancel the pending wakeup
+            proc.waiter._detach()
+        proc.state = "exited"  # set before kill so queued events no-op
+        for fd in proc.fdtab.fds():  # release ports, FIN/teardown live TCP
+            self._close_fd(proc, fd)
+        proc.kill()
+        proc.exit_code = 0  # a requested shutdown is a clean exit
 
     # --- event machinery --------------------------------------------------
 
@@ -400,16 +428,65 @@ class NetKernel:
         self._seq += 1
 
     def run(self, until_ns: int) -> None:
+        hb = self.heartbeat_ns
         try:
             while self.events:
-                t, _, fn = heapq.heappop(self.events)
+                t = self.events[0][0]
+                if self._next_hb is not None and self._next_hb <= until_ns and self._next_hb < t:
+                    self.now = max(self.now, self._next_hb)
+                    self._heartbeat()
+                    self._next_hb += hb
+                    continue
                 if t > until_ns:
-                    heapq.heappush(self.events, (t, 0, fn))
                     break
+                _, _, fn = heapq.heappop(self.events)
                 self.now = max(self.now, t)
                 fn()
+            # sim time runs to until_ns even after the queue drains; keep
+            # the heartbeat cadence to the end (manager.rs:738-780)
+            while self._next_hb is not None and self._next_hb <= until_ns:
+                self.now = max(self.now, self._next_hb)
+                self._heartbeat()
+                self._next_hb += hb
         finally:
             self.shutdown_check()
+
+    def _heartbeat(self) -> None:
+        """Manager heartbeat + per-host tracker lines (reference:
+        manager.rs:738-780 heartbeat messages; tracker.c:407-450 per-host
+        bytes in/out heartbeats)."""
+        from shadow_tpu.utils.shadow_log import slog
+
+        total_sc = sum(self.syscall_counts.values())
+        slog(
+            "info",
+            self.now,
+            "manager",
+            f"heartbeat: {total_sc} syscalls, "
+            f"{sum(h.packets_sent for h in self.hosts)} packets",
+        )
+        for h in self.hosts:
+            if not h.procs:
+                continue
+            slog(
+                "info",
+                self.now,
+                h.name,
+                f"tracker: bytes_sent={h.bytes_sent} bytes_recv={h.bytes_recv} "
+                f"packets_sent={h.packets_sent} packets_dropped={h.packets_dropped}",
+            )
+
+    def stats(self) -> dict:
+        """Aggregate counters for sim-stats.json (reference sim_stats.rs)."""
+        return {
+            "syscalls_handled": sum(self.syscall_counts.values()),
+            "syscall_counts": dict(sorted(self.syscall_counts.items())),
+            "packets_sent": sum(h.packets_sent for h in self.hosts),
+            "packets_dropped": sum(h.packets_dropped for h in self.hosts),
+            "bytes_sent": sum(h.bytes_sent for h in self.hosts),
+            "bytes_recv": sum(h.bytes_recv for h in self.hosts),
+            "processes": len(self.procs),
+        }
 
     def shutdown(self) -> None:
         for p in self.procs:
@@ -421,7 +498,7 @@ class NetKernel:
         """Reap naturally-exited children (expected_final_state,
         reference configuration.rs:582 + worker.rs:485-487)."""
         for p in self.procs:
-            if p.state == "exited" and p.popen is not None:
+            if p.state == "exited" and p.popen is not None and p.exit_code is None:
                 p.exit_code = p.popen.wait()
 
     def unexpected_final_states(self) -> "list[str]":
@@ -475,6 +552,7 @@ class NetKernel:
         # fold shim-accumulated local latency, then charge the syscall cost
         proc.now += int(msg.a[4]) + self.syscall_latency_ns
         name = I.VSYS_NAMES.get(code, str(code))
+        self.syscall_counts[name] += 1
         args = tuple(int(x) for x in msg.a[1:4])
         proc.syscall_log.append((proc.now, name, args))
         proc._pending = (name, ", ".join(str(a) for a in args))
@@ -533,6 +611,8 @@ class NetKernel:
         return False
 
     def _wake_sleep(self, proc: ManagedProcess, t: int) -> None:
+        if proc.state == "exited":  # killed (e.g. shutdown_time) while asleep
+            return
         proc.now = max(proc.now, t)
         proc.state = "running"
         proc._reply(0)
@@ -957,7 +1037,7 @@ class NetKernel:
     def _norm_ip(host: HostKernel, ip: int) -> int:
         """127.0.0.0/8 means the sending host itself (the reference routes
         loopback via a dedicated localhost interface, namespace.rs:26)."""
-        return host.ip if (ip >> 24) == 127 else ip
+        return host.ip if (ip >> 24) == (LOCALHOST_NET >> 24) else ip
 
     def _udp_sendto(self, proc, sock: UdpSocket, data: bytes, ip: int, port: int) -> bool:
         host = proc.host

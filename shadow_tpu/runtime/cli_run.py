@@ -38,4 +38,6 @@ def run_from_config(path: str, show_config: bool = False) -> int:
         results = manager.run()
     except CapacityError as e:
         raise CliUserError(str(e)) from e
+    if results.unexpected_final_states:
+        return 1
     return 0 if results.packets_unroutable == 0 else 1

@@ -43,6 +43,7 @@ class HostInstance:
     # (reference: sim_config.rs Bandwidth resolution)
     bw_up_bits: int = -1
     bw_down_bits: int = -1
+    spec: object = None  # the HostOptions this instance was expanded from
 
 
 @dataclasses.dataclass
@@ -55,6 +56,10 @@ class SimResults:
     wall_seconds: float
     sim_seconds: float
     scheduler: str
+    # managed-process runs only: processes whose final state did not match
+    # their expected_final_state (reference worker.rs:485-487)
+    unexpected_final_states: "list[str]" = dataclasses.field(default_factory=list)
+    extra_stats: dict = dataclasses.field(default_factory=dict)
 
     @property
     def sim_sec_per_wall_sec(self) -> float:
@@ -66,6 +71,7 @@ class Manager:
         self.config = config
         self.graph = self._load_graph()
         self.hosts = self._expand_hosts()
+        self.managed_mode = self._validate_process_specs()
         self.ip = IpAssignment()
         for h in self.hosts:
             if h.ip >= 0:
@@ -73,6 +79,36 @@ class Manager:
         for h in self.hosts:
             if h.ip < 0:
                 h.ip = self.ip.assign_auto(h.index)
+
+    def _validate_process_specs(self) -> bool:
+        """Classify the run as scripted-model or managed-executable mode and
+        validate the specs up front (construction = world validation)."""
+        import pathlib
+
+        from shadow_tpu.models.registry import _REGISTRY
+
+        kinds = {h.model_name in _REGISTRY for h in self.hosts}
+        if kinds == {True, False}:
+            raise ValueError(
+                "config mixes scripted models and executable paths across hosts; "
+                "run them in separate simulations"
+            )
+        if kinds != {False}:
+            return False
+        for h in self.hosts:
+            exe = pathlib.Path(h.model_name)
+            if not (exe.is_file() and os.access(exe, os.X_OK)):
+                raise ValueError(
+                    f"hosts.{h.name}: process path {h.model_name!r} is neither a "
+                    f"registered model nor an executable file"
+                )
+            p = h.spec.processes[0]
+            if not isinstance(p.args, list):
+                raise ValueError(
+                    f"hosts.{h.name}: executable processes take args as a string or "
+                    f"list, not a mapping"
+                )
+        return True
 
     def _load_graph(self) -> NetworkGraph:
         g = self.config.network.graph
@@ -119,6 +155,7 @@ class Manager:
                         model_name=spec.processes[0].path,
                         bw_up_bits=bw_up,
                         bw_down_bits=bw_down,
+                        spec=spec,
                     )
                 )
         return out
@@ -126,6 +163,9 @@ class Manager:
     def run(self) -> SimResults:
         cfgo = self.config
         num_hosts = len(self.hosts)
+
+        if self.managed_mode:
+            return self._run_managed()
 
         model_names = {h.model_name for h in self.hosts}
         if len(model_names) != 1:
@@ -234,6 +274,80 @@ class Manager:
         self._write_outputs(results)
         return results
 
+    def _run_managed(self) -> SimResults:
+        """Run real executables as managed processes under the LD_PRELOAD
+        shim on the CPU-side serial kernel (the reference's only execution
+        mode; spawn/resume managed_thread.rs:156-267). The device engine
+        stays out of the loop until the hybrid scheduler lands; network
+        semantics (latency/loss/routing/DNS) are shared with it via
+        RoutingTables + the threefry RNG streams."""
+        from shadow_tpu.hostk.kernel import NetKernel, ProcessSpec
+
+        cfgo = self.config
+        host_node = [h.node_index for h in self.hosts]
+        tables = compute_routing(self.graph, use_shortest_path=cfgo.network.use_shortest_path)
+        tables = tables.with_hosts(host_node)
+
+        k = NetKernel(
+            tables,
+            host_names=[h.name for h in self.hosts],
+            host_nodes=host_node,
+            seed=cfgo.general.seed,
+            data_dir=cfgo.general.data_directory,
+            syscall_latency_ns=cfgo.experimental.syscall_latency_ns,
+            vdso_latency_ns=cfgo.experimental.vdso_latency_ns,
+            max_unapplied_ns=cfgo.experimental.max_unapplied_cpu_latency_ns,
+            strace_mode=cfgo.experimental.strace_logging_mode,
+            pcap=cfgo.experimental.use_pcap,
+            host_ips=[h.ip for h in self.hosts],
+            heartbeat_ns=cfgo.general.heartbeat_interval_ns,
+        )
+        for h in self.hosts:
+            p = h.spec.processes[0]
+            k.add_process(
+                ProcessSpec(
+                    host=h.name,
+                    args=[p.path] + list(p.args),
+                    start_ns=p.start_time_ns,
+                    expected_final_state=p.expected_final_state,
+                    environment=p.environment,
+                    shutdown_ns=p.shutdown_time_ns,
+                )
+            )
+
+        end = cfgo.general.stop_time_ns
+        slog("info", 0, "manager",
+             f"starting: {len(self.hosts)} hosts, scheduler=managed, "
+             f"{len(k.procs)} managed processes, stop={fmt_time_ns(end)}")
+        t0 = time.perf_counter()
+        try:
+            k.run(end)
+        finally:
+            k.shutdown()
+        wall = time.perf_counter() - t0
+
+        stats = k.stats()
+        unexpected = k.unexpected_final_states()
+        for u in unexpected:
+            slog("warning", end, "manager", f"unexpected final state: {u}")
+        results = SimResults(
+            hosts=self.hosts,
+            events_handled=stats["syscalls_handled"],
+            packets_sent=stats["packets_sent"],
+            packets_dropped=stats["packets_dropped"],
+            packets_unroutable=0,
+            wall_seconds=wall,
+            sim_seconds=end / NS_PER_SEC,
+            scheduler="managed",
+            unexpected_final_states=unexpected,
+            extra_stats=stats,
+        )
+        slog("info", end, "manager",
+             f"finished: {stats['syscalls_handled']} syscalls, "
+             f"{stats['packets_sent']} packets in {wall:.2f}s wall")
+        self._write_outputs(results)
+        return results
+
     def _write_outputs(self, results: SimResults) -> None:
         data_dir = self.config.general.data_directory
         os.makedirs(data_dir, exist_ok=True)
@@ -249,6 +363,7 @@ class Manager:
                     "sim_seconds": results.sim_seconds,
                     "scheduler": results.scheduler,
                     "num_hosts": len(results.hosts),
+                    **results.extra_stats,
                 },
                 f,
                 indent=2,
