@@ -140,6 +140,8 @@ class ExperimentalOptions:
         ):
             if k in d:
                 setattr(out, k, d.pop(k))
+        if out.strace_logging_mode is False:  # YAML 1.1 parses bare `off` as False
+            out.strace_logging_mode = "off"
         if out.strace_logging_mode not in ("off", "standard", "deterministic"):
             raise ValueError(
                 f"unknown strace_logging_mode {out.strace_logging_mode!r} "
@@ -198,6 +200,8 @@ class ProcessOptions:
                 f"process.expected_final_state must be 'exited' or 'running', got {efs!r}"
             )
         out.expected_final_state = efs
+        if out.shutdown_time_ns is not None and out.shutdown_time_ns <= out.start_time_ns:
+            raise ValueError("process.shutdown_time must be after start_time")
         _reject_unknown("process", d)
         return out
 

@@ -516,6 +516,8 @@ class NetKernel:
     # --- process driving --------------------------------------------------
 
     def _start_proc(self, proc: ManagedProcess) -> None:
+        if proc.state != "pending":  # e.g. shut down before its start event
+            return
         proc.spawn(self.now)
         self.event_log.append((self.now, f"start {proc.host.name} vpid={proc.vpid}"))
         # reply START_RES: a[0] = virtual pid

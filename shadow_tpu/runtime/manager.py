@@ -94,6 +94,12 @@ class Manager:
                 "run them in separate simulations"
             )
         if kinds != {False}:
+            for h in self.hosts:
+                if not isinstance(h.spec.processes[0].args, dict):
+                    raise ValueError(
+                        f"hosts.{h.name}: scripted model {h.model_name!r} takes args "
+                        f"as a mapping, not a string or list"
+                    )
             return False
         for h in self.hosts:
             exe = pathlib.Path(h.model_name)

@@ -100,6 +100,39 @@ def test_cli_managed_end_to_end(tmp_path, guest_bins):
     assert "11.0.0.1 server" in hosts and "11.0.0.2 client" in hosts
 
 
+def test_cli_double_run_strace_identical(tmp_path, guest_bins):
+    """The reference's determinism suite runs the same config twice with
+    deterministic strace mode and diffs the outputs
+    (src/test/determinism/CMakeLists.txt:1-40, determinism1_compare.cmake).
+    Here: full CLI path, byte-identical strace files + stdout + stats."""
+    outs = []
+    for run in ("run1", "run2"):
+        d = tmp_path / run
+        cfg = d / "shadow.yaml"
+        d.mkdir()
+        cfg.write_text(
+            CONFIG.format(
+                data_dir=d / "data",
+                server_bin=guest_bins["udp_echo"],
+                client_bin=guest_bins["udp_client"],
+            )
+            + "experimental:\n  strace_logging_mode: deterministic\n"
+        )
+        assert run_from_config(str(cfg)) == 0
+        data = d / "data"
+        files = {}
+        for p in sorted(data.rglob("*")):
+            if p.suffix in (".strace", ".stdout") or p.name == "hosts":
+                files[str(p.relative_to(data))] = p.read_bytes()
+        stats = json.loads((data / "sim-stats.json").read_text())
+        stats.pop("wall_seconds")
+        files["sim-stats"] = json.dumps(stats, sort_keys=True)
+        outs.append(files)
+    assert outs[0].keys() == outs[1].keys()
+    for name in outs[0]:
+        assert outs[0][name] == outs[1][name], f"run-twice diff in {name}"
+
+
 SHUTDOWN_CONFIG = """
 general:
   stop_time: 5 sec
