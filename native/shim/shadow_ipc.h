@@ -84,6 +84,11 @@ enum {
     VSYS_OPEN = 37,      /* buf=path a[1]=flags a[2]=mode -> fd (virtual
                           * paths only: /dev/urandom etc.; everything else
                           * passes through natively inside the sandbox cwd) */
+    VSYS_UBIND = 38,     /* a[1]=fd a[2]=abstract, buf=path */
+    VSYS_UCONNECT = 39,  /* a[1]=fd a[2]=abstract, buf=path */
+    VSYS_USENDTO = 40,   /* a[1]=fd a[2]=abstract a[3]=dontwait,
+                            buf=[u16 pathlen][path][payload] */
+    VSYS_SOCKETPAIR = 41, /* a[1]=domain a[2]=vtype -> fd, a[2]=fd2 */
 };
 
 typedef struct {

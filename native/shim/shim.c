@@ -40,6 +40,7 @@
 #include <sys/socket.h>
 #include <sys/stat.h>
 #include <sys/syscall.h>
+#include <sys/un.h>
 #include <sys/utsname.h>
 #include <time.h>
 #include <unistd.h>
@@ -258,9 +259,55 @@ static void parts_to_addr(int64_t ip, int64_t port, struct sockaddr *addr,
     *len = sizeof(in);
 }
 
+/* ---- unix-domain address helpers (sockaddr_un <-> path + abstract) ---- */
+
+static int unix_addr_parse(const struct sockaddr *addr, socklen_t len,
+                           int *abstract, const char **path, size_t *plen) {
+    const struct sockaddr_un *un = (const struct sockaddr_un *)addr;
+    size_t off = offsetof(struct sockaddr_un, sun_path);
+    if (!addr || len < off)
+        return -1;
+    size_t avail = len - off;
+    if (avail == 0)
+        return -1; /* autobind not supported */
+    if (un->sun_path[0] == '\0') {
+        *abstract = 1;
+        *path = un->sun_path + 1;
+        *plen = avail - 1; /* abstract names use the full length */
+    } else {
+        *abstract = 0;
+        *path = un->sun_path;
+        *plen = strnlen(un->sun_path, avail);
+    }
+    if (*plen > 107)
+        return -1;
+    return 0;
+}
+
+static void unix_addr_fill(struct sockaddr *addr, socklen_t *len, int abstract,
+                           const char *path, size_t plen) {
+    struct sockaddr_un un;
+    memset(&un, 0, sizeof(un));
+    un.sun_family = AF_UNIX;
+    if (plen > 107)
+        plen = 107;
+    size_t off = offsetof(struct sockaddr_un, sun_path);
+    socklen_t want;
+    if (abstract) {
+        memcpy(un.sun_path + 1, path, plen);
+        want = (socklen_t)(off + 1 + plen);
+    } else {
+        memcpy(un.sun_path, path, plen);
+        want = plen ? (socklen_t)(off + plen + 1) : (socklen_t)sizeof(sa_family_t);
+    }
+    socklen_t cp = *len < (socklen_t)sizeof(un) ? *len : (socklen_t)sizeof(un);
+    memcpy(addr, &un, cp);
+    *len = want;
+}
+
 int socket(int domain, int type, int protocol) {
     int base = type & 0xFF;
-    if (!g_active || domain != AF_INET ||
+    if (!g_active || (domain != AF_INET && domain != AF_UNIX) ||
         (base != SOCK_DGRAM && base != SOCK_STREAM))
         return (int)syscall(SYS_socket, domain, type, protocol);
     /* forward base type + the SOCK_NONBLOCK bit (== O_NONBLOCK) */
@@ -273,9 +320,28 @@ int socket(int domain, int type, int protocol) {
     return (int)r;
 }
 
+static int bind_or_connect_unix(int code, int fd, const struct sockaddr *addr,
+                                socklen_t len) {
+    int abstract;
+    const char *path;
+    size_t plen;
+    if (unix_addr_parse(addr, len, &abstract, &path, &plen) != 0) {
+        errno = EINVAL;
+        return -1;
+    }
+    int64_t r = vsys(code, fd, abstract, 0, path, (uint32_t)plen, NULL);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    return 0;
+}
+
 int bind(int fd, const struct sockaddr *addr, socklen_t len) {
     if (!g_active || !is_vfd(fd))
         return (int)syscall(SYS_bind, fd, addr, len);
+    if (addr && addr->sa_family == AF_UNIX)
+        return bind_or_connect_unix(VSYS_UBIND, fd, addr, len);
     int64_t ip, port;
     if (addr_to_parts(addr, len, &ip, &port) != 0) {
         errno = EINVAL;
@@ -292,6 +358,8 @@ int bind(int fd, const struct sockaddr *addr, socklen_t len) {
 int connect(int fd, const struct sockaddr *addr, socklen_t len) {
     if (!g_active || !is_vfd(fd))
         return (int)syscall(SYS_connect, fd, addr, len);
+    if (addr && addr->sa_family == AF_UNIX)
+        return bind_or_connect_unix(VSYS_UCONNECT, fd, addr, len);
     int64_t ip, port;
     if (addr_to_parts(addr, len, &ip, &port) != 0) {
         errno = EINVAL;
@@ -305,10 +373,55 @@ int connect(int fd, const struct sockaddr *addr, socklen_t len) {
     return 0;
 }
 
+int socketpair(int domain, int type, int protocol, int sv[2]) {
+    int base = type & 0xFF;
+    if (!g_active || domain != AF_UNIX ||
+        (base != SOCK_DGRAM && base != SOCK_STREAM))
+        return (int)syscall(SYS_socketpair, domain, type, protocol, sv);
+    int64_t vtype = base | (type & SOCK_NONBLOCK ? 0x800 : 0);
+    ShimMsg reply;
+    int64_t r = vsys(VSYS_SOCKETPAIR, domain, vtype, protocol, NULL, 0, &reply);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    sv[0] = (int)r;
+    sv[1] = (int)reply.a[2];
+    return 0;
+}
+
 ssize_t sendto(int fd, const void *buf, size_t n, int flags,
                const struct sockaddr *addr, socklen_t len) {
     if (!g_active || !is_vfd(fd))
         return syscall(SYS_sendto, fd, buf, n, flags, addr, len);
+    if (addr && addr->sa_family == AF_UNIX) {
+        /* dgram with a destination path: [u16 plen][path][payload] */
+        int abstract;
+        const char *path;
+        size_t plen;
+        if (unix_addr_parse(addr, len, &abstract, &path, &plen) != 0) {
+            errno = EINVAL;
+            return -1;
+        }
+        static char tmp[SHIM_BUF_SIZE]; /* single-threaded shim */
+        size_t cap = SHIM_BUF_SIZE - 2 - plen;
+        if (n > cap) { /* dgram sends are all-or-nothing, never truncated */
+            errno = EMSGSIZE;
+            return -1;
+        }
+        tmp[0] = (char)(plen & 0xFF);
+        tmp[1] = (char)(plen >> 8);
+        memcpy(tmp + 2, path, plen);
+        memcpy(tmp + 2 + plen, buf, n);
+        int64_t r = vsys(VSYS_USENDTO, fd, abstract,
+                         (flags & MSG_DONTWAIT) != 0, tmp,
+                         (uint32_t)(2 + plen + n), NULL);
+        if (r < 0) {
+            errno = (int)-r;
+            return -1;
+        }
+        return (ssize_t)r;
+    }
     int64_t ip = -1, port = -1;
     if (addr)
         addr_to_parts(addr, len, &ip, &port);
@@ -338,6 +451,14 @@ ssize_t recvfrom(int fd, void *buf, size_t n, int flags,
         errno = (int)-r;
         return -1;
     }
+    if (reply.a[4] == 1) { /* unix socket: buf = [path][payload] */
+        size_t plen = (size_t)reply.a[2];
+        size_t cp = (size_t)r < n ? (size_t)r : n;
+        memcpy(buf, reply.buf + plen, cp);
+        if (addr && len)
+            unix_addr_fill(addr, len, (int)reply.a[3], reply.buf, plen);
+        return (ssize_t)cp;
+    }
     size_t cp = (size_t)r < n ? (size_t)r : n;
     memcpy(buf, reply.buf, cp);
     if (addr && len)
@@ -360,7 +481,10 @@ int getsockname(int fd, struct sockaddr *addr, socklen_t *len) {
         errno = (int)-r;
         return -1;
     }
-    parts_to_addr(reply.a[2], reply.a[3], addr, len);
+    if (reply.a[4] == 1)
+        unix_addr_fill(addr, len, (int)reply.a[2], reply.buf, reply.buf_len);
+    else
+        parts_to_addr(reply.a[2], reply.a[3], addr, len);
     return 0;
 }
 
@@ -398,8 +522,12 @@ int accept4(int fd, struct sockaddr *addr, socklen_t *len, int flags) {
         errno = (int)-r;
         return -1;
     }
-    if (addr && len)
-        parts_to_addr(reply.a[2], reply.a[3], addr, len);
+    if (addr && len) {
+        if (reply.a[4] == 1) /* unix: unnamed peer */
+            unix_addr_fill(addr, len, 0, "", 0);
+        else
+            parts_to_addr(reply.a[2], reply.a[3], addr, len);
+    }
     return (int)r;
 }
 
@@ -427,7 +555,10 @@ int getpeername(int fd, struct sockaddr *addr, socklen_t *len) {
         errno = (int)-r;
         return -1;
     }
-    parts_to_addr(reply.a[2], reply.a[3], addr, len);
+    if (reply.a[4] == 1)
+        unix_addr_fill(addr, len, (int)reply.a[2], reply.buf, reply.buf_len);
+    else
+        parts_to_addr(reply.a[2], reply.a[3], addr, len);
     return 0;
 }
 

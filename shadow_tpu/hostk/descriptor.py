@@ -472,6 +472,146 @@ class RandomFile(File):
 
 
 # --------------------------------------------------------------------------
+# Unix-domain sockets (reference: descriptor/socket/unix.rs, 2,269 LoC —
+# stream + dgram incl. the abstract namespace, abstract_unix_ns.rs).
+# Host-local by construction: addresses live in a per-host namespace map
+# keyed (abstract, path); filesystem socket inodes are not materialized in
+# the sandbox dir (connect() resolves purely through the namespace map).
+
+SOCK_STREAM = 1
+SOCK_DGRAM = 2
+
+
+class UnixSocket(File):
+    CAPACITY = 212992  # per-direction buffer, net.core.wmem_default-ish
+    DGRAM_QUEUE = 64  # max queued datagrams per receiver
+
+    def __init__(self, stype: int):
+        super().__init__()
+        self.stype = stype
+        self.bound: "Optional[tuple[bool, str]]" = None  # (abstract, path)
+        # stream state
+        self.listening = False
+        self.backlog = 0
+        self.pending: "deque[UnixSocket]" = deque()  # children awaiting accept
+        self.peer: "Optional[UnixSocket]" = None
+        self.recv_buf = bytearray()
+        self.peer_closed = False
+        self.shut_wr = False
+        # dgram state: queue of ((abstract, path) | None, payload)
+        self.dgrams: "deque[tuple[Optional[tuple[bool, str]], bytes]]" = deque()
+        self.default_dest: "Optional[UnixSocket]" = None
+
+    # --- poll interface ---------------------------------------------------
+
+    def readable(self) -> bool:
+        if self.listening:
+            return len(self.pending) > 0
+        if self.stype == SOCK_DGRAM:
+            return len(self.dgrams) > 0
+        return len(self.recv_buf) > 0 or self.peer_closed
+
+    def writable(self) -> bool:
+        if self.listening:
+            return False
+        if self.stype == SOCK_DGRAM:
+            return True  # bounded only by the receiver's queue at send time
+        return (
+            self.peer is not None
+            and not self.peer_closed
+            and not self.shut_wr
+            and len(self.peer.recv_buf) < self.CAPACITY
+        )
+
+    def hup(self) -> bool:
+        return self.stype == SOCK_STREAM and self.peer_closed and not self.recv_buf
+
+    # --- stream ops -------------------------------------------------------
+
+    def stream_send(self, data: bytes) -> int:
+        if self.peer_closed or self.shut_wr:
+            return -EPIPE  # peer closed: EPIPE even though peer ref is gone
+        if self.peer is None:
+            return -ENOTCONN
+        space = self.CAPACITY - len(self.peer.recv_buf)
+        if space <= 0:
+            return -EAGAIN
+        take = data[:space]
+        self.peer.recv_buf.extend(take)
+        self.peer.notify()
+        return len(take)
+
+    def stream_recv(self, n: int) -> "bytes | int":
+        if self.peer is None and not self.peer_closed:
+            return -ENOTCONN
+        if self.recv_buf:
+            out = bytes(self.recv_buf[:n])
+            del self.recv_buf[:n]
+            if self.peer is not None:
+                self.peer.notify()  # writer may have space again
+            return out
+        if self.peer_closed:
+            return b""  # EOF
+        return -EAGAIN
+
+    # --- dgram ops --------------------------------------------------------
+
+    def dgram_send_to(self, dest: "UnixSocket", data: bytes) -> int:
+        if dest.closed:
+            return -ECONNREFUSED  # e.g. the other socketpair end was closed
+        if len(dest.dgrams) >= self.DGRAM_QUEUE:
+            return -EAGAIN
+        dest.dgrams.append((self.bound, bytes(data)))
+        dest.notify()
+        return len(data)
+
+    def dgram_recv(self) -> "Optional[tuple[Optional[tuple[bool, str]], bytes]]":
+        if not self.dgrams:
+            return None
+        d = self.dgrams.popleft()
+        self.notify()  # senders blocked on a full queue re-check
+        return d
+
+    # --- lifecycle --------------------------------------------------------
+
+    def connect_to_listener(self, listener: "UnixSocket") -> "int | UnixSocket":
+        """Stream connect: create the server-side child and queue it for
+        accept (unix.rs connect path). Returns the child, or -errno."""
+        if len(listener.pending) >= max(listener.backlog, 1):
+            return -EAGAIN
+        child = UnixSocket(SOCK_STREAM)
+        child.bound = listener.bound
+        child.peer = self
+        self.peer = child
+        listener.pending.append(child)
+        listener.notify()
+        return child
+
+    def shutdown_write(self) -> int:
+        if self.stype != SOCK_STREAM or (self.peer is None and not self.peer_closed):
+            return -ENOTCONN
+        self.shut_wr = True
+        if self.peer is not None:
+            self.peer.peer_closed = True
+            self.peer.notify()
+        return 0
+
+    def on_close(self, kernel, proc) -> None:
+        if self.peer is not None:
+            self.peer.peer_closed = True
+            self.peer.notify()
+            self.peer.peer = None
+            self.peer = None
+        for child in self.pending:  # un-accepted connections are reset
+            if child.peer is not None:
+                child.peer.peer_closed = True
+                child.peer.notify()
+                child.peer.peer = None
+        self.pending.clear()
+        super().on_close(kernel, proc)
+
+
+# --------------------------------------------------------------------------
 # UDP socket (moved from kernel.py; reference: descriptor/socket/inet/udp.rs)
 
 

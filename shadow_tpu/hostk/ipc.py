@@ -63,6 +63,10 @@ VSYS_RESOLVE = 34
 VSYS_GETRANDOM = 35
 VSYS_DUP = 36
 VSYS_OPEN = 37
+VSYS_UBIND = 38
+VSYS_UCONNECT = 39
+VSYS_USENDTO = 40
+VSYS_SOCKETPAIR = 41
 
 VSYS_NAMES = {
     VSYS_NANOSLEEP: "nanosleep",
@@ -102,6 +106,10 @@ VSYS_NAMES = {
     VSYS_GETRANDOM: "getrandom",
     VSYS_DUP: "dup",
     VSYS_OPEN: "open",
+    VSYS_UBIND: "bind",  # unix-domain variants share the libc name in straces
+    VSYS_UCONNECT: "connect",
+    VSYS_USENDTO: "sendto",
+    VSYS_SOCKETPAIR: "socketpair",
 }
 
 

@@ -46,6 +46,7 @@ from shadow_tpu.hostk.descriptor import (
     EAGAIN,
     EBADF,
     EADDRINUSE,
+    ECONNREFUSED,
     EDESTADDRREQ,
     EINPROGRESS,
     EINVAL,
@@ -64,8 +65,11 @@ from shadow_tpu.hostk.descriptor import (
     File,
     PipeEnd,
     RandomFile,
+    SOCK_DGRAM,
+    SOCK_STREAM,
     TimerFd,
     UdpSocket,
+    UnixSocket,
     make_pipe,
 )
 from shadow_tpu.hostk.dns import Dns
@@ -120,6 +124,7 @@ class Waiter:
         self.files = files
         self.check = check
         self.done = False
+        self._checking = False  # guards re-entrant notify during check()
         self.on_timeout = on_timeout
         proc.waiter = self
         for f in files:
@@ -134,20 +139,27 @@ class Waiter:
         if self.proc.waiter is self:
             self.proc.waiter = None
 
+    def _run_check(self) -> bool:
+        self._checking = True
+        try:
+            return self.check()
+        finally:
+            self._checking = False
+
     def _cb(self, _f: File) -> None:
-        if self.done or self.proc.state == "exited":
+        if self.done or self._checking or self.proc.state == "exited":
             return
         self.proc.now = max(self.proc.now, self.kernel.now)
-        if self.check():
+        if self._run_check():
             self._detach()
             self.proc.state = "running"
             self.kernel._service(self.proc)
 
     def _timeout_fire(self) -> None:
-        if self.done or self.proc.state == "exited":
+        if self.done or self._checking or self.proc.state == "exited":
             return
         self.proc.now = max(self.proc.now, self.kernel.now)
-        if self.check():  # raced: became ready at the same instant
+        if self._run_check():  # raced: became ready at the same instant
             self._detach()
             self.proc.state = "running"
             self.kernel._service(self.proc)
@@ -278,6 +290,9 @@ class HostKernel:
         self.ports: dict[tuple[int, int], File] = {}
         # established/handshaking TCP, keyed (local_port, remote_ip, remote_port)
         self.tcp_conns: dict[tuple[int, int, int], T.TcpSocket] = {}
+        # unix-domain namespace: (abstract, path) -> bound socket
+        # (reference: unix.rs bind + abstract_unix_ns.rs)
+        self.unix_ns: "dict[tuple[bool, str], UnixSocket]" = {}
         self.next_port = EPHEMERAL_PORT_BASE
         self.rng_counter = 0
         self.procs: list[ManagedProcess] = []
@@ -590,6 +605,11 @@ class NetKernel:
                 pk = (PROTO_TCP, f.bound_port)
                 if f.bound_port and host.ports.get(pk) is f and f.state in (T.CLOSED, T.LISTEN):
                     del host.ports[pk]
+            if isinstance(f, UnixSocket) and f.bound is not None:
+                # accepted children share the listener's address; only the
+                # namespace owner releases it
+                if host.unix_ns.get(f.bound) is f:
+                    del host.unix_ns[f.bound]
             f.on_close(self, proc)
         return 0
 
@@ -775,6 +795,8 @@ class NetKernel:
             return self._tcp_recv(proc, f, n, dontwait)
         if isinstance(f, UdpSocket):
             return self._udp_recv(proc, f, n, dontwait)
+        if isinstance(f, UnixSocket):
+            return self._unix_recv(proc, f, n, dontwait, include_path=False)
         if isinstance(f, (PipeEnd, EventFd, TimerFd, RandomFile)):
             r = f.read(n)
             if isinstance(r, int) and r == -EAGAIN and not (f.nonblock or dontwait):
@@ -813,6 +835,8 @@ class NetKernel:
             return self._tcp_send(proc, f, data, dontwait)
         if isinstance(f, UdpSocket):
             return self._udp_sendto(proc, f, data, -1, -1)
+        if isinstance(f, UnixSocket):
+            return self._unix_send(proc, f, data, dontwait)
         if isinstance(f, (PipeEnd, EventFd, RandomFile)):
             r = f.write(data)
             if r == -EAGAIN and not (f.nonblock or dontwait):
@@ -833,10 +857,16 @@ class NetKernel:
     # --- sockets ----------------------------------------------------------
 
     def _sys_socket(self, proc, msg):
+        domain = int(msg.a[1])
         stype = int(msg.a[2]) & 0xFF
         nonblock = bool(int(msg.a[2]) & 0x800)  # SOCK_NONBLOCK
-        if stype == 2:  # SOCK_DGRAM
-            s: File = UdpSocket()
+        if domain == 1:  # AF_UNIX
+            if stype not in (SOCK_STREAM, SOCK_DGRAM):
+                proc._reply(-EINVAL)
+                return True
+            s: File = UnixSocket(stype)
+        elif stype == 2:  # SOCK_DGRAM
+            s = UdpSocket()
         elif stype == 1:  # SOCK_STREAM
             s = T.TcpSocket(proc.host)
         else:
@@ -880,6 +910,14 @@ class NetKernel:
         if f is None:
             proc._reply(-EBADF)
             return True
+        if isinstance(f, UnixSocket):
+            if f.stype != SOCK_STREAM or f.bound is None:
+                proc._reply(-EINVAL)
+                return True
+            f.listening = True
+            f.backlog = max(int(msg.a[2]), 1)
+            proc._reply(0)
+            return True
         if not isinstance(f, T.TcpSocket):
             proc._reply(-ENOTSOCK if not isinstance(f, UdpSocket) else -EINVAL)
             return True
@@ -895,6 +933,29 @@ class NetKernel:
         if f is None:
             proc._reply(-EBADF)
             return True
+        if isinstance(f, UnixSocket):
+            if not f.listening:
+                proc._reply(-EINVAL)
+                return True
+            nonblock_child = bool(int(msg.a[2]))
+
+            def try_accept_unix() -> bool:
+                if not f.pending:
+                    return False
+                child = f.pending.popleft()
+                child.nonblock = nonblock_child
+                cfd = proc.fdtab.alloc(child)
+                f.notify()  # a backlog slot freed: blocked connectors re-check
+                proc._reply(cfd, a=(0, 0, 0, 0, 1))  # a[4]=1: unix peer addr
+                return True
+
+            if try_accept_unix():
+                return True
+            if f.nonblock:
+                proc._reply(-EAGAIN)
+                return True
+            Waiter(self, proc, [f], try_accept_unix)
+            return False
         if not isinstance(f, T.TcpSocket) or f.state != T.LISTEN:
             proc._reply(-EINVAL)
             return True
@@ -956,7 +1017,7 @@ class NetKernel:
 
     def _sys_shutdown(self, proc, msg):
         f = self._file(proc, int(msg.a[1]))
-        if not isinstance(f, T.TcpSocket):
+        if not isinstance(f, (T.TcpSocket, UnixSocket)):
             proc._reply(-EBADF if f is None else -ENOTSOCK)
             return True
         how = int(msg.a[2])
@@ -966,9 +1027,21 @@ class NetKernel:
             proc._reply(0)  # SHUT_RD: no-op in this model
         return True
 
+    @staticmethod
+    def _unix_addr_reply(proc, addr: "Optional[tuple[bool, str]]") -> None:
+        """Reply with a unix address: a[4]=1 marker, a[2]=abstract flag,
+        buf=path bytes (empty for unbound)."""
+        if addr is None:
+            proc._reply(0, a=(0, 0, 0, 0, 1))
+        else:
+            proc._reply(0, a=(0, 0, int(addr[0]), 0, 1), buf=addr[1].encode())
+
     def _sys_getsockname(self, proc, msg):
         f = self._file(proc, int(msg.a[1]))
         host = proc.host
+        if isinstance(f, UnixSocket):
+            self._unix_addr_reply(proc, f.bound)
+            return True
         if isinstance(f, UdpSocket):
             proc._reply(0, a=(0, 0, host.ip, f.bound_port))
         elif isinstance(f, T.TcpSocket):
@@ -979,6 +1052,14 @@ class NetKernel:
 
     def _sys_getpeername(self, proc, msg):
         f = self._file(proc, int(msg.a[1]))
+        if isinstance(f, UnixSocket):
+            if f.stype == SOCK_STREAM and f.peer is not None:
+                self._unix_addr_reply(proc, f.peer.bound)
+            elif f.stype == SOCK_DGRAM and f.default_dest is not None:
+                self._unix_addr_reply(proc, f.default_dest.bound)
+            else:
+                proc._reply(-ENOTCONN)
+            return True
         if isinstance(f, UdpSocket):
             if f.peer is None:
                 proc._reply(-ENOTCONN)
@@ -991,6 +1072,106 @@ class NetKernel:
                 proc._reply(0, a=(0, 0, f.remote_ip, f.remote_port))
         else:
             proc._reply(-EBADF if f is None else -ENOTSOCK)
+        return True
+
+    # --- unix-domain sockets (reference: descriptor/socket/unix.rs) -------
+
+    @staticmethod
+    def _unix_key(msg, payload: "Optional[bytes]" = None) -> "tuple[bool, str]":
+        path = (payload if payload is not None else I.msg_payload(msg)).decode(
+            errors="surrogateescape"
+        )
+        return (bool(int(msg.a[2])), path)
+
+    def _sys_ubind(self, proc, msg):
+        f = self._file(proc, int(msg.a[1]))
+        if not isinstance(f, UnixSocket):
+            proc._reply(-EBADF if f is None else -ENOTSOCK)
+            return True
+        key = self._unix_key(msg)
+        if f.bound is not None or not key[1]:
+            proc._reply(-EINVAL)
+            return True
+        if key in proc.host.unix_ns:
+            proc._reply(-EADDRINUSE)
+            return True
+        f.bound = key
+        proc.host.unix_ns[key] = f
+        proc._reply(0)
+        return True
+
+    def _sys_uconnect(self, proc, msg):
+        f = self._file(proc, int(msg.a[1]))
+        if not isinstance(f, UnixSocket):
+            proc._reply(-EBADF if f is None else -ENOTSOCK)
+            return True
+        key = self._unix_key(msg)
+        dest = proc.host.unix_ns.get(key)
+        if dest is None or dest.stype != f.stype:
+            proc._reply(-ECONNREFUSED)
+            return True
+        if f.stype == SOCK_DGRAM:
+            f.default_dest = dest
+            proc._reply(0)
+            return True
+        if f.peer is not None:
+            proc._reply(-EISCONN)
+            return True
+        if not dest.listening:
+            proc._reply(-ECONNREFUSED)
+            return True
+        r = f.connect_to_listener(dest)
+        if isinstance(r, int) and r == -EAGAIN and not f.nonblock:
+            # full backlog: a blocking connect waits for accept() to drain
+            # a slot (Linux blocks; only nonblocking connect sees EAGAIN)
+            def check() -> bool:
+                if dest.closed:
+                    proc._reply(-ECONNREFUSED)
+                    return True
+                rr = f.connect_to_listener(dest)
+                if isinstance(rr, int) and rr == -EAGAIN:
+                    return False
+                proc._reply(rr if isinstance(rr, int) else 0)
+                return True
+
+            Waiter(self, proc, [dest], check)
+            return False
+        proc._reply(r if isinstance(r, int) else 0)
+        return True
+
+    def _sys_usendto(self, proc, msg):
+        """Dgram sendto with an explicit destination path:
+        buf = [u16 pathlen][path][payload], a[2]=abstract, a[3]=dontwait."""
+        f = self._file(proc, int(msg.a[1]))
+        if not isinstance(f, UnixSocket):
+            proc._reply(-EBADF if f is None else -ENOTSOCK)
+            return True
+        if f.stype != SOCK_DGRAM:
+            proc._reply(-EISCONN)  # stream sendto with addr
+            return True
+        raw = I.msg_payload(msg)
+        plen = struct.unpack("<H", raw[:2])[0]
+        key = self._unix_key(msg, raw[2 : 2 + plen])
+        data = raw[2 + plen :]
+        dest = proc.host.unix_ns.get(key)
+        if dest is None or dest.stype != SOCK_DGRAM:
+            proc._reply(-ECONNREFUSED)
+            return True
+        return self._unix_dgram_send(proc, f, dest, data, dontwait=bool(int(msg.a[3])))
+
+    def _sys_socketpair(self, proc, msg):
+        stype = int(msg.a[2]) & 0xFF
+        nonblock = bool(int(msg.a[2]) & 0x800)
+        if int(msg.a[1]) != 1 or stype not in (SOCK_STREAM, SOCK_DGRAM):
+            proc._reply(-EINVAL)
+            return True
+        a, b = UnixSocket(stype), UnixSocket(stype)
+        a.nonblock = b.nonblock = nonblock
+        if stype == SOCK_STREAM:
+            a.peer, b.peer = b, a
+        else:
+            a.default_dest, b.default_dest = b, a
+        proc._reply(proc.fdtab.alloc(a), a=(0, 0, proc.fdtab.alloc(b)))
         return True
 
     def _sys_setsockopt(self, proc, msg):
@@ -1032,7 +1213,52 @@ class NetKernel:
             return self._tcp_send(proc, f, data, dontwait=dontwait)
         if isinstance(f, UdpSocket):
             return self._udp_sendto(proc, f, data, ip, port)
+        if isinstance(f, UnixSocket):  # send() on a connected unix socket
+            return self._unix_send(proc, f, data, dontwait=dontwait)
         proc._reply(-ENOTSOCK)
+        return True
+
+    def _unix_send(self, proc, sock: UnixSocket, data: bytes, dontwait: bool) -> bool:
+        if sock.stype == SOCK_DGRAM:
+            dest = sock.default_dest
+            if dest is None:
+                proc._reply(-ENOTCONN)
+                return True
+            return self._unix_dgram_send(proc, sock, dest, data, dontwait)
+        r = sock.stream_send(data)
+        if r == -EAGAIN and not (sock.nonblock or dontwait):
+
+            def check() -> bool:
+                rr = sock.stream_send(data)
+                if rr == -EAGAIN:
+                    return False
+                proc._reply(rr)
+                return True
+
+            Waiter(self, proc, [sock], check)
+            return False
+        proc._reply(r)
+        return True
+
+    def _unix_dgram_send(
+        self, proc, sock: UnixSocket, dest: UnixSocket, data: bytes, dontwait: bool
+    ) -> bool:
+        if len(data) > I.SHIM_BUF_SIZE:
+            proc._reply(-EMSGSIZE)
+            return True
+        r = sock.dgram_send_to(dest, data)
+        if r == -EAGAIN and not (sock.nonblock or dontwait):
+
+            def check() -> bool:
+                rr = sock.dgram_send_to(dest, data)
+                if rr == -EAGAIN:
+                    return False
+                proc._reply(rr)
+                return True
+
+            Waiter(self, proc, [dest], check)
+            return False
+        proc._reply(r)
         return True
 
     @staticmethod
@@ -1069,7 +1295,55 @@ class NetKernel:
             return self._tcp_recv(proc, f, min(n, I.SHIM_BUF_SIZE), dontwait)
         if isinstance(f, UdpSocket):
             return self._udp_recv(proc, f, min(n, I.SHIM_BUF_SIZE), dontwait)
+        if isinstance(f, UnixSocket):
+            return self._unix_recv(proc, f, min(n, I.SHIM_BUF_SIZE), dontwait, include_path=True)
         proc._reply(-ENOTSOCK)
+        return True
+
+    def _unix_recv(
+        self, proc, sock: UnixSocket, n: int, dontwait: bool, include_path: bool
+    ) -> bool:
+        """Unix-socket receive. Reply contract when a source address rides
+        along: a[4]=1 (unix marker), a[2]=pathlen, a[3]=abstract flag,
+        buf=path+payload, ret=len(payload)."""
+
+        def attempt() -> "Optional[tuple]":
+            """-> (ret, a, buf) or None if would block."""
+            if sock.stype == SOCK_DGRAM:
+                d = sock.dgram_recv()
+                if d is None:
+                    return None
+                src, data = d
+                data = data[:n]  # excess datagram bytes are discarded (POSIX)
+                if include_path and src is not None:
+                    path = src[1].encode()
+                    # path + payload must fit the reply buffer
+                    data = data[: I.SHIM_BUF_SIZE - len(path)]
+                    return (len(data), (0, 0, len(path), int(src[0]), 1), path + data)
+                return (len(data), (0, 0, 0, 0, 1), data)
+            r = sock.stream_recv(n)
+            if r == -EAGAIN:
+                return None
+            if isinstance(r, int):
+                return (r, (), b"")
+            return (len(r), (0, 0, 0, 0, 1), r)
+
+        got = attempt()
+        if got is None:
+            if sock.nonblock or dontwait:
+                proc._reply(-EAGAIN)
+                return True
+
+            def check() -> bool:
+                g = attempt()
+                if g is None:
+                    return False
+                proc._reply(g[0], a=g[1], buf=g[2])
+                return True
+
+            Waiter(self, proc, [sock], check)
+            return False
+        proc._reply(got[0], a=got[1], buf=got[2])
         return True
 
     def _udp_recv(self, proc, sock: UdpSocket, n: int, dontwait: bool) -> bool:
@@ -1395,4 +1669,8 @@ _DISPATCH = {
     I.VSYS_GETRANDOM: NetKernel._sys_getrandom,
     I.VSYS_DUP: NetKernel._sys_dup,
     I.VSYS_OPEN: NetKernel._sys_open,
+    I.VSYS_UBIND: NetKernel._sys_ubind,
+    I.VSYS_UCONNECT: NetKernel._sys_uconnect,
+    I.VSYS_USENDTO: NetKernel._sys_usendto,
+    I.VSYS_SOCKETPAIR: NetKernel._sys_socketpair,
 }

@@ -87,7 +87,7 @@ class Manager:
 
         from shadow_tpu.models.registry import _REGISTRY
 
-        kinds = {h.model_name in _REGISTRY for h in self.hosts}
+        kinds = {p.path in _REGISTRY for h in self.hosts for p in h.spec.processes}
         if kinds == {True, False}:
             raise ValueError(
                 "config mixes scripted models and executable paths across hosts; "
@@ -95,6 +95,10 @@ class Manager:
             )
         if kinds != {False}:
             for h in self.hosts:
+                if len(h.spec.processes) != 1:
+                    raise ValueError(
+                        f"hosts.{h.name}: scripted-model hosts take exactly one process"
+                    )
                 if not isinstance(h.spec.processes[0].args, dict):
                     raise ValueError(
                         f"hosts.{h.name}: scripted model {h.model_name!r} takes args "
@@ -102,18 +106,18 @@ class Manager:
                     )
             return False
         for h in self.hosts:
-            exe = pathlib.Path(h.model_name)
-            if not (exe.is_file() and os.access(exe, os.X_OK)):
-                raise ValueError(
-                    f"hosts.{h.name}: process path {h.model_name!r} is neither a "
-                    f"registered model nor an executable file"
-                )
-            p = h.spec.processes[0]
-            if not isinstance(p.args, list):
-                raise ValueError(
-                    f"hosts.{h.name}: executable processes take args as a string or "
-                    f"list, not a mapping"
-                )
+            for p in h.spec.processes:
+                exe = pathlib.Path(p.path)
+                if not (exe.is_file() and os.access(exe, os.X_OK)):
+                    raise ValueError(
+                        f"hosts.{h.name}: process path {p.path!r} is neither a "
+                        f"registered model nor an executable file"
+                    )
+                if not isinstance(p.args, list) and p.args != {}:
+                    raise ValueError(
+                        f"hosts.{h.name}: executable processes take args as a string "
+                        f"or list, not a mapping"
+                    )
         return True
 
     def _load_graph(self) -> NetworkGraph:
@@ -134,10 +138,8 @@ class Manager:
                 raise ValueError(
                     f"hosts.{spec.name}: network_node_id {spec.network_node_id} not in graph"
                 )
-            if len(spec.processes) != 1:
-                raise ValueError(
-                    f"hosts.{spec.name}: exactly one process per host is supported currently"
-                )
+            if not spec.processes:
+                raise ValueError(f"hosts.{spec.name}: at least one process is required")
             for i in range(spec.quantity):
                 name = spec.name if spec.quantity == 1 else f"{spec.name}{i + 1}"
                 ip = -1
@@ -309,17 +311,17 @@ class Manager:
             heartbeat_ns=cfgo.general.heartbeat_interval_ns,
         )
         for h in self.hosts:
-            p = h.spec.processes[0]
-            k.add_process(
-                ProcessSpec(
-                    host=h.name,
-                    args=[p.path] + list(p.args),
-                    start_ns=p.start_time_ns,
-                    expected_final_state=p.expected_final_state,
-                    environment=p.environment,
-                    shutdown_ns=p.shutdown_time_ns,
+            for p in h.spec.processes:
+                k.add_process(
+                    ProcessSpec(
+                        host=h.name,
+                        args=[p.path] + list(p.args),
+                        start_ns=p.start_time_ns,
+                        expected_final_state=p.expected_final_state,
+                        environment=p.environment,
+                        shutdown_ns=p.shutdown_time_ns,
+                    )
                 )
-            )
 
         end = cfgo.general.stop_time_ns
         slog("info", 0, "manager",

@@ -1,0 +1,78 @@
+/* Guest test program: unix-domain stream echo across two processes on the
+ * same simulated host. Usage:
+ *   unix_echo_pair server <name> <n>
+ *   unix_echo_pair client <name> <n> <gap_ms>
+ * Abstract-namespace address <name>. The server accepts one connection and
+ * echoes n messages; the client sends n messages, checks the echoes, then
+ * shuts down. Exercises blocking accept/recv across process boundaries. */
+#include <stddef.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <time.h>
+#include <unistd.h>
+
+static void abs_addr(struct sockaddr_un *un, socklen_t *len, const char *name) {
+    memset(un, 0, sizeof(*un));
+    un->sun_family = AF_UNIX;
+    un->sun_path[0] = '\0';
+    strcpy(un->sun_path + 1, name);
+    *len = (socklen_t)(offsetof(struct sockaddr_un, sun_path) + 1 + strlen(name));
+}
+
+int main(int argc, char **argv) {
+    if (argc < 4)
+        return 2;
+    int n = atoi(argv[3]);
+    struct sockaddr_un a;
+    socklen_t alen;
+    abs_addr(&a, &alen, argv[2]);
+    char buf[512];
+
+    if (strcmp(argv[1], "server") == 0) {
+        int srv = socket(AF_UNIX, SOCK_STREAM, 0);
+        if (srv < 0 || bind(srv, (struct sockaddr *)&a, alen) != 0 ||
+            listen(srv, 2) != 0)
+            return 3;
+        int c = accept(srv, NULL, NULL); /* blocks until the client starts */
+        if (c < 0)
+            return 4;
+        for (int i = 0; i < n; i++) {
+            ssize_t r = recv(c, buf, sizeof(buf), 0);
+            if (r <= 0)
+                return 5;
+            if (send(c, buf, (size_t)r, 0) != r)
+                return 6;
+        }
+        if (recv(c, buf, sizeof(buf), 0) != 0) /* client shutdown -> EOF */
+            return 7;
+        printf("server echoed %d\n", n);
+        close(c);
+        close(srv);
+        return 0;
+    }
+
+    int gap_ms = argc > 4 ? atoi(argv[4]) : 0;
+    int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+    if (fd < 0 || connect(fd, (struct sockaddr *)&a, alen) != 0)
+        return 8;
+    for (int i = 0; i < n; i++) {
+        int len = snprintf(buf, sizeof(buf), "msg-%d", i);
+        if (send(fd, buf, (size_t)len, 0) != len)
+            return 9;
+        char echo[512];
+        ssize_t r = recv(fd, echo, sizeof(echo), 0);
+        if (r != len || memcmp(buf, echo, (size_t)len) != 0)
+            return 10;
+        if (gap_ms > 0) {
+            struct timespec ts = {gap_ms / 1000, (long)(gap_ms % 1000) * 1000000L};
+            nanosleep(&ts, NULL);
+        }
+    }
+    shutdown(fd, SHUT_WR);
+    printf("client done %d\n", n);
+    close(fd);
+    return 0;
+}

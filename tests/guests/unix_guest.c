@@ -1,0 +1,122 @@
+/* Guest test program: unix-domain sockets within one process.
+ * Exercises socketpair, abstract-namespace stream listen/connect/accept,
+ * dgram sendto/recvfrom with source addresses, getsockname/getpeername,
+ * poll readiness, and EOF on close. Prints "ok <step>" lines; exits 0
+ * only if every step passed. */
+#include <poll.h>
+#include <stddef.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#define CHECK(cond, name)                                                      \
+    do {                                                                       \
+        if (!(cond)) {                                                         \
+            printf("FAIL %s\n", name);                                         \
+            return 1;                                                          \
+        }                                                                      \
+        printf("ok %s\n", name);                                               \
+    } while (0)
+
+static void abs_addr(struct sockaddr_un *un, socklen_t *len, const char *name) {
+    memset(un, 0, sizeof(*un));
+    un->sun_family = AF_UNIX;
+    un->sun_path[0] = '\0';
+    strcpy(un->sun_path + 1, name);
+    *len = (socklen_t)(offsetof(struct sockaddr_un, sun_path) + 1 + strlen(name));
+}
+
+int main(void) {
+    /* --- socketpair ----------------------------------------------------- */
+    int sv[2];
+    CHECK(socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0, "socketpair");
+    CHECK(write(sv[0], "hello", 5) == 5, "sp-write");
+    char buf[256];
+    CHECK(read(sv[1], buf, sizeof(buf)) == 5 && memcmp(buf, "hello", 5) == 0,
+          "sp-read");
+    CHECK(send(sv[1], "back", 4, 0) == 4, "sp-send");
+    CHECK(recv(sv[0], buf, sizeof(buf), 0) == 4 && memcmp(buf, "back", 4) == 0,
+          "sp-recv");
+    close(sv[1]);
+    CHECK(read(sv[0], buf, sizeof(buf)) == 0, "sp-eof");
+    close(sv[0]);
+
+    /* --- abstract stream server/client in-process ----------------------- */
+    int srv = socket(AF_UNIX, SOCK_STREAM, 0);
+    CHECK(srv >= 0, "stream-socket");
+    struct sockaddr_un a;
+    socklen_t alen;
+    abs_addr(&a, &alen, "test-stream");
+    CHECK(bind(srv, (struct sockaddr *)&a, alen) == 0, "stream-bind");
+    CHECK(listen(srv, 4) == 0, "stream-listen");
+    struct sockaddr_un got;
+    socklen_t glen = sizeof(got);
+    CHECK(getsockname(srv, (struct sockaddr *)&got, &glen) == 0 &&
+              got.sun_family == AF_UNIX && got.sun_path[0] == '\0' &&
+              strcmp(got.sun_path + 1, "test-stream") == 0,
+          "stream-getsockname");
+
+    int cli = socket(AF_UNIX, SOCK_STREAM, 0);
+    CHECK(connect(cli, (struct sockaddr *)&a, alen) == 0, "stream-connect");
+
+    struct pollfd p = {.fd = srv, .events = POLLIN};
+    CHECK(poll(&p, 1, 0) == 1 && (p.revents & POLLIN), "stream-poll-accept");
+    int conn = accept(srv, NULL, NULL);
+    CHECK(conn >= 0, "stream-accept");
+
+    glen = sizeof(got);
+    CHECK(getpeername(cli, (struct sockaddr *)&got, &glen) == 0 &&
+              got.sun_path[0] == '\0' &&
+              strcmp(got.sun_path + 1, "test-stream") == 0,
+          "stream-getpeername");
+
+    CHECK(send(cli, "ping", 4, 0) == 4, "stream-send");
+    CHECK(recv(conn, buf, sizeof(buf), 0) == 4 && memcmp(buf, "ping", 4) == 0,
+          "stream-echo-in");
+    CHECK(send(conn, "pong", 4, 0) == 4, "stream-reply");
+    CHECK(recv(cli, buf, sizeof(buf), 0) == 4 && memcmp(buf, "pong", 4) == 0,
+          "stream-echo-out");
+    CHECK(shutdown(cli, SHUT_WR) == 0, "stream-shutdown");
+    CHECK(recv(conn, buf, sizeof(buf), 0) == 0, "stream-eof-after-shutdown");
+    close(conn);
+    close(cli);
+    close(srv);
+
+    /* connect to a closed listener must be refused */
+    int cli2 = socket(AF_UNIX, SOCK_STREAM, 0);
+    CHECK(connect(cli2, (struct sockaddr *)&a, alen) < 0, "stream-refused");
+    close(cli2);
+
+    /* --- dgram with addresses ------------------------------------------- */
+    int d1 = socket(AF_UNIX, SOCK_DGRAM, 0);
+    int d2 = socket(AF_UNIX, SOCK_DGRAM, 0);
+    struct sockaddr_un a1, a2;
+    socklen_t l1, l2;
+    abs_addr(&a1, &l1, "dg-one");
+    abs_addr(&a2, &l2, "dg-two");
+    CHECK(bind(d1, (struct sockaddr *)&a1, l1) == 0, "dgram-bind1");
+    CHECK(bind(d2, (struct sockaddr *)&a2, l2) == 0, "dgram-bind2");
+    CHECK(bind(d2, (struct sockaddr *)&a2, l2) < 0, "dgram-rebind-einval");
+    CHECK(sendto(d1, "dgram!", 6, 0, (struct sockaddr *)&a2, l2) == 6,
+          "dgram-sendto");
+    struct sockaddr_un src;
+    socklen_t slen = sizeof(src);
+    ssize_t r = recvfrom(d2, buf, sizeof(buf), 0, (struct sockaddr *)&src, &slen);
+    CHECK(r == 6 && memcmp(buf, "dgram!", 6) == 0, "dgram-recv");
+    CHECK(src.sun_family == AF_UNIX && src.sun_path[0] == '\0' &&
+              strcmp(src.sun_path + 1, "dg-one") == 0,
+          "dgram-srcaddr");
+    /* connected dgram */
+    CHECK(connect(d2, (struct sockaddr *)&a1, l1) == 0, "dgram-connect");
+    CHECK(send(d2, "reply", 5, 0) == 5, "dgram-send-connected");
+    CHECK(recv(d1, buf, sizeof(buf), 0) == 5 && memcmp(buf, "reply", 5) == 0,
+          "dgram-recv-connected");
+    close(d1);
+    close(d2);
+
+    printf("unix all ok\n");
+    return 0;
+}
