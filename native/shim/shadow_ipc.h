@@ -89,6 +89,12 @@ enum {
     VSYS_USENDTO = 40,   /* a[1]=fd a[2]=abstract a[3]=dontwait,
                             buf=[u16 pathlen][path][payload] */
     VSYS_SOCKETPAIR = 41, /* a[1]=domain a[2]=vtype -> fd, a[2]=fd2 */
+    VSYS_SIGACTION = 42, /* a[1]=sig a[2]=disposition (0 dfl, 1 ign, 2 handler) */
+    VSYS_ALARM = 43,     /* a[1]=seconds -> remaining seconds */
+    VSYS_SETITIMER = 44, /* a[1]=value ns a[2]=interval ns -> a[2],a[3] old */
+    VSYS_GETITIMER = 45, /* -> a[2]=value ns a[3]=interval ns */
+    VSYS_KILL = 46,      /* a[1]=vpid (0 = self) a[2]=sig */
+    VSYS_PAUSE = 47,     /* blocks until a signal is delivered -> -EINTR */
 };
 
 typedef struct {
@@ -97,7 +103,9 @@ typedef struct {
     int64_t a[6];
     int64_t ret;
     uint32_t buf_len;
-    uint32_t _pad;
+    uint32_t sig;      /* shadow->shim: deliver this signal before returning
+                        * (reference: pending-unblocked-signal handoff,
+                        * shim_shmem.rs:252-268 + shim_signals.c) */
     char buf[SHIM_BUF_SIZE];
 } ShimMsg;
 

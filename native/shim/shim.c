@@ -28,10 +28,12 @@
 #include "shadow_ipc.h"
 
 #include <arpa/inet.h>
+#include <dlfcn.h>
 #include <errno.h>
 #include <fcntl.h>
 #include <netinet/in.h>
 #include <poll.h>
+#include <signal.h>
 #include <stddef.h>
 #include <stdio.h>
 #include <stdlib.h>
@@ -40,6 +42,7 @@
 #include <sys/socket.h>
 #include <sys/stat.h>
 #include <sys/syscall.h>
+#include <sys/time.h>
 #include <sys/un.h>
 #include <sys/utsname.h>
 #include <time.h>
@@ -67,7 +70,19 @@ static long raw_clock_gettime(clockid_t c, struct timespec *ts) {
 static void ipc_call(ShimMsg *m) {
     shim_channel_send(&g_shm->to_shadow, m);
     shim_channel_recv(&g_shm->to_shim, m, -1);
+    if (m->sig) {
+        /* Shadow queued a signal for this process: run the native handler
+         * before the interrupted call returns, exactly where the kernel
+         * would deliver it (reference shim_signals.c; the pending-signal
+         * handoff shim_shmem.rs:252-268). raise() is not interposed, so
+         * the real sigaction-registered handler executes in-process. */
+        int s = (int)m->sig;
+        m->sig = 0;
+        raise(s);
+    }
 }
+
+#define SHIM_ERESTART 512 /* kernel-style ERESTARTSYS: re-issue the call */
 
 static int64_t vsys_ex(int code, int64_t a1, int64_t a2, int64_t a3, int64_t a5,
                        const void *out_buf, uint32_t out_len, ShimMsg *reply) {
@@ -88,7 +103,21 @@ static int64_t vsys_ex(int code, int64_t a1, int64_t a2, int64_t a3, int64_t a5,
         memcpy(m.buf, out_buf, out_len);
         m.buf_len = out_len;
     }
-    ipc_call(&m);
+    /* keep a pristine copy (header + payload only) for SA_RESTART resends;
+     * on the stack because a handler running inside ipc_call may itself
+     * issue nested vsys calls */
+    ShimMsg req;
+    size_t req_len = offsetof(ShimMsg, buf) + m.buf_len;
+    memcpy(&req, &m, req_len);
+    for (;;) {
+        ipc_call(&m);
+        if (m.ret != -SHIM_ERESTART)
+            break;
+        /* the signal handler already ran inside ipc_call; re-issue the
+         * original call (latency was charged on the first attempt) */
+        memcpy(&m, &req, req_len);
+        m.a[4] = 0;
+    }
     if (reply)
         *reply = m;
     return m.ret;
@@ -196,7 +225,16 @@ int nanosleep(const struct timespec *req, struct timespec *rem) {
     if (!g_active)
         return (int)syscall(SYS_nanosleep, req, rem);
     int64_t ns = (int64_t)req->tv_sec * 1000000000LL + req->tv_nsec;
-    vsys(VSYS_NANOSLEEP, ns, 0, 0, NULL, 0, NULL);
+    ShimMsg reply;
+    int64_t r = vsys(VSYS_NANOSLEEP, ns, 0, 0, NULL, 0, &reply);
+    if (r < 0) { /* -EINTR: a[2] = remaining ns */
+        if (rem) {
+            rem->tv_sec = reply.a[2] / 1000000000LL;
+            rem->tv_nsec = (long)(reply.a[2] % 1000000000LL);
+        }
+        errno = (int)-r;
+        return -1;
+    }
     if (rem) {
         rem->tv_sec = 0;
         rem->tv_nsec = 0;
@@ -208,8 +246,9 @@ unsigned int sleep(unsigned int seconds) {
     if (!g_active)
         return (unsigned int)syscall(SYS_nanosleep,
                                      &(struct timespec){seconds, 0}, NULL);
-    struct timespec ts = {seconds, 0};
-    nanosleep(&ts, NULL);
+    struct timespec ts = {seconds, 0}, rem = {0, 0};
+    if (nanosleep(&ts, &rem) != 0)
+        return (unsigned int)(rem.tv_sec + (rem.tv_nsec ? 1 : 0));
     return 0;
 }
 
@@ -229,6 +268,114 @@ pid_t getpid(void) {
     if (!g_active)
         return (pid_t)syscall(SYS_getpid);
     return (pid_t)g_vpid;
+}
+
+/* ---- signals (reference: shim_signals.c + process.rs signal plumbing).
+ * Handlers are registered natively (the real kernel runs them); the shim
+ * only tells Shadow the disposition so it can route sim-time signals
+ * (alarm/itimer/kill) through the reply path, and emulates the timers
+ * themselves on simulated time. ---- */
+
+int sigaction(int sig, const struct sigaction *act, struct sigaction *old) {
+    /* glibc's struct sigaction layout differs from the kernel's, and the
+     * kernel ABI needs glibc's SA_RESTORER trampoline — so registration
+     * must go through the real libc, not a raw syscall */
+    static int (*real)(int, const struct sigaction *, struct sigaction *);
+    if (!real)
+        real = (int (*)(int, const struct sigaction *, struct sigaction *))
+            dlsym(RTLD_NEXT, "sigaction");
+    if (real(sig, act, old) != 0)
+        return -1;
+    if (g_active && act) {
+        int64_t kind = 2; /* handler */
+        if (act->sa_handler == SIG_DFL && !(act->sa_flags & SA_SIGINFO))
+            kind = 0;
+        else if (act->sa_handler == SIG_IGN && !(act->sa_flags & SA_SIGINFO))
+            kind = 1;
+        else if (act->sa_flags & SA_RESTART)
+            kind |= 0x10; /* restart interrupted file syscalls */
+        vsys(VSYS_SIGACTION, sig, kind, 0, NULL, 0, NULL);
+    }
+    return 0;
+}
+
+sighandler_t signal(int sig, sighandler_t h) {
+    struct sigaction act, old;
+    memset(&act, 0, sizeof(act));
+    act.sa_handler = h;
+    act.sa_flags = SA_RESTART;
+    if (sigaction(sig, &act, &old) != 0)
+        return SIG_ERR;
+    return old.sa_handler;
+}
+
+unsigned int alarm(unsigned int seconds) {
+    if (!g_active)
+        return (unsigned int)syscall(SYS_alarm, seconds);
+    int64_t r = vsys(VSYS_ALARM, (int64_t)seconds, 0, 0, NULL, 0, NULL);
+    return r < 0 ? 0 : (unsigned int)r;
+}
+
+int setitimer(__itimer_which_t which, const struct itimerval *nv, struct itimerval *ov) {
+    if (!g_active || which != ITIMER_REAL)
+        return (int)syscall(SYS_setitimer, which, nv, ov);
+    if (!nv) /* Linux treats a NULL new_value as a query */
+        return getitimer(which, ov);
+    int64_t val = (int64_t)nv->it_value.tv_sec * 1000000000LL +
+                  (int64_t)nv->it_value.tv_usec * 1000LL;
+    int64_t itv = (int64_t)nv->it_interval.tv_sec * 1000000000LL +
+                  (int64_t)nv->it_interval.tv_usec * 1000LL;
+    ShimMsg reply;
+    int64_t r = vsys(VSYS_SETITIMER, val, itv, 0, NULL, 0, &reply);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    if (ov) {
+        ov->it_value.tv_sec = reply.a[2] / 1000000000LL;
+        ov->it_value.tv_usec = (reply.a[2] % 1000000000LL) / 1000;
+        ov->it_interval.tv_sec = reply.a[3] / 1000000000LL;
+        ov->it_interval.tv_usec = (reply.a[3] % 1000000000LL) / 1000;
+    }
+    return 0;
+}
+
+int getitimer(__itimer_which_t which, struct itimerval *cur) {
+    if (!g_active || which != ITIMER_REAL)
+        return (int)syscall(SYS_getitimer, which, cur);
+    ShimMsg reply;
+    int64_t r = vsys(VSYS_GETITIMER, 0, 0, 0, NULL, 0, &reply);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    if (cur) {
+        cur->it_value.tv_sec = reply.a[2] / 1000000000LL;
+        cur->it_value.tv_usec = (reply.a[2] % 1000000000LL) / 1000;
+        cur->it_interval.tv_sec = reply.a[3] / 1000000000LL;
+        cur->it_interval.tv_usec = (reply.a[3] % 1000000000LL) / 1000;
+    }
+    return 0;
+}
+
+int kill(pid_t pid, int sig) {
+    /* vpids live at >= 1000; anything else is outside the simulation */
+    if (!g_active || (pid < VFD_BASE && pid != 0))
+        return (int)syscall(SYS_kill, pid, sig);
+    int64_t r = vsys(VSYS_KILL, (int64_t)pid, sig, 0, NULL, 0, NULL);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    return 0;
+}
+
+int pause(void) {
+    if (!g_active)
+        return (int)syscall(SYS_pause);
+    int64_t r = vsys(VSYS_PAUSE, 0, 0, 0, NULL, 0, NULL);
+    errno = r < 0 ? (int)-r : EINTR;
+    return -1;
 }
 
 /* ---- sockets (UDP first tier; TCP rides the device stack later) ---- */

@@ -23,6 +23,7 @@ from typing import Callable, Optional
 VFD_BASE = 1000
 
 # errno values we return (negated over the wire)
+EINTR = 4
 EPERM = 1
 EBADF = 9
 EAGAIN = 11
@@ -40,6 +41,7 @@ EEXIST = 17
 ENOENT = 2
 EMSGSIZE = 90
 ENOTSOCK = 88
+ESRCH = 3
 
 # epoll event bits (uapi)
 EPOLLIN = 0x001

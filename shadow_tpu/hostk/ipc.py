@@ -67,6 +67,12 @@ VSYS_UBIND = 38
 VSYS_UCONNECT = 39
 VSYS_USENDTO = 40
 VSYS_SOCKETPAIR = 41
+VSYS_SIGACTION = 42
+VSYS_ALARM = 43
+VSYS_SETITIMER = 44
+VSYS_GETITIMER = 45
+VSYS_KILL = 46
+VSYS_PAUSE = 47
 
 VSYS_NAMES = {
     VSYS_NANOSLEEP: "nanosleep",
@@ -110,6 +116,12 @@ VSYS_NAMES = {
     VSYS_UCONNECT: "connect",
     VSYS_USENDTO: "sendto",
     VSYS_SOCKETPAIR: "socketpair",
+    VSYS_SIGACTION: "rt_sigaction",
+    VSYS_ALARM: "alarm",
+    VSYS_SETITIMER: "setitimer",
+    VSYS_GETITIMER: "getitimer",
+    VSYS_KILL: "kill",
+    VSYS_PAUSE: "pause",
 }
 
 
@@ -120,7 +132,7 @@ class ShimMsg(ctypes.Structure):
         ("a", ctypes.c_int64 * 6),
         ("ret", ctypes.c_int64),
         ("buf_len", ctypes.c_uint32),
-        ("_pad", ctypes.c_uint32),
+        ("sig", ctypes.c_uint32),  # shadow->shim: deliver before returning
         ("buf", ctypes.c_char * SHIM_BUF_SIZE),
     ]
 

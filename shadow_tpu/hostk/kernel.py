@@ -32,6 +32,7 @@ import pathlib
 import shutil
 import struct
 import subprocess
+from collections import deque
 from typing import Callable, Optional
 
 import jax.numpy as jnp
@@ -47,6 +48,8 @@ from shadow_tpu.hostk.descriptor import (
     EBADF,
     EADDRINUSE,
     ECONNREFUSED,
+    EINTR,
+    ESRCH,
     EDESTADDRREQ,
     EINPROGRESS,
     EINVAL,
@@ -118,6 +121,7 @@ class Waiter:
         check: "Callable[[], bool]",
         timeout_at: Optional[int] = None,
         on_timeout: Optional[Callable[[], None]] = None,
+        on_interrupt: Optional[Callable[[], None]] = None,
     ):
         self.kernel = kernel
         self.proc = proc
@@ -126,6 +130,7 @@ class Waiter:
         self.done = False
         self._checking = False  # guards re-entrant notify during check()
         self.on_timeout = on_timeout
+        self.on_interrupt = on_interrupt  # custom EINTR reply (e.g. nanosleep rem)
         proc.waiter = self
         for f in files:
             f.add_listener(self._cb)
@@ -188,6 +193,14 @@ class ManagedProcess:
         self._stdout_path = None
         self.strace: Optional[StraceFile] = None
         self._pending: Optional[tuple[str, str]] = None  # (name, args) awaiting reply
+        # signal state (reference: process.rs signal bookkeeping + the
+        # pending-unblocked-signal handoff shim_shmem.rs:252-268)
+        self.pending_sigs: "deque[int]" = deque()
+        self.sig_handlers: dict[int, int] = {}  # sig -> 0 dfl | 1 ign | 2 handler
+        self.shutdown_requested = False  # config shutdown_time fired
+        self.itimer_fire_ns = 0  # 0 = disarmed
+        self.itimer_interval_ns = 0
+        self.itimer_gen = 0
 
     # --- lifecycle -------------------------------------------------------
 
@@ -272,6 +285,8 @@ class ManagedProcess:
         self._pending = None
         self.ipc.set_time(SIM_START_UNIX_NS + self.now, 0)
         m = I.make_msg(I.MSG_SYSCALL_DONE, a=a, ret=ret, buf=buf)
+        if self.pending_sigs:  # deliver one queued signal with this return
+            m.sig = self.pending_sigs.popleft()
         self.ipc.send_to_shim(m)
 
 
@@ -424,17 +439,158 @@ class NetKernel:
             self._push(spec.shutdown_ns, lambda p=proc: self._shutdown_proc(p))
         return proc
 
+    # --- signals (reference: shim_signals.c, process.rs, syscall/signal) --
+
+    _SIG_DFL_IGNORED = {17, 18, 23, 28}  # SIGCHLD, SIGCONT, SIGURG, SIGWINCH
+    # default action "stop" — a stopped-process model does not exist here,
+    # so these are dropped rather than (wrongly) treated as fatal
+    _SIG_DFL_STOP = {19, 20, 21, 22}  # SIGSTOP, SIGTSTP, SIGTTIN, SIGTTOU
+    ERESTART = 512  # kernel-internal ERESTARTSYS: shim re-issues the syscall
+
+    def deliver_signal(self, proc: ManagedProcess, sig: int) -> None:
+        """Queue a signal for a process at the current sim time. Handler-
+        registered signals ride the next IPC reply (the shim raises them
+        natively); default-disposition fatal signals terminate the process;
+        ignored signals are dropped. SA_RESTART handlers restart the
+        interrupted file syscall (the shim resends it on ERESTART)."""
+        if proc.state == "exited":
+            return
+        kind = proc.sig_handlers.get(sig, 0)
+        if sig == 9:  # SIGKILL cannot be caught or ignored
+            kind = 0
+        if kind == 1:
+            return
+        if kind == 0:
+            if sig in self._SIG_DFL_IGNORED or sig in self._SIG_DFL_STOP:
+                return
+            self._terminate_by_signal(proc, sig)
+            return
+        restart = bool(kind & 0x10)
+        proc.pending_sigs.append(sig)
+        if proc.state == "blocked" and proc.waiter is not None:
+            w = proc.waiter
+            w._detach()
+            proc.now = max(proc.now, self.now)
+            proc.state = "running"
+            if w.on_interrupt is not None:
+                w.on_interrupt()  # syscall-specific EINTR reply (never restarts)
+            elif restart:
+                proc._reply(-self.ERESTART)
+            else:
+                proc._reply(-EINTR)
+            self._service(proc)
+
+    def _terminate_by_signal(self, proc: ManagedProcess, sig: int) -> None:
+        """Default disposition: the real process gets the real signal, so
+        waitpid status is authentic (exit_code = -sig via Popen)."""
+        self.event_log.append(
+            (self.now, f"killed {proc.host.name}/{proc.vpid} sig={sig}")
+        )
+        if proc.waiter is not None:
+            proc.waiter._detach()
+        proc.state = "exited"
+        for fd in proc.fdtab.fds():
+            self._close_fd(proc, fd)
+        if proc.popen is not None and proc.popen.poll() is None:
+            proc.popen.send_signal(sig)
+            try:
+                proc.exit_code = proc.popen.wait(timeout=5)
+            except subprocess.TimeoutExpired:  # blocked the signal natively
+                proc.popen.kill()
+                proc.exit_code = proc.popen.wait()
+        proc.kill()
+
+    def _sys_sigaction(self, proc, msg):
+        proc.sig_handlers[int(msg.a[1])] = int(msg.a[2])
+        proc._reply(0)
+        return True
+
+    def _itimer_remaining(self, proc: ManagedProcess) -> int:
+        return max(0, proc.itimer_fire_ns - proc.now) if proc.itimer_fire_ns else 0
+
+    def _arm_itimer(self, proc: ManagedProcess, value_ns: int, interval_ns: int) -> None:
+        proc.itimer_gen += 1
+        if value_ns <= 0:
+            proc.itimer_fire_ns = 0
+            proc.itimer_interval_ns = 0
+            return
+        proc.itimer_fire_ns = proc.now + value_ns
+        proc.itimer_interval_ns = interval_ns
+        gen = proc.itimer_gen
+        self._push(proc.itimer_fire_ns, lambda: self._itimer_fire(proc, gen))
+
+    def _itimer_fire(self, proc: ManagedProcess, gen: int) -> None:
+        if gen != proc.itimer_gen or proc.state == "exited":
+            return  # re-armed or cancelled since scheduled
+        proc.now = max(proc.now, self.now)
+        interval = proc.itimer_interval_ns
+        if interval > 0:
+            self._arm_itimer(proc, interval, interval)
+        else:
+            proc.itimer_gen += 1
+            proc.itimer_fire_ns = 0
+        self.deliver_signal(proc, 14)  # SIGALRM
+
+    def _sys_alarm(self, proc, msg):
+        remaining = self._itimer_remaining(proc)
+        self._arm_itimer(proc, int(msg.a[1]) * 1_000_000_000, 0)
+        proc._reply((remaining + 999_999_999) // 1_000_000_000)
+        return True
+
+    def _sys_setitimer(self, proc, msg):
+        old_val, old_itv = self._itimer_remaining(proc), proc.itimer_interval_ns
+        self._arm_itimer(proc, int(msg.a[1]), int(msg.a[2]))
+        proc._reply(0, a=(0, 0, old_val, old_itv))
+        return True
+
+    def _sys_getitimer(self, proc, msg):
+        proc._reply(0, a=(0, 0, self._itimer_remaining(proc), proc.itimer_interval_ns))
+        return True
+
+    def _sys_kill(self, proc, msg):
+        vpid, sig = int(msg.a[1]), int(msg.a[2])
+        target = proc if vpid == 0 else next(
+            (p for p in self.procs if p.vpid == vpid), None
+        )
+        if target is None or target.state == "exited":
+            proc._reply(-ESRCH)
+            return True
+        if not 0 <= sig <= 64:
+            proc._reply(-EINVAL)
+            return True
+        if sig == 0:  # existence probe
+            proc._reply(0)
+            return True
+        if target is proc:
+            # queue first so the signal rides this very reply (handler runs
+            # before kill() returns, as on Linux); a fatal default kills the
+            # process with no reply at all
+            self.deliver_signal(target, sig)
+            if proc.state == "exited":
+                return True
+            proc._reply(0)
+            return True
+        proc._reply(0)
+        self.deliver_signal(target, sig)
+        return True
+
+    def _sys_pause(self, proc, msg):
+        if proc.pending_sigs:
+            proc._reply(-EINTR)
+            return True
+        Waiter(self, proc, [], lambda: False)
+        return False
+
     def _shutdown_proc(self, proc: ManagedProcess) -> None:
+        """Config shutdown_time: deliver SIGTERM at sim time (reference
+        sends shutdown_signal, configuration.rs:560-640). A process with a
+        SIGTERM handler gets to run it and exit on its own; the default
+        disposition terminates. Either way the exit is expected."""
         if proc.state == "exited":
             return
         self.event_log.append((self.now, f"shutdown {proc.host.name}/{proc.vpid}"))
-        if proc.waiter is not None:  # blocked: cancel the pending wakeup
-            proc.waiter._detach()
-        proc.state = "exited"  # set before kill so queued events no-op
-        for fd in proc.fdtab.fds():  # release ports, FIN/teardown live TCP
-            self._close_fd(proc, fd)
-        proc.kill()
-        proc.exit_code = 0  # a requested shutdown is a clean exit
+        proc.shutdown_requested = True
+        self.deliver_signal(proc, 15)
 
     # --- event machinery --------------------------------------------------
 
@@ -519,6 +675,8 @@ class NetKernel:
     def unexpected_final_states(self) -> "list[str]":
         out = []
         for p in self.procs:
+            if p.shutdown_requested and p.state == "exited":
+                continue  # a requested shutdown is an expected exit
             want = p.spec.expected_final_state
             got = "exited" if p.state == "exited" else "running"
             if want != got or (want == "exited" and (p.exit_code or 0) != 0):
@@ -544,6 +702,8 @@ class NetKernel:
         """Run the process until it blocks or exits, emulating each syscall
         (the ManagedThread::resume loop, managed_thread.rs:156-267)."""
         while True:
+            if proc.state == "exited":  # e.g. fatal self-kill mid-service
+                return
             msg = proc._recv()
             if msg is None:
                 proc.state = "exited"
@@ -629,16 +789,19 @@ class NetKernel:
 
     def _sys_nanosleep(self, proc, msg):
         wake_at = proc.now + int(msg.a[1])
-        self._push(wake_at, lambda p=proc, t=wake_at: self._wake_sleep(p, t))
+        Waiter(
+            self,
+            proc,
+            [],
+            lambda: False,
+            timeout_at=wake_at,
+            on_timeout=lambda: proc._reply(0),
+            # a signal interrupts the sleep: EINTR + remaining time
+            on_interrupt=lambda: proc._reply(
+                -EINTR, a=(0, 0, max(0, wake_at - proc.now))
+            ),
+        )
         return False
-
-    def _wake_sleep(self, proc: ManagedProcess, t: int) -> None:
-        if proc.state == "exited":  # killed (e.g. shutdown_time) while asleep
-            return
-        proc.now = max(proc.now, t)
-        proc.state = "running"
-        proc._reply(0)
-        self._service(proc)
 
     def _sys_gethostname(self, proc, msg):
         proc._reply(0, buf=proc.host.name.encode() + b"\0")
@@ -1034,7 +1197,7 @@ class NetKernel:
         if addr is None:
             proc._reply(0, a=(0, 0, 0, 0, 1))
         else:
-            proc._reply(0, a=(0, 0, int(addr[0]), 0, 1), buf=addr[1].encode())
+            proc._reply(0, a=(0, 0, int(addr[0]), 0, 1), buf=addr[1].encode("utf-8", "surrogateescape"))
 
     def _sys_getsockname(self, proc, msg):
         f = self._file(proc, int(msg.a[1]))
@@ -1316,7 +1479,7 @@ class NetKernel:
                 src, data = d
                 data = data[:n]  # excess datagram bytes are discarded (POSIX)
                 if include_path and src is not None:
-                    path = src[1].encode()
+                    path = src[1].encode("utf-8", "surrogateescape")
                     # path + payload must fit the reply buffer
                     data = data[: I.SHIM_BUF_SIZE - len(path)]
                     return (len(data), (0, 0, len(path), int(src[0]), 1), path + data)
@@ -1673,4 +1836,10 @@ _DISPATCH = {
     I.VSYS_UCONNECT: NetKernel._sys_uconnect,
     I.VSYS_USENDTO: NetKernel._sys_usendto,
     I.VSYS_SOCKETPAIR: NetKernel._sys_socketpair,
+    I.VSYS_SIGACTION: NetKernel._sys_sigaction,
+    I.VSYS_ALARM: NetKernel._sys_alarm,
+    I.VSYS_SETITIMER: NetKernel._sys_setitimer,
+    I.VSYS_GETITIMER: NetKernel._sys_getitimer,
+    I.VSYS_KILL: NetKernel._sys_kill,
+    I.VSYS_PAUSE: NetKernel._sys_pause,
 }

@@ -1,0 +1,52 @@
+/* Guest test program: cross-process signals at simulated time.
+ * Usage:
+ *   kill_pair wait            — install SIGUSR1 handler, pause until hit
+ *   kill_pair send <vpid>     — sleep 100ms, kill(vpid, SIGUSR1)
+ *   kill_pair victim          — pause forever (no handlers; killed by test)
+ */
+#include <errno.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+
+static volatile int hits = 0;
+static void on_usr1(int s) { (void)s; hits++; }
+
+static long long now_ns(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    return (long long)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+int main(int argc, char **argv) {
+    if (argc < 2)
+        return 2;
+    if (strcmp(argv[1], "wait") == 0) {
+        struct sigaction sa;
+        memset(&sa, 0, sizeof(sa));
+        sa.sa_handler = on_usr1;
+        sigaction(SIGUSR1, &sa, NULL);
+        while (hits == 0) {
+            if (pause() != -1 || errno != EINTR)
+                return 3;
+        }
+        printf("signaled at %lld\n", now_ns());
+        return 0;
+    }
+    if (strcmp(argv[1], "send") == 0) {
+        struct timespec d = {0, 100000000};
+        nanosleep(&d, NULL);
+        if (kill((pid_t)atoi(argv[2]), SIGUSR1) != 0)
+            return 4;
+        printf("sent at %lld\n", now_ns());
+        return 0;
+    }
+    if (strcmp(argv[1], "victim") == 0) {
+        for (;;)
+            pause();
+    }
+    return 2;
+}

@@ -1,0 +1,117 @@
+/* Guest test program: signals on simulated time within one process.
+ * alarm/SIGALRM interrupting nanosleep, setitimer interval ticks via
+ * pause, self-kill synchronous delivery, SIG_IGN, alarm cancellation.
+ * Prints "ok <step>"; exits 0 only if all steps passed. */
+#include <errno.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/time.h>
+#include <time.h>
+#include <unistd.h>
+
+#define CHECK(cond, name)                                                      \
+    do {                                                                       \
+        if (!(cond)) {                                                         \
+            printf("FAIL %s\n", name);                                         \
+            return 1;                                                          \
+        }                                                                      \
+        printf("ok %s\n", name);                                               \
+    } while (0)
+
+#include <sys/socket.h>
+
+static volatile int alarms = 0, usr1s = 0, usr2s = 0;
+static int g_sp[2];
+static void on_alrm(int s) { (void)s; alarms++; }
+static void on_alrm_send(int s) {
+    (void)s;
+    alarms++;
+    send(g_sp[1], "wake", 4, 0); /* unblocks the restarted recv */
+}
+static void on_usr1(int s) { (void)s; usr1s++; }
+static void on_usr2(int s) { (void)s; usr2s++; }
+
+static long long now_ns(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    return (long long)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+int main(void) {
+    struct sigaction sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sa_handler = on_alrm;
+    CHECK(sigaction(SIGALRM, &sa, NULL) == 0, "sigaction");
+
+    /* alarm interrupts nanosleep with EINTR and correct remaining time */
+    long long t0 = now_ns();
+    alarm(1);
+    struct timespec req = {5, 0}, rem = {0, 0};
+    int r = nanosleep(&req, &rem);
+    long long waited = now_ns() - t0;
+    CHECK(r == -1 && errno == EINTR, "sleep-eintr");
+    CHECK(alarms == 1, "alarm-fired");
+    CHECK(waited >= 900000000LL && waited <= 1500000000LL, "alarm-at-1s");
+    CHECK(rem.tv_sec >= 3 && rem.tv_sec <= 4, "sleep-remaining");
+
+    /* interval timer ticks pause() on a 100ms cadence */
+    t0 = now_ns();
+    struct itimerval itv = {{0, 100000}, {0, 100000}}; /* 100ms/100ms */
+    CHECK(setitimer(ITIMER_REAL, &itv, NULL) == 0, "setitimer");
+    for (int i = 0; i < 3; i++)
+        CHECK(pause() == -1 && errno == EINTR, "pause-tick");
+    long long ticked = now_ns() - t0;
+    CHECK(alarms == 4, "itimer-count");
+    CHECK(ticked >= 290000000LL && ticked <= 500000000LL, "itimer-cadence");
+    struct itimerval zero = {{0, 0}, {0, 0}}, old;
+    CHECK(setitimer(ITIMER_REAL, &zero, &old) == 0, "setitimer-disarm");
+    CHECK(old.it_interval.tv_usec == 100000, "setitimer-old-interval");
+
+    /* self-kill: handler runs before kill() returns */
+    memset(&sa, 0, sizeof(sa));
+    sa.sa_handler = on_usr1;
+    sigaction(SIGUSR1, &sa, NULL);
+    CHECK(kill(getpid(), SIGUSR1) == 0, "self-kill");
+    CHECK(usr1s == 1, "self-kill-sync");
+
+    /* ignored signals are dropped */
+    signal(SIGUSR2, SIG_IGN);
+    CHECK(kill(getpid(), SIGUSR2) == 0, "kill-ignored");
+    CHECK(usr2s == 0, "ignored-dropped");
+    signal(SIGUSR2, on_usr2);
+    CHECK(kill(getpid(), SIGUSR2) == 0 && usr2s == 1, "rearmed-handler");
+
+    /* alarm(0) cancels and reports remaining seconds */
+    alarm(3);
+    unsigned int remaining = alarm(0);
+    CHECK(remaining >= 2 && remaining <= 3, "alarm-cancel");
+    struct timespec ok = {0, 50000000};
+    CHECK(nanosleep(&ok, NULL) == 0 && alarms == 4, "no-stray-alarm");
+
+    /* SA_RESTART: a blocking recv interrupted by SIGALRM restarts after
+     * the handler (which itself sends the wakeup datagram) */
+    CHECK(socketpair(AF_UNIX, SOCK_DGRAM, 0, g_sp) == 0, "restart-socketpair");
+    memset(&sa, 0, sizeof(sa));
+    sa.sa_handler = on_alrm_send;
+    sa.sa_flags = SA_RESTART;
+    CHECK(sigaction(SIGALRM, &sa, NULL) == 0, "restart-sigaction");
+    alarm(1);
+    t0 = now_ns();
+    char b2[16];
+    ssize_t rr = recv(g_sp[0], b2, sizeof(b2), 0);
+    CHECK(rr == 4 && memcmp(b2, "wake", 4) == 0, "sa-restart");
+    CHECK(now_ns() - t0 >= 900000000LL, "sa-restart-waited");
+    CHECK(alarms == 5, "sa-restart-count");
+    close(g_sp[0]);
+    close(g_sp[1]);
+
+    /* kill to a nonexistent sim pid (only meaningful under the shim,
+     * where pids >= 1000 are virtual; natively 4242 might exist) */
+    if (getenv("SHADOW_SHM"))
+        CHECK(kill(4242, 0) == -1 && errno == ESRCH, "kill-esrch");
+
+    printf("signals all ok\n");
+    return 0;
+}
