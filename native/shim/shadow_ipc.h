@@ -95,6 +95,7 @@ enum {
     VSYS_GETITIMER = 45, /* -> a[2]=value ns a[3]=interval ns */
     VSYS_KILL = 46,      /* a[1]=vpid (0 = self) a[2]=sig */
     VSYS_PAUSE = 47,     /* blocks until a signal is delivered -> -EINTR */
+    VSYS_RESOLVE_REV = 48, /* a[1]=ip -> buf=hostname (reverse DNS) */
 };
 
 typedef struct {

@@ -57,6 +57,7 @@ static ShimShmem *g_shm = NULL;
 static int g_active = 0;
 static int64_t g_unapplied = 0;
 static int64_t g_vpid = 0;
+static uint32_t g_host_ip = 0; /* simulated address, host byte order */
 static int g_in_shim = 0; /* recursion guard (reference shim.c:427-439) */
 
 /* ---- raw syscalls for passthrough (avoid dlsym recursion) ---- */
@@ -169,6 +170,7 @@ __attribute__((constructor)) static void shim_attach(void) {
     shim_channel_send(&g_shm->to_shadow, &m);
     shim_channel_recv(&g_shm->to_shim, &m, -1);
     g_vpid = m.a[0];
+    g_host_ip = (uint32_t)m.a[1]; /* host-order simulated address */
     g_active = 1;
 }
 
@@ -359,9 +361,15 @@ int getitimer(__itimer_which_t which, struct itimerval *cur) {
 }
 
 int kill(pid_t pid, int sig) {
-    /* vpids live at >= 1000; anything else is outside the simulation */
-    if (!g_active || (pid < VFD_BASE && pid != 0))
+    if (!g_active)
         return (int)syscall(SYS_kill, pid, sig);
+    /* vpids live at >= 1000 (0 = self, POSIX "my process group"); real
+     * pids and negative pgids are outside the simulation — confined to
+     * ESRCH, never forwarded to the real kernel */
+    if (pid < VFD_BASE && pid != 0) {
+        errno = ESRCH;
+        return -1;
+    }
     int64_t r = vsys(VSYS_KILL, (int64_t)pid, sig, 0, NULL, 0, NULL);
     if (r < 0) {
         errno = (int)-r;
@@ -1240,6 +1248,97 @@ void freeaddrinfo(struct addrinfo *res) {
     /* our results are single contiguous blocks; real ones never reach here
      * because getaddrinfo above handles every g_active case */
     free(res);
+}
+
+int getnameinfo(const struct sockaddr *sa, socklen_t salen, char *host,
+                socklen_t hostlen, char *serv, socklen_t servlen, int flags) {
+    if (!g_active)
+        return EAI_FAIL; /* no passthrough (libc-internal resolver) */
+    if (!sa || salen < (socklen_t)sizeof(struct sockaddr_in) ||
+        sa->sa_family != AF_INET)
+        return EAI_FAMILY;
+    const struct sockaddr_in *in = (const struct sockaddr_in *)sa;
+    if (serv && servlen > 0)
+        snprintf(serv, servlen, "%u", (unsigned)ntohs(in->sin_port));
+    if (host && hostlen > 0) {
+        uint32_t ip = ntohl(in->sin_addr.s_addr);
+        if (!(flags & NI_NUMERICHOST)) {
+            ShimMsg reply;
+            int64_t r = vsys(VSYS_RESOLVE_REV, (int64_t)ip, 0, 0, NULL, 0,
+                             &reply);
+            if (r == 0) {
+                if (reply.buf_len > (uint32_t)hostlen)
+                    return EAI_OVERFLOW;
+                memcpy(host, reply.buf, reply.buf_len);
+                host[hostlen - 1] = '\0';
+                return 0;
+            }
+            if (flags & NI_NAMEREQD)
+                return EAI_NONAME;
+        }
+        snprintf(host, hostlen, "%u.%u.%u.%u", ip >> 24, (ip >> 16) & 0xFF,
+                 (ip >> 8) & 0xFF, ip & 0xFF);
+    }
+    return 0;
+}
+
+/* getifaddrs emulation (reference: shim_api_ifaddrs.c): lo + eth0 with the
+ * host's simulated address. Each node is one contiguous allocation. */
+
+#include <ifaddrs.h>
+#include <net/if.h>
+
+static struct ifaddrs *mk_ifaddr(const char *name, uint32_t ip_hostorder,
+                                 uint32_t mask_hostorder, unsigned int extra_flags) {
+    size_t sz = sizeof(struct ifaddrs) + 16 + 3 * sizeof(struct sockaddr_in);
+    char *blk = calloc(1, sz);
+    if (!blk)
+        return NULL;
+    struct ifaddrs *ifa = (struct ifaddrs *)blk;
+    char *nm = blk + sizeof(struct ifaddrs);
+    struct sockaddr_in *sas = (struct sockaddr_in *)(nm + 16);
+    strncpy(nm, name, 15);
+    sas[0].sin_family = AF_INET;
+    sas[0].sin_addr.s_addr = htonl(ip_hostorder);
+    sas[1].sin_family = AF_INET;
+    sas[1].sin_addr.s_addr = htonl(mask_hostorder);
+    sas[2].sin_family = AF_INET;
+    sas[2].sin_addr.s_addr = htonl((ip_hostorder & mask_hostorder) |
+                                   ~mask_hostorder);
+    ifa->ifa_name = nm;
+    ifa->ifa_flags = IFF_UP | IFF_RUNNING | extra_flags;
+    ifa->ifa_addr = (struct sockaddr *)&sas[0];
+    ifa->ifa_netmask = (struct sockaddr *)&sas[1];
+    ifa->ifa_broadaddr = (struct sockaddr *)&sas[2];
+    return ifa;
+}
+
+int getifaddrs(struct ifaddrs **ifap) {
+    if (!g_active) {
+        static int (*real)(struct ifaddrs **);
+        if (!real)
+            real = (int (*)(struct ifaddrs **))dlsym(RTLD_NEXT, "getifaddrs");
+        return real(ifap);
+    }
+    struct ifaddrs *lo = mk_ifaddr("lo", 0x7F000001u, 0xFF000000u, IFF_LOOPBACK);
+    struct ifaddrs *eth = mk_ifaddr("eth0", g_host_ip, 0xFFFFFF00u, 0);
+    if (!lo || !eth) {
+        free(lo);
+        free(eth);
+        errno = ENOMEM;
+        return -1;
+    }
+    lo->ifa_next = eth;
+    *ifap = lo;
+    return 0;
+}
+
+void freeifaddrs(struct ifaddrs *ifa) {
+    while (ifa) {
+        struct ifaddrs *next = ifa->ifa_next;
+        free(ifa);
+        ifa = next;
+    }
 }
 
 struct hostent *gethostbyname(const char *name) {

@@ -73,6 +73,7 @@ VSYS_SETITIMER = 44
 VSYS_GETITIMER = 45
 VSYS_KILL = 46
 VSYS_PAUSE = 47
+VSYS_RESOLVE_REV = 48
 
 VSYS_NAMES = {
     VSYS_NANOSLEEP: "nanosleep",
@@ -122,6 +123,7 @@ VSYS_NAMES = {
     VSYS_GETITIMER: "getitimer",
     VSYS_KILL: "kill",
     VSYS_PAUSE: "pause",
+    VSYS_RESOLVE_REV: "getnameinfo",
 }
 
 

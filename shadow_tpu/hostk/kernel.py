@@ -122,6 +122,7 @@ class Waiter:
         timeout_at: Optional[int] = None,
         on_timeout: Optional[Callable[[], None]] = None,
         on_interrupt: Optional[Callable[[], None]] = None,
+        restartable: bool = True,
     ):
         self.kernel = kernel
         self.proc = proc
@@ -131,6 +132,8 @@ class Waiter:
         self._checking = False  # guards re-entrant notify during check()
         self.on_timeout = on_timeout
         self.on_interrupt = on_interrupt  # custom EINTR reply (e.g. nanosleep rem)
+        # pause/poll/epoll_wait are never restarted by SA_RESTART on Linux
+        self.restartable = restartable
         proc.waiter = self
         for f in files:
             f.add_listener(self._cb)
@@ -474,7 +477,7 @@ class NetKernel:
             proc.state = "running"
             if w.on_interrupt is not None:
                 w.on_interrupt()  # syscall-specific EINTR reply (never restarts)
-            elif restart:
+            elif restart and w.restartable:
                 proc._reply(-self.ERESTART)
             else:
                 proc._reply(-EINTR)
@@ -523,11 +526,16 @@ class NetKernel:
         if gen != proc.itimer_gen or proc.state == "exited":
             return  # re-armed or cancelled since scheduled
         proc.now = max(proc.now, self.now)
+        expiry = proc.itimer_fire_ns
         interval = proc.itimer_interval_ns
+        proc.itimer_gen += 1
         if interval > 0:
-            self._arm_itimer(proc, interval, interval)
+            # re-arm from the expiry, not the (possibly later) proc clock —
+            # the cadence must not drift (as with the kernel's own timers)
+            proc.itimer_fire_ns = expiry + interval
+            new_gen = proc.itimer_gen
+            self._push(proc.itimer_fire_ns, lambda: self._itimer_fire(proc, new_gen))
         else:
-            proc.itimer_gen += 1
             proc.itimer_fire_ns = 0
         self.deliver_signal(proc, 14)  # SIGALRM
 
@@ -571,14 +579,16 @@ class NetKernel:
             proc._reply(0)
             return True
         proc._reply(0)
-        self.deliver_signal(target, sig)
+        # deliver at the sender's sim time (its clock may be ahead of the
+        # kernel's), like every other cross-process effect (_send_packet)
+        self._push(proc.now, lambda: self.deliver_signal(target, sig))
         return True
 
     def _sys_pause(self, proc, msg):
         if proc.pending_sigs:
             proc._reply(-EINTR)
             return True
-        Waiter(self, proc, [], lambda: False)
+        Waiter(self, proc, [], lambda: False, restartable=False)
         return False
 
     def _shutdown_proc(self, proc: ManagedProcess) -> None:
@@ -695,7 +705,8 @@ class NetKernel:
         self.event_log.append((self.now, f"start {proc.host.name} vpid={proc.vpid}"))
         # reply START_RES: a[0] = virtual pid
         proc.ipc.set_time(SIM_START_UNIX_NS + self.now, 0)
-        proc.ipc.send_to_shim(I.make_msg(I.MSG_START_RES, a=(proc.vpid,)))
+        # a[0]=vpid, a[1]=host ip (the shim needs it for getifaddrs)
+        proc.ipc.send_to_shim(I.make_msg(I.MSG_START_RES, a=(proc.vpid, proc.host.ip)))
         self._service(proc)
 
     def _service(self, proc: ManagedProcess) -> None:
@@ -822,6 +833,20 @@ class NetKernel:
             proc._reply(-2)  # maps to EAI_NONAME in the shim
             return True
         proc._reply(0, a=(0, 0, ip))
+        return True
+
+    def _sys_resolve_rev(self, proc, msg):
+        """Reverse DNS: ip -> registered hostname (dns.c:180
+        dns_resolveIPToAddress analogue)."""
+        ip = int(msg.a[1])
+        if ip == proc.host.ip or (ip >> 24) == (LOCALHOST_NET >> 24):
+            proc._reply(0, buf=proc.host.name.encode() + b"\0")
+            return True
+        name = self.dns.reverse(ip)
+        if name is None:
+            proc._reply(-2)  # EAI_NONAME on the shim side
+            return True
+        proc._reply(0, buf=name.encode() + b"\0")
         return True
 
     def _sys_getrandom(self, proc, msg):
@@ -1626,6 +1651,7 @@ class NetKernel:
             check,
             timeout_at=(proc.now + timeout_ns) if timeout_ns > 0 else None,
             on_timeout=on_timeout,
+            restartable=False,  # poll(2) is never restarted by SA_RESTART
         )
         return False
 
@@ -1681,6 +1707,7 @@ class NetKernel:
             try_report,
             timeout_at=(proc.now + timeout_ns) if timeout_ns > 0 else None,
             on_timeout=on_timeout,
+            restartable=False,  # epoll_wait(2) is never restarted by SA_RESTART
         )
         return False
 
@@ -1841,5 +1868,6 @@ _DISPATCH = {
     I.VSYS_SETITIMER: NetKernel._sys_setitimer,
     I.VSYS_GETITIMER: NetKernel._sys_getitimer,
     I.VSYS_KILL: NetKernel._sys_kill,
+    I.VSYS_RESOLVE_REV: NetKernel._sys_resolve_rev,
     I.VSYS_PAUSE: NetKernel._sys_pause,
 }

@@ -1,0 +1,82 @@
+/* Guest test program: name-service APIs under the shim.
+ * Usage: dns_guest <peer_hostname> <peer_ip_dotted> <own_ip_dotted>
+ * Exercises getaddrinfo, gethostbyname, getnameinfo (forward+reverse),
+ * getifaddrs, gethostname. */
+#include <arpa/inet.h>
+#include <ifaddrs.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#define CHECK(cond, name)                                                      \
+    do {                                                                       \
+        if (!(cond)) {                                                         \
+            printf("FAIL %s\n", name);                                         \
+            return 1;                                                          \
+        }                                                                      \
+        printf("ok %s\n", name);                                               \
+    } while (0)
+
+int main(int argc, char **argv) {
+    if (argc < 4)
+        return 2;
+    const char *peer = argv[1], *peer_ip = argv[2], *own_ip = argv[3];
+
+    struct addrinfo hints, *res = NULL;
+    memset(&hints, 0, sizeof(hints));
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_DGRAM;
+    CHECK(getaddrinfo(peer, "7000", &hints, &res) == 0 && res, "getaddrinfo");
+    char dotted[64];
+    struct sockaddr_in *sin = (struct sockaddr_in *)res->ai_addr;
+    inet_ntop(AF_INET, &sin->sin_addr, dotted, sizeof(dotted));
+    CHECK(strcmp(dotted, peer_ip) == 0, "getaddrinfo-ip");
+    CHECK(ntohs(sin->sin_port) == 7000, "getaddrinfo-port");
+    CHECK(res->ai_socktype == SOCK_DGRAM, "getaddrinfo-socktype");
+
+    /* reverse: ip -> name */
+    char hostbuf[256], servbuf[32];
+    CHECK(getnameinfo((struct sockaddr *)sin, sizeof(*sin), hostbuf,
+                      sizeof(hostbuf), servbuf, sizeof(servbuf), 0) == 0,
+          "getnameinfo");
+    CHECK(strcmp(hostbuf, peer) == 0, "getnameinfo-name");
+    CHECK(strcmp(servbuf, "7000") == 0, "getnameinfo-serv");
+    CHECK(getnameinfo((struct sockaddr *)sin, sizeof(*sin), hostbuf,
+                      sizeof(hostbuf), NULL, 0, NI_NUMERICHOST) == 0 &&
+              strcmp(hostbuf, peer_ip) == 0,
+          "getnameinfo-numeric");
+    freeaddrinfo(res);
+
+    struct hostent *he = gethostbyname(peer);
+    CHECK(he && he->h_addrtype == AF_INET, "gethostbyname");
+    inet_ntop(AF_INET, he->h_addr_list[0], dotted, sizeof(dotted));
+    CHECK(strcmp(dotted, peer_ip) == 0, "gethostbyname-ip");
+
+    /* interfaces: lo + eth0 with our simulated address */
+    struct ifaddrs *ifa = NULL;
+    CHECK(getifaddrs(&ifa) == 0 && ifa, "getifaddrs");
+    int saw_lo = 0, saw_eth = 0;
+    for (struct ifaddrs *i = ifa; i; i = i->ifa_next) {
+        if (!i->ifa_addr || i->ifa_addr->sa_family != AF_INET)
+            continue;
+        struct sockaddr_in *a = (struct sockaddr_in *)i->ifa_addr;
+        inet_ntop(AF_INET, &a->sin_addr, dotted, sizeof(dotted));
+        if (strcmp(i->ifa_name, "lo") == 0 && strcmp(dotted, "127.0.0.1") == 0)
+            saw_lo = 1;
+        if (strcmp(i->ifa_name, "eth0") == 0 && strcmp(dotted, own_ip) == 0)
+            saw_eth = 1;
+    }
+    freeifaddrs(ifa);
+    CHECK(saw_lo, "ifaddrs-lo");
+    CHECK(saw_eth, "ifaddrs-eth0");
+
+    char hn[256];
+    CHECK(gethostname(hn, sizeof(hn)) == 0 && strlen(hn) > 0, "gethostname");
+    printf("hostname=%s\n", hn);
+    printf("dns all ok\n");
+    return 0;
+}

@@ -1,0 +1,49 @@
+"""Name-service API tests (reference: shim_api_addrinfo.c,
+shim_api_ifaddrs.c, dns.c registry + reverse resolution, src/test/ifaddrs
+paired suite)."""
+
+import pathlib
+import subprocess
+
+import pytest
+
+from shadow_tpu.graph import compute_routing
+from shadow_tpu.hostk.kernel import NetKernel, ProcessSpec
+from shadow_tpu.simtime import NS_PER_SEC
+from tests.topo import two_node_graph
+
+GUESTS = pathlib.Path(__file__).parent / "guests"
+
+
+@pytest.fixture(scope="module")
+def dns_bin(tmp_path_factory):
+    out = tmp_path_factory.mktemp("guests") / "dns_guest"
+    subprocess.run(["cc", "-O2", "-o", str(out), str(GUESTS / "dns_guest.c")], check=True)
+    return str(out)
+
+
+def test_dns_apis_under_shim(tmp_path, dns_bin):
+    tables = compute_routing(two_node_graph()).with_hosts([0, 1])
+    k = NetKernel(
+        tables,
+        host_names=["server", "client"],
+        host_nodes=[0, 1],
+        data_dir=tmp_path / "data",
+    )
+    p = k.add_process(
+        ProcessSpec(
+            host="client",
+            args=[dns_bin, "server", "11.0.0.1", "11.0.0.2"],
+        )
+    )
+    try:
+        k.run(NS_PER_SEC)
+    finally:
+        k.shutdown()
+    out = p.stdout().decode()
+    assert p.exit_code == 0, out + p.stderr().decode()
+    assert "dns all ok" in out
+    assert "hostname=client" in out
+    # hosts file exported for native consumption (dns.c:115 analogue)
+    hosts = (tmp_path / "data" / "hosts").read_text()
+    assert "11.0.0.1 server" in hosts and "11.0.0.2 client" in hosts
