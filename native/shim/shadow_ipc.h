@@ -96,6 +96,8 @@ enum {
     VSYS_KILL = 46,      /* a[1]=vpid (0 = self) a[2]=sig */
     VSYS_PAUSE = 47,     /* blocks until a signal is delivered -> -EINTR */
     VSYS_RESOLVE_REV = 48, /* a[1]=ip -> buf=hostname (reverse DNS) */
+    VSYS_DUP2 = 49,      /* a[1]=oldfd a[2]=newfd a[3]=cloexec(ignored) */
+    VSYS_FSTAT = 50,     /* a[1]=fd -> a[2]=type (1 sock, 2 fifo, 3 anon, 4 chr) */
 };
 
 typedef struct {

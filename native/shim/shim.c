@@ -254,6 +254,25 @@ unsigned int sleep(unsigned int seconds) {
     return 0;
 }
 
+int clock_nanosleep(clockid_t clk, int flags, const struct timespec *req,
+                    struct timespec *rem) {
+    if (!g_active) /* returns the error number, never sets errno */
+        return syscall(SYS_clock_nanosleep, clk, flags, req, rem) == 0 ? 0
+                                                                       : errno;
+    struct timespec rel = *req;
+    if (flags & TIMER_ABSTIME) {
+        int64_t now = local_now_ns();
+        int64_t tgt = (int64_t)req->tv_sec * 1000000000LL + req->tv_nsec;
+        int64_t d = tgt > now ? tgt - now : 0;
+        rel.tv_sec = d / 1000000000LL;
+        rel.tv_nsec = (long)(d % 1000000000LL);
+        rem = NULL; /* ABSTIME never reports remaining time */
+    }
+    if (nanosleep(&rel, rem) != 0)
+        return errno; /* clock_nanosleep returns the error, not -1 */
+    return 0;
+}
+
 int usleep(useconds_t usec) {
     if (!g_active)
         return (int)syscall(SYS_nanosleep,
@@ -264,12 +283,54 @@ int usleep(useconds_t usec) {
     return nanosleep(&ts, NULL);
 }
 
-/* ---- identity ---- */
+/* ---- identity (fixed deterministic values; reference handler/unistd) ---- */
 
 pid_t getpid(void) {
     if (!g_active)
         return (pid_t)syscall(SYS_getpid);
     return (pid_t)g_vpid;
+}
+
+pid_t getppid(void) {
+    if (!g_active)
+        return (pid_t)syscall(SYS_getppid);
+    return 1; /* all managed processes are children of the "init" shadow */
+}
+
+pid_t gettid(void) {
+    if (!g_active)
+        return (pid_t)syscall(SYS_gettid);
+    return (pid_t)g_vpid; /* single-threaded managed processes */
+}
+
+uid_t getuid(void) { return g_active ? 1000 : (uid_t)syscall(SYS_getuid); }
+uid_t geteuid(void) { return g_active ? 1000 : (uid_t)syscall(SYS_geteuid); }
+gid_t getgid(void) { return g_active ? 1000 : (gid_t)syscall(SYS_getgid); }
+gid_t getegid(void) { return g_active ? 1000 : (gid_t)syscall(SYS_getegid); }
+
+int sched_yield(void) {
+    if (!g_active)
+        return (int)syscall(SYS_sched_yield);
+    /* fold any accumulated local latency into the host clock so spin
+     * loops that yield make deterministic forward progress */
+    vsys(VSYS_YIELD, 0, 0, 0, NULL, 0, NULL);
+    return 0;
+}
+
+#include <sys/sysinfo.h>
+
+int sysinfo(struct sysinfo *info) {
+    if (!g_active)
+        return (int)syscall(SYS_sysinfo, info);
+    memset(info, 0, sizeof(*info));
+    /* uptime = simulated seconds since the 2000-01-01 epoch */
+    info->uptime = (long)((local_now_ns() - 946684800000000000LL) /
+                          1000000000LL);
+    info->totalram = 16UL << 30;
+    info->freeram = 8UL << 30;
+    info->procs = 1;
+    info->mem_unit = 1;
+    return 0;
 }
 
 /* ---- signals (reference: shim_signals.c + process.rs signal plumbing).
@@ -389,6 +450,172 @@ int pause(void) {
 /* ---- sockets (UDP first tier; TCP rides the device stack later) ---- */
 
 static int is_vfd(int fd) { return fd >= VFD_BASE; }
+
+/* ---- descriptor breadth: dup2/dup3, vectored IO, msghdr IO, fstat,
+ * lseek — on virtual fds (reference: handler/{unistd,uio,socket}.rs) ---- */
+
+int dup2(int oldfd, int newfd) {
+    if (!g_active || !is_vfd(oldfd)) {
+        if (g_active && newfd >= VFD_BASE) {
+            /* a real fd in the virtual range would be misrouted forever */
+            errno = EBADF;
+            return -1;
+        }
+        return (int)syscall(SYS_dup2, oldfd, newfd);
+    }
+    int64_t r = vsys(VSYS_DUP2, oldfd, newfd, 0, NULL, 0, NULL);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    return (int)r;
+}
+
+int dup3(int oldfd, int newfd, int flags) {
+    if (!g_active || !is_vfd(oldfd)) {
+        if (g_active && newfd >= VFD_BASE) {
+            errno = EBADF;
+            return -1;
+        }
+        return (int)syscall(SYS_dup3, oldfd, newfd, flags);
+    }
+    if (oldfd == newfd) {
+        errno = EINVAL; /* dup3 differs from dup2 here */
+        return -1;
+    }
+    int64_t r = vsys(VSYS_DUP2, oldfd, newfd, (flags & O_CLOEXEC) != 0, NULL,
+                     0, NULL);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    return (int)r;
+}
+
+ssize_t readv(int fd, const struct iovec *iov, int iovcnt) {
+    if (!g_active || !is_vfd(fd))
+        return syscall(SYS_readv, fd, iov, iovcnt);
+    /* a short read into the first non-empty iovec is valid readv
+     * behavior and avoids blocking for data beyond what's available */
+    for (int i = 0; i < iovcnt; i++) {
+        if (iov[i].iov_len == 0)
+            continue;
+        return read(fd, iov[i].iov_base, iov[i].iov_len);
+    }
+    return 0;
+}
+
+/* gather an iovec array into the shared scratch buffer; returns the byte
+ * count, or (size_t)-1 if the total exceeds the buffer (caller decides
+ * between short-write and EMSGSIZE semantics) */
+static char g_iov_tmp[SHIM_BUF_SIZE]; /* single-threaded shim */
+
+static size_t gather_iov(const struct iovec *iov, size_t cnt) {
+    size_t total = 0;
+    for (size_t i = 0; i < cnt; i++) {
+        if (iov[i].iov_len > sizeof(g_iov_tmp) - total)
+            return (size_t)-1;
+        memcpy(g_iov_tmp + total, iov[i].iov_base, iov[i].iov_len);
+        total += iov[i].iov_len;
+    }
+    return total;
+}
+
+ssize_t writev(int fd, const struct iovec *iov, int iovcnt) {
+    if (!g_active || !is_vfd(fd))
+        return syscall(SYS_writev, fd, iov, iovcnt);
+    size_t total = gather_iov(iov, (size_t)(iovcnt < 0 ? 0 : iovcnt));
+    if (total == (size_t)-1) {
+        /* stream short-write semantics: send what fits in one message */
+        size_t n = 0;
+        for (int i = 0; i < iovcnt && n < sizeof(g_iov_tmp); i++) {
+            size_t take = iov[i].iov_len;
+            if (take > sizeof(g_iov_tmp) - n)
+                take = sizeof(g_iov_tmp) - n;
+            memcpy(g_iov_tmp + n, iov[i].iov_base, take);
+            n += take;
+        }
+        total = n;
+    }
+    return write(fd, g_iov_tmp, total);
+}
+
+ssize_t sendmsg(int fd, const struct msghdr *msg, int flags) {
+    if (!g_active || !is_vfd(fd))
+        return syscall(SYS_sendmsg, fd, msg, flags);
+    size_t total = gather_iov(msg->msg_iov, msg->msg_iovlen);
+    if (total == (size_t)-1) {
+        /* the socket type is kernel-side; oversized gathers fail rather
+         * than silently truncating a datagram (streams should writev) */
+        errno = EMSGSIZE;
+        return -1;
+    }
+    /* control messages are not simulated; they are silently dropped */
+    return sendto(fd, g_iov_tmp, total, flags,
+                  (struct sockaddr *)msg->msg_name, msg->msg_namelen);
+}
+
+ssize_t recvmsg(int fd, struct msghdr *msg, int flags) {
+    if (!g_active || !is_vfd(fd))
+        return syscall(SYS_recvmsg, fd, msg, flags);
+    /* receive into the first non-empty iovec (short reads are valid;
+     * a zero-length iov[0] must not turn into an unbounded kernel read) */
+    struct iovec *v = NULL;
+    for (size_t i = 0; i < msg->msg_iovlen; i++) {
+        if (msg->msg_iov[i].iov_len > 0) {
+            v = &msg->msg_iov[i];
+            break;
+        }
+    }
+    if (v == NULL) {
+        errno = EINVAL;
+        return -1;
+    }
+    socklen_t alen = msg->msg_namelen;
+    ssize_t r = recvfrom(fd, v->iov_base, v->iov_len, flags,
+                         (struct sockaddr *)msg->msg_name,
+                         msg->msg_name ? &alen : NULL);
+    if (r >= 0) {
+        msg->msg_namelen = msg->msg_name ? alen : 0;
+        msg->msg_controllen = 0;
+        msg->msg_flags = 0;
+    }
+    return r;
+}
+
+int fstat(int fd, struct stat *st) {
+    if (!g_active || !is_vfd(fd))
+        return (int)syscall(SYS_fstat, fd, st);
+    ShimMsg reply;
+    int64_t r = vsys(VSYS_FSTAT, fd, 0, 0, NULL, 0, &reply);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    memset(st, 0, sizeof(*st));
+    switch ((int)reply.a[2]) {
+    case 1:
+        st->st_mode = S_IFSOCK | 0777;
+        break;
+    case 2:
+        st->st_mode = S_IFIFO | 0600;
+        break;
+    case 4:
+        st->st_mode = S_IFCHR | 0666;
+        break;
+    default:
+        st->st_mode = 0600; /* anon inode */
+    }
+    st->st_blksize = 4096;
+    return 0;
+}
+
+off_t lseek(int fd, off_t offset, int whence) {
+    if (!g_active || !is_vfd(fd))
+        return (off_t)syscall(SYS_lseek, fd, offset, whence);
+    errno = ESPIPE; /* sockets/pipes/eventfds are not seekable */
+    return -1;
+}
 
 static int addr_to_parts(const struct sockaddr *addr, socklen_t len,
                          int64_t *ip, int64_t *port) {

@@ -142,6 +142,13 @@ class DescriptorTable:
             return None
         return self.alloc(f)
 
+    def alloc_at(self, file: File, fd: int) -> int:
+        """dup2 semantics: place a reference at a specific (free) slot."""
+        assert fd not in self._files
+        self._files[fd] = file
+        file.refcount += 1
+        return fd
+
     def remove(self, fd: int) -> Optional[File]:
         """Drop one descriptor; returns the file if that was the last ref."""
         f = self._files.pop(fd, None)

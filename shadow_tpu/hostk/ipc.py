@@ -74,6 +74,8 @@ VSYS_GETITIMER = 45
 VSYS_KILL = 46
 VSYS_PAUSE = 47
 VSYS_RESOLVE_REV = 48
+VSYS_DUP2 = 49
+VSYS_FSTAT = 50
 
 VSYS_NAMES = {
     VSYS_NANOSLEEP: "nanosleep",
@@ -124,6 +126,8 @@ VSYS_NAMES = {
     VSYS_KILL: "kill",
     VSYS_PAUSE: "pause",
     VSYS_RESOLVE_REV: "getnameinfo",
+    VSYS_DUP2: "dup2",
+    VSYS_FSTAT: "fstat",
 }
 
 

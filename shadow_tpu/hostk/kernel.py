@@ -885,6 +885,43 @@ class NetKernel:
         proc._reply(nfd if nfd is not None else -EBADF)
         return True
 
+    def _sys_dup2(self, proc, msg):
+        oldfd, newfd = int(msg.a[1]), int(msg.a[2])
+        f = self._file(proc, oldfd)
+        if f is None:
+            proc._reply(-EBADF)
+            return True
+        if newfd < VFD_BASE:
+            # virtual files cannot shadow native fd numbers: the shim
+            # routes by fd range (vfds >= 1000), so dup2 of a simulated
+            # file onto 0/1/2 etc. is not representable
+            proc._reply(-EINVAL)
+            return True
+        if oldfd == newfd:
+            proc._reply(newfd)
+            return True
+        if proc.fdtab.get(newfd) is not None:
+            self._close_fd(proc, newfd)
+        proc.fdtab.alloc_at(f, newfd)
+        proc._reply(newfd)
+        return True
+
+    def _sys_fstat(self, proc, msg):
+        f = self._file(proc, int(msg.a[1]))
+        if f is None:
+            proc._reply(-EBADF)
+            return True
+        if isinstance(f, (T.TcpSocket, UdpSocket, UnixSocket)):
+            t = 1  # S_IFSOCK
+        elif isinstance(f, PipeEnd):
+            t = 2  # S_IFIFO
+        elif isinstance(f, (EventFd, TimerFd, Epoll)):
+            t = 3  # anon inode
+        else:
+            t = 4  # character device (/dev/urandom etc.)
+        proc._reply(0, a=(0, 0, t))
+        return True
+
     def _sys_fcntl(self, proc, msg):
         f = self._file(proc, int(msg.a[1]))
         if f is None:
@@ -1869,5 +1906,7 @@ _DISPATCH = {
     I.VSYS_GETITIMER: NetKernel._sys_getitimer,
     I.VSYS_KILL: NetKernel._sys_kill,
     I.VSYS_RESOLVE_REV: NetKernel._sys_resolve_rev,
+    I.VSYS_DUP2: NetKernel._sys_dup2,
+    I.VSYS_FSTAT: NetKernel._sys_fstat,
     I.VSYS_PAUSE: NetKernel._sys_pause,
 }

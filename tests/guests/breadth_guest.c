@@ -1,0 +1,104 @@
+/* Guest test program: descriptor/identity syscall breadth under the shim.
+ * dup2/dup3, readv/writev, sendmsg/recvmsg, fstat, lseek, identity calls,
+ * sysinfo, sched_yield, clock_nanosleep. */
+#define _GNU_SOURCE
+#include <errno.h>
+#include <fcntl.h>
+#include <sched.h>
+#include <stddef.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/sysinfo.h>
+#include <sys/uio.h>
+#include <sys/un.h>
+#include <time.h>
+#include <unistd.h>
+
+#define CHECK(cond, name)                                                      \
+    do {                                                                       \
+        if (!(cond)) {                                                         \
+            printf("FAIL %s (errno=%d)\n", name, errno);                       \
+            return 1;                                                          \
+        }                                                                      \
+        printf("ok %s\n", name);                                               \
+    } while (0)
+
+int main(void) {
+    /* vectored IO over a pipe */
+    int pfd[2];
+    CHECK(pipe(pfd) == 0, "pipe");
+    struct iovec wv[3] = {{"abc", 3}, {"", 0}, {"defgh", 5}};
+    CHECK(writev(pfd[1], wv, 3) == 8, "writev");
+    char b1[4] = {0}, b2[16] = {0};
+    struct iovec rv[2] = {{b1, 3}, {b2, 8}};
+    ssize_t r = readv(pfd[0], rv, 2);
+    CHECK(r >= 3, "readv"); /* short reads are valid */
+    CHECK(memcmp(b1, "abc", 3) == 0, "readv-content");
+
+    /* dup2 onto a specific virtual slot */
+    int d = dup2(pfd[0], 1500);
+    CHECK(d == 1500, "dup2");
+    CHECK(dup3(pfd[0], 1500, O_CLOEXEC) == 1500, "dup3-replace");
+    CHECK(dup3(pfd[0], pfd[0], 0) == -1 && errno == EINVAL, "dup3-same");
+    /* remaining writev bytes readable through the dup'd fd */
+    ssize_t rest = read(1500, b2, sizeof(b2));
+    CHECK(rest == 8 - r + 3 || rest > 0, "dup2-read");
+    close(1500);
+    close(pfd[0]);
+    close(pfd[1]);
+
+    /* sendmsg/recvmsg over a unix dgram socketpair */
+    int sv[2];
+    CHECK(socketpair(AF_UNIX, SOCK_DGRAM, 0, sv) == 0, "socketpair");
+    struct iovec mv[2] = {{"ping", 4}, {"-pong", 5}};
+    struct msghdr mh;
+    memset(&mh, 0, sizeof(mh));
+    mh.msg_iov = mv;
+    mh.msg_iovlen = 2;
+    CHECK(sendmsg(sv[0], &mh, 0) == 9, "sendmsg");
+    char rb[32] = {0};
+    struct iovec rmv = {rb, sizeof(rb)};
+    struct msghdr rmh;
+    memset(&rmh, 0, sizeof(rmh));
+    rmh.msg_iov = &rmv;
+    rmh.msg_iovlen = 1;
+    CHECK(recvmsg(sv[1], &rmh, 0) == 9 && memcmp(rb, "ping-pong", 9) == 0,
+          "recvmsg");
+
+    /* fstat on a socket reports S_IFSOCK; lseek is ESPIPE */
+    struct stat st;
+    CHECK(fstat(sv[0], &st) == 0 && S_ISSOCK(st.st_mode), "fstat-sock");
+    CHECK(lseek(sv[0], 0, SEEK_SET) == -1 && errno == ESPIPE, "lseek-espipe");
+    close(sv[0]);
+    close(sv[1]);
+
+    /* identity + sysinfo determinism */
+    printf("pid=%d ppid=%d uid=%d gid=%d\n", getpid(), getppid(), getuid(),
+           getgid());
+    struct sysinfo si;
+    CHECK(sysinfo(&si) == 0 && si.totalram > 0, "sysinfo");
+    printf("uptime=%ld\n", si.uptime);
+    CHECK(sched_yield() == 0, "sched_yield");
+
+    /* clock_nanosleep relative + absolute on simulated time */
+    struct timespec ts = {0, 20000000};
+    CHECK(clock_nanosleep(CLOCK_MONOTONIC, 0, &ts, NULL) == 0,
+          "clock_nanosleep-rel");
+    struct timespec now;
+    clock_gettime(CLOCK_REALTIME, &now);
+    struct timespec abs_t = {now.tv_sec, now.tv_nsec};
+    abs_t.tv_sec += 1;
+    long long t0 = (long long)now.tv_sec * 1000000000LL + now.tv_nsec;
+    CHECK(clock_nanosleep(CLOCK_REALTIME, TIMER_ABSTIME, &abs_t, NULL) == 0,
+          "clock_nanosleep-abs");
+    clock_gettime(CLOCK_REALTIME, &now);
+    long long waited = (long long)now.tv_sec * 1000000000LL + now.tv_nsec - t0;
+    CHECK(waited >= 900000000LL && waited <= 1500000000LL,
+          "clock_nanosleep-abs-timing");
+
+    printf("breadth all ok\n");
+    return 0;
+}

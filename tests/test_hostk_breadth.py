@@ -1,0 +1,57 @@
+"""Syscall-breadth tests: dup2/dup3, vectored IO, msghdr IO, fstat,
+lseek, identity, sysinfo, sched_yield, clock_nanosleep (reference:
+handler/{unistd,uio,socket,sysinfo,sched}.rs + the dup/file paired
+suites under src/test/)."""
+
+import pathlib
+import subprocess
+
+import pytest
+
+from shadow_tpu.graph import NetworkGraph, compute_routing
+from shadow_tpu.hostk.kernel import NetKernel, ProcessSpec
+from shadow_tpu.simtime import NS_PER_SEC
+
+GUESTS = pathlib.Path(__file__).parent / "guests"
+
+
+@pytest.fixture(scope="module")
+def breadth_bin(tmp_path_factory):
+    out = tmp_path_factory.mktemp("guests") / "breadth_guest"
+    subprocess.run(
+        ["cc", "-O2", "-o", str(out), str(GUESTS / "breadth_guest.c")], check=True
+    )
+    return str(out)
+
+
+def _run(tmp_path, breadth_bin, sub="a"):
+    graph = NetworkGraph.from_gml(
+        'graph [\n  node [ id 0 ]\n  edge [ source 0 target 0 latency "1 ms" ]\n]'
+    )
+    tables = compute_routing(graph).with_hosts([0])
+    k = NetKernel(tables, host_names=["box"], host_nodes=[0], data_dir=tmp_path / sub)
+    p = k.add_process(ProcessSpec(host="box", args=[breadth_bin]))
+    try:
+        k.run(5 * NS_PER_SEC)
+    finally:
+        k.shutdown()
+    return k, p
+
+
+def test_breadth_under_shim(tmp_path, breadth_bin):
+    k, p = _run(tmp_path, breadth_bin)
+    out = p.stdout().decode()
+    assert p.exit_code == 0, out + p.stderr().decode()
+    assert "breadth all ok" in out
+    # deterministic identity
+    assert "pid=1000 ppid=1 uid=1000 gid=1000" in out
+    # sim uptime starts at 0 (2000-01-01 epoch)
+    assert "uptime=0" in out or "uptime=1" in out
+    assert k.syscall_counts["dup2"] >= 2
+    assert k.syscall_counts["fstat"] >= 1
+
+
+def test_breadth_deterministic(tmp_path, breadth_bin):
+    a = _run(tmp_path, breadth_bin, "r1")[1].stdout()
+    b = _run(tmp_path, breadth_bin, "r2")[1].stdout()
+    assert a == b
