@@ -39,6 +39,8 @@ enum {
     SHIM_MSG_SYSCALL = 3,    /* shim -> shadow: a[0]=vsys, a[1..5]=args  */
     SHIM_MSG_SYSCALL_DONE = 4, /* shadow -> shim: ret (+ buf payload)    */
     SHIM_MSG_PROC_EXIT = 5,  /* shim -> shadow: destructor ran           */
+    SHIM_MSG_THREAD_START = 6, /* shim -> shadow: new thread on its own
+                                * channel; parks until scheduled          */
 };
 
 /* virtual syscall codes (a[0] of SHIM_MSG_SYSCALL). The reference
@@ -98,6 +100,17 @@ enum {
     VSYS_RESOLVE_REV = 48, /* a[1]=ip -> buf=hostname (reverse DNS) */
     VSYS_DUP2 = 49,      /* a[1]=oldfd a[2]=newfd a[3]=cloexec(ignored) */
     VSYS_FSTAT = 50,     /* a[1]=fd -> a[2]=type (1 sock, 2 fifo, 3 anon, 4 chr) */
+    /* threads (reference: native_clone managed_thread.rs:294-365) */
+    VSYS_THREAD_CREATE = 51, /* -> a[2]=tid, buf=shm path for the thread */
+    VSYS_THREAD_EXIT = 52,   /* a[1]=retval */
+    VSYS_THREAD_JOIN = 53,   /* a[1]=tid -> a[2]=retval */
+    VSYS_THREAD_FAILED = 54, /* a[1]=tid (pthread_create failed natively) */
+    /* pthread sync, keyed by guest object address (reference: futex.c) */
+    VSYS_MUTEX_LOCK = 55,    /* a[1]=addr */
+    VSYS_MUTEX_TRYLOCK = 56, /* a[1]=addr */
+    VSYS_MUTEX_UNLOCK = 57,  /* a[1]=addr */
+    VSYS_COND_WAIT = 58,     /* a[1]=cond a[2]=mutex a[3]=timeout ns (-1 none) */
+    VSYS_COND_SIGNAL = 59,   /* a[1]=cond a[2]=broadcast */
 };
 
 typedef struct {

@@ -55,10 +55,18 @@ int shim_channel_recv(ShimChannel *ch, ShimMsg *out, int timeout_ms);
 
 static ShimShmem *g_shm = NULL;
 static int g_active = 0;
-static int64_t g_unapplied = 0;
 static int64_t g_vpid = 0;
 static uint32_t g_host_ip = 0; /* simulated address, host byte order */
-static int g_in_shim = 0; /* recursion guard (reference shim.c:427-439) */
+
+/* per-thread state: each managed thread has its own channel pair in its
+ * own shm block (reference: per-thread IPCData, managed_thread.rs:94-102),
+ * its own local-latency accumulator and recursion guard */
+static __thread ShimShmem *t_shm = NULL; /* NULL = use the process block */
+static __thread int64_t t_tid = 0;       /* 0 = main thread (tid == vpid) */
+static __thread int64_t g_unapplied = 0;
+static __thread int g_in_shim = 0; /* recursion guard (reference shim.c:427-439) */
+
+static inline ShimShmem *cur_shm(void) { return t_shm ? t_shm : g_shm; }
 
 /* ---- raw syscalls for passthrough (avoid dlsym recursion) ---- */
 
@@ -69,8 +77,10 @@ static long raw_clock_gettime(clockid_t c, struct timespec *ts) {
 /* ---- IPC core ---- */
 
 static void ipc_call(ShimMsg *m) {
-    shim_channel_send(&g_shm->to_shadow, m);
-    shim_channel_recv(&g_shm->to_shim, m, -1);
+    ShimShmem *s = cur_shm();
+    m->tid = (uint32_t)(t_tid ? t_tid : g_vpid);
+    shim_channel_send(&s->to_shadow, m);
+    shim_channel_recv(&s->to_shim, m, -1);
     if (m->sig) {
         /* Shadow queued a signal for this process: run the native handler
          * before the interrupted call returns, exactly where the kernel
@@ -132,15 +142,16 @@ static int64_t vsys(int code, int64_t a1, int64_t a2, int64_t a3,
 /* ---- local time (reference shim_sys.c:58-90) ---- */
 
 static int64_t local_now_ns(void) {
+    ShimShmem *s = cur_shm();
     int64_t t =
-        atomic_load_explicit(&g_shm->sim_time_ns, memory_order_acquire) +
+        atomic_load_explicit(&s->sim_time_ns, memory_order_acquire) +
         g_unapplied;
-    g_unapplied += g_shm->vdso_latency_ns;
-    if (g_unapplied > g_shm->max_unapplied_ns && !g_in_shim) {
+    g_unapplied += s->vdso_latency_ns;
+    if (g_unapplied > s->max_unapplied_ns && !g_in_shim) {
         g_in_shim = 1;
         vsys(VSYS_YIELD, 0, 0, 0, NULL, 0, NULL);
         g_in_shim = 0;
-        t = atomic_load_explicit(&g_shm->sim_time_ns, memory_order_acquire);
+        t = atomic_load_explicit(&s->sim_time_ns, memory_order_acquire);
     }
     return t;
 }
@@ -300,7 +311,7 @@ pid_t getppid(void) {
 pid_t gettid(void) {
     if (!g_active)
         return (pid_t)syscall(SYS_gettid);
-    return (pid_t)g_vpid; /* single-threaded managed processes */
+    return (pid_t)(t_tid ? t_tid : g_vpid);
 }
 
 uid_t getuid(void) { return g_active ? 1000 : (uid_t)syscall(SYS_getuid); }
@@ -330,6 +341,206 @@ int sysinfo(struct sysinfo *info) {
     info->freeram = 8UL << 30;
     info->procs = 1;
     info->mem_unit = 1;
+    return 0;
+}
+
+/* ---- threads (reference: native_clone managed_thread.rs:294-365 + the
+ * per-thread IPC channels of ipc.rs). The simulation runs exactly one
+ * thread at a time: a new thread mmaps its own channel block, announces
+ * itself, and parks until the kernel schedules it. pthread mutexes and
+ * condvars are interposed so blocking goes through the simulator — two
+ * serialized threads contending on a *native* futex would deadlock. ---- */
+
+#include <pthread.h>
+
+typedef struct {
+    void *(*fn)(void *);
+    void *arg;
+    int64_t tid;
+    char path[256];
+} ThreadBoot;
+
+#define MAX_THREADS 256
+static struct {
+    pthread_t pt;
+    int64_t tid;
+} g_thread_map[MAX_THREADS]; /* only mutated by the single running thread */
+static int g_thread_count = 0;
+
+static void *thread_trampoline(void *p) {
+    ThreadBoot tb = *(ThreadBoot *)p;
+    free(p);
+    int fd = open(tb.path, O_RDWR);
+    if (fd < 0)
+        return NULL;
+    void *m = mmap(NULL, SHIM_SHMEM_SIZE, PROT_READ | PROT_WRITE, MAP_SHARED,
+                   fd, 0);
+    close(fd);
+    if (m == MAP_FAILED)
+        return NULL;
+    t_shm = (ShimShmem *)m;
+    t_tid = tb.tid;
+    /* announce on our own channel and park until scheduled */
+    ShimMsg msg;
+    memset(&msg, 0, offsetof(ShimMsg, buf));
+    msg.kind = SHIM_MSG_THREAD_START;
+    msg.tid = (uint32_t)tb.tid;
+    msg.a[0] = tb.tid;
+    shim_channel_send(&t_shm->to_shadow, &msg);
+    shim_channel_recv(&t_shm->to_shim, &msg, -1);
+    void *ret = tb.fn(tb.arg);
+    vsys(VSYS_THREAD_EXIT, (int64_t)(intptr_t)ret, 0, 0, NULL, 0, NULL);
+    return ret;
+}
+
+void pthread_exit(void *retval) {
+    static void (*real)(void *) __attribute__((noreturn));
+    if (!real)
+        real = (void (*)(void *))dlsym(RTLD_NEXT, "pthread_exit");
+    if (g_active && t_tid != 0) /* worker thread: tell the simulator first */
+        vsys(VSYS_THREAD_EXIT, (int64_t)(intptr_t)retval, 0, 0, NULL, 0, NULL);
+    real(retval);
+    __builtin_unreachable();
+}
+
+int pthread_create(pthread_t *t, const pthread_attr_t *attr,
+                   void *(*fn)(void *), void *arg) {
+    static int (*real)(pthread_t *, const pthread_attr_t *, void *(*)(void *),
+                       void *);
+    if (!real)
+        real = (int (*)(pthread_t *, const pthread_attr_t *, void *(*)(void *),
+                        void *))dlsym(RTLD_NEXT, "pthread_create");
+    if (!g_active)
+        return real(t, attr, fn, arg);
+    if (g_thread_count >= MAX_THREADS)
+        return EAGAIN; /* a dropped mapping would deadlock a later join */
+    ShimMsg reply;
+    int64_t r = vsys(VSYS_THREAD_CREATE, 0, 0, 0, NULL, 0, &reply);
+    if (r < 0)
+        return (int)-r;
+    ThreadBoot *tb = malloc(sizeof(*tb));
+    if (!tb)
+        return ENOMEM;
+    tb->fn = fn;
+    tb->arg = arg;
+    tb->tid = reply.a[2];
+    size_t n = reply.buf_len < sizeof(tb->path) - 1 ? reply.buf_len
+                                                    : sizeof(tb->path) - 1;
+    memcpy(tb->path, reply.buf, n);
+    tb->path[n] = '\0';
+    int rc = real(t, attr, thread_trampoline, tb);
+    if (rc != 0) {
+        vsys(VSYS_THREAD_FAILED, tb->tid, 0, 0, NULL, 0, NULL);
+        free(tb);
+        return rc;
+    }
+    if (g_thread_count < MAX_THREADS) {
+        g_thread_map[g_thread_count].pt = *t;
+        g_thread_map[g_thread_count].tid = tb->tid;
+        g_thread_count++;
+    }
+    return 0;
+}
+
+int pthread_join(pthread_t t, void **retval) {
+    static int (*real)(pthread_t, void **);
+    if (!real)
+        real = (int (*)(pthread_t, void **))dlsym(RTLD_NEXT, "pthread_join");
+    if (!g_active)
+        return real(t, retval);
+    /* glibc reuses pthread_t values once a thread is joined, so match
+     * newest-first and retire the entry on successful join */
+    int64_t tid = -1;
+    int slot = -1;
+    for (int i = g_thread_count - 1; i >= 0; i--) {
+        if (pthread_equal(g_thread_map[i].pt, t)) {
+            tid = g_thread_map[i].tid;
+            slot = i;
+            break;
+        }
+    }
+    if (tid < 0) /* not one of ours (e.g. created before attach) */
+        return real(t, retval);
+    ShimMsg reply;
+    int64_t r = vsys(VSYS_THREAD_JOIN, tid, 0, 0, NULL, 0, &reply);
+    if (r < 0)
+        return (int)-r;
+    real(t, NULL); /* reap the native thread; it has already exited */
+    g_thread_map[slot] = g_thread_map[--g_thread_count];
+    if (retval)
+        *retval = (void *)(intptr_t)reply.a[2];
+    return 0;
+}
+
+/* pthread sync objects, keyed by guest address (state lives kernel-side) */
+
+#define REAL(name, ret_t, ...)                                                \
+    static ret_t (*real_##name)(__VA_ARGS__);                                  \
+    if (!real_##name)                                                          \
+        real_##name = (ret_t(*)(__VA_ARGS__))dlsym(RTLD_NEXT, #name);
+
+int pthread_mutex_lock(pthread_mutex_t *m) {
+    REAL(pthread_mutex_lock, int, pthread_mutex_t *)
+    if (!g_active)
+        return real_pthread_mutex_lock(m);
+    int64_t r = vsys(VSYS_MUTEX_LOCK, (int64_t)(intptr_t)m, 0, 0, NULL, 0, NULL);
+    return r < 0 ? (int)-r : 0;
+}
+
+int pthread_mutex_trylock(pthread_mutex_t *m) {
+    REAL(pthread_mutex_trylock, int, pthread_mutex_t *)
+    if (!g_active)
+        return real_pthread_mutex_trylock(m);
+    int64_t r = vsys(VSYS_MUTEX_TRYLOCK, (int64_t)(intptr_t)m, 0, 0, NULL, 0,
+                     NULL);
+    return r < 0 ? (int)-r : 0;
+}
+
+int pthread_mutex_unlock(pthread_mutex_t *m) {
+    REAL(pthread_mutex_unlock, int, pthread_mutex_t *)
+    if (!g_active)
+        return real_pthread_mutex_unlock(m);
+    int64_t r = vsys(VSYS_MUTEX_UNLOCK, (int64_t)(intptr_t)m, 0, 0, NULL, 0,
+                     NULL);
+    return r < 0 ? (int)-r : 0;
+}
+
+int pthread_cond_wait(pthread_cond_t *c, pthread_mutex_t *m) {
+    REAL(pthread_cond_wait, int, pthread_cond_t *, pthread_mutex_t *)
+    if (!g_active)
+        return real_pthread_cond_wait(c, m);
+    int64_t r = vsys(VSYS_COND_WAIT, (int64_t)(intptr_t)c,
+                     (int64_t)(intptr_t)m, -1, NULL, 0, NULL);
+    return r < 0 ? (int)-r : 0;
+}
+
+int pthread_cond_timedwait(pthread_cond_t *c, pthread_mutex_t *m,
+                           const struct timespec *abstime) {
+    REAL(pthread_cond_timedwait, int, pthread_cond_t *, pthread_mutex_t *,
+         const struct timespec *)
+    if (!g_active)
+        return real_pthread_cond_timedwait(c, m, abstime);
+    int64_t now = local_now_ns();
+    int64_t tgt = (int64_t)abstime->tv_sec * 1000000000LL + abstime->tv_nsec;
+    int64_t rel = tgt > now ? tgt - now : 0;
+    int64_t r = vsys(VSYS_COND_WAIT, (int64_t)(intptr_t)c,
+                     (int64_t)(intptr_t)m, rel, NULL, 0, NULL);
+    return r < 0 ? (int)-r : 0;
+}
+
+int pthread_cond_signal(pthread_cond_t *c) {
+    REAL(pthread_cond_signal, int, pthread_cond_t *)
+    if (!g_active)
+        return real_pthread_cond_signal(c);
+    vsys(VSYS_COND_SIGNAL, (int64_t)(intptr_t)c, 0, 0, NULL, 0, NULL);
+    return 0;
+}
+
+int pthread_cond_broadcast(pthread_cond_t *c) {
+    REAL(pthread_cond_broadcast, int, pthread_cond_t *)
+    if (!g_active)
+        return real_pthread_cond_broadcast(c);
+    vsys(VSYS_COND_SIGNAL, (int64_t)(intptr_t)c, 1, 0, NULL, 0, NULL);
     return 0;
 }
 

@@ -42,6 +42,8 @@ ENOENT = 2
 EMSGSIZE = 90
 ENOTSOCK = 88
 ESRCH = 3
+ETIMEDOUT = 110
+EBUSY = 16
 
 # epoll event bits (uapi)
 EPOLLIN = 0x001

@@ -76,6 +76,18 @@ VSYS_PAUSE = 47
 VSYS_RESOLVE_REV = 48
 VSYS_DUP2 = 49
 VSYS_FSTAT = 50
+VSYS_THREAD_CREATE = 51
+VSYS_THREAD_EXIT = 52
+VSYS_THREAD_JOIN = 53
+VSYS_THREAD_FAILED = 54
+VSYS_MUTEX_LOCK = 55
+VSYS_MUTEX_TRYLOCK = 56
+VSYS_MUTEX_UNLOCK = 57
+VSYS_COND_WAIT = 58
+VSYS_COND_SIGNAL = 59
+
+# message kind for a new thread announcing itself on its own channel
+MSG_THREAD_START = 6
 
 VSYS_NAMES = {
     VSYS_NANOSLEEP: "nanosleep",
@@ -128,6 +140,15 @@ VSYS_NAMES = {
     VSYS_RESOLVE_REV: "getnameinfo",
     VSYS_DUP2: "dup2",
     VSYS_FSTAT: "fstat",
+    VSYS_THREAD_CREATE: "clone",  # libc-visible names for strace parity
+    VSYS_THREAD_EXIT: "exit",
+    VSYS_THREAD_JOIN: "pthread_join",
+    VSYS_THREAD_FAILED: "clone_failed",
+    VSYS_MUTEX_LOCK: "futex_lock",
+    VSYS_MUTEX_TRYLOCK: "futex_trylock",
+    VSYS_MUTEX_UNLOCK: "futex_unlock",
+    VSYS_COND_WAIT: "futex_wait",
+    VSYS_COND_SIGNAL: "futex_wake",
 }
 
 

@@ -48,8 +48,11 @@ from shadow_tpu.hostk.descriptor import (
     EBADF,
     EADDRINUSE,
     ECONNREFUSED,
+    EBUSY,
     EINTR,
+    EPERM,
     ESRCH,
+    ETIMEDOUT,
     EDESTADDRREQ,
     EINPROGRESS,
     EINVAL,
@@ -123,6 +126,7 @@ class Waiter:
         on_timeout: Optional[Callable[[], None]] = None,
         on_interrupt: Optional[Callable[[], None]] = None,
         restartable: bool = True,
+        sig_interruptible: bool = True,
     ):
         self.kernel = kernel
         self.proc = proc
@@ -134,6 +138,9 @@ class Waiter:
         self.on_interrupt = on_interrupt  # custom EINTR reply (e.g. nanosleep rem)
         # pause/poll/epoll_wait are never restarted by SA_RESTART on Linux
         self.restartable = restartable
+        # pthread mutex/cond/join waits never return EINTR (POSIX); a
+        # queued signal is delivered once the wait completes
+        self.sig_interruptible = sig_interruptible
         proc.waiter = self
         for f in files:
             f.add_listener(self._cb)
@@ -155,7 +162,7 @@ class Waiter:
             self._checking = False
 
     def _cb(self, _f: File) -> None:
-        if self.done or self._checking or self.proc.state == "exited":
+        if self.done or self._checking or self.proc.dead:
             return
         self.proc.now = max(self.proc.now, self.kernel.now)
         if self._run_check():
@@ -164,7 +171,7 @@ class Waiter:
             self.kernel._service(self.proc)
 
     def _timeout_fire(self) -> None:
-        if self.done or self._checking or self.proc.state == "exited":
+        if self.done or self._checking or self.proc.dead:
             return
         self.proc.now = max(self.proc.now, self.kernel.now)
         if self._run_check():  # raced: became ready at the same instant
@@ -179,42 +186,165 @@ class Waiter:
         self.kernel._service(self.proc)
 
 
+class GuestThread:
+    """One managed thread: its own futex channel pair + per-thread clock
+    and run state (reference: ManagedThread, managed_thread.rs:40; the
+    reference likewise runs exactly one thread of the whole simulation at
+    a time via per-thread ping-pong channels)."""
+
+    def __init__(self, process: "ManagedProcess", tid: int, ipc: "Optional[I.IpcBlock]"):
+        self.process = process
+        self.kernel = process.kernel
+        self.tid = tid
+        self.ipc = ipc
+        self.now = 0
+        self.state = "pending"  # pending -> running -> blocked -> exited
+        self.waiter: Optional[Waiter] = None
+        self._pending: Optional[tuple[str, str]] = None  # strace line await reply
+        self.pending_sigs: "deque[int]" = deque()
+        self.retval = 0  # THREAD_EXIT value for joiners
+        self.exit_evt = File()  # joiners listen here
+
+    # ---- process delegation: syscall handlers treat a thread as the
+    # calling context, most state is process-wide --------------------------
+
+    @property
+    def host(self):
+        return self.process.host
+
+    @property
+    def fdtab(self):
+        return self.process.fdtab
+
+    @property
+    def spec(self):
+        return self.process.spec
+
+    @property
+    def vpid(self):
+        return self.process.vpid
+
+    @property
+    def strace(self):
+        return self.process.strace
+
+    @property
+    def syscall_log(self):
+        return self.process.syscall_log
+
+    @property
+    def sig_handlers(self):
+        return self.process.sig_handlers
+
+    @property
+    def dead(self) -> bool:
+        return self.state == "exited" or self.process.exited
+
+    # ---- channel ---------------------------------------------------------
+
+    def _recv(self, max_wall_s: "Optional[float]" = None):
+        """Blocking receive with child-death detection (the reference pairs
+        this with ChildPidWatcher closing the channel,
+        utility/childpid_watcher.rs). Returns None if the process died,
+        False if max_wall_s elapsed."""
+        import time as _time
+
+        deadline = _time.monotonic() + max_wall_s if max_wall_s else None
+        while True:
+            msg = self.ipc.recv_from_shim(timeout_ms=100)
+            if msg is not None:
+                return msg
+            if self.process.popen.poll() is not None:
+                return None
+            if deadline is not None and _time.monotonic() > deadline:
+                return False
+
+    def _reply(self, ret: int = 0, a=(), buf: bytes = b"") -> None:
+        if self._pending is not None and self.strace is not None:
+            name, args = self._pending
+            self.strace.log(self.now, name, args, ret, tid=self.tid)
+        self._pending = None
+        self.ipc.set_time(SIM_START_UNIX_NS + self.now, 0)
+        m = I.make_msg(I.MSG_SYSCALL_DONE, a=a, ret=ret, buf=buf)
+        if self.pending_sigs:  # deliver one queued signal with this return
+            m.sig = self.pending_sigs.popleft()
+        self.ipc.send_to_shim(m)
+
+    def mark_exited(self) -> None:
+        if self.state != "exited":
+            self.state = "exited"
+            self.exit_evt.notify()
+
+
 class ManagedProcess:
     def __init__(self, kernel: "NetKernel", spec: ProcessSpec, host: "HostKernel", vpid: int):
         self.kernel = kernel
         self.spec = spec
         self.host = host
         self.vpid = vpid
-        self.now = 0
-        self.ipc: Optional[I.IpcBlock] = None
         self.popen: Optional[subprocess.Popen] = None
         self.fdtab = DescriptorTable()
-        self.state = "pending"  # pending -> running -> blocked -> exited
-        self.waiter: Optional[Waiter] = None
+        self.threads: "list[GuestThread]" = []
+        self.exited = False
         self.syscall_log: list[tuple[int, str, tuple]] = []
         self.exit_code: Optional[int] = None
         self._stdout_path = None
         self.strace: Optional[StraceFile] = None
-        self._pending: Optional[tuple[str, str]] = None  # (name, args) awaiting reply
         # signal state (reference: process.rs signal bookkeeping + the
         # pending-unblocked-signal handoff shim_shmem.rs:252-268)
-        self.pending_sigs: "deque[int]" = deque()
         self.sig_handlers: dict[int, int] = {}  # sig -> 0 dfl | 1 ign | 2 handler
         self.shutdown_requested = False  # config shutdown_time fired
         self.itimer_fire_ns = 0  # 0 = disarmed
         self.itimer_interval_ns = 0
         self.itimer_gen = 0
+        # pthread sync objects, keyed by guest address
+        # (reference: futex.c/futex_table.c serve the same role one level
+        # down; the shim interposes at the pthread layer instead)
+        self.mutexes: dict[int, "KMutex"] = {}
+        self.conds: dict[int, "KCond"] = {}
+
+    # ---- main-thread conveniences (tests + process-level call sites) ----
+
+    @property
+    def main(self) -> "Optional[GuestThread]":
+        return self.threads[0] if self.threads else None
+
+    @property
+    def state(self) -> str:
+        if self.exited:
+            return "exited"
+        return self.main.state if self.main else "pending"
+
+    @property
+    def now(self) -> int:
+        return max((t.now for t in self.threads), default=0)
+
+    @property
+    def ipc(self):
+        return self.main.ipc if self.main else None
+
+    def mark_exited(self) -> None:
+        self.exited = True
+        for t in self.threads:
+            if t.waiter is not None:
+                t.waiter._detach()
+            t.mark_exited()
 
     # --- lifecycle -------------------------------------------------------
 
     def spawn(self, now_ns: int) -> None:
-        self.now = now_ns
-        self.ipc = I.IpcBlock(
-            tag=f"h{self.host.host_id}p{self.vpid}",
-            vdso_latency_ns=self.kernel.vdso_latency_ns,
-            syscall_latency_ns=self.kernel.syscall_latency_ns,
-            max_unapplied_ns=self.kernel.max_unapplied_ns,
+        main = GuestThread(
+            self,
+            self.vpid,
+            I.IpcBlock(
+                tag=f"h{self.host.host_id}p{self.vpid}",
+                vdso_latency_ns=self.kernel.vdso_latency_ns,
+                syscall_latency_ns=self.kernel.syscall_latency_ns,
+                max_unapplied_ns=self.kernel.max_unapplied_ns,
+            ),
         )
+        main.now = now_ns
+        self.threads.append(main)
         self.ipc.set_time(SIM_START_UNIX_NS + now_ns, 0)
         env = dict(os.environ)
         env.update(self.spec.environment)
@@ -243,13 +373,13 @@ class ManagedProcess:
             stdin=subprocess.DEVNULL,
         )
         # shim constructor sends START_REQ before main() runs
-        msg = self._recv()
+        msg = main._recv()
         if msg is None or msg.kind != I.MSG_START_REQ:
             raise SimPanic(
                 f"{self.host.name}: process failed to attach "
                 f"(kind={getattr(msg, 'kind', None)}, rc={self.popen.poll()})"
             )
-        self.state = "running"
+        main.state = "running"
 
     def stdout(self) -> bytes:
         return pathlib.Path(self._stdout_path).read_bytes() if self._stdout_path else b""
@@ -258,39 +388,38 @@ class ManagedProcess:
         return pathlib.Path(self._stderr_path).read_bytes() if self._stderr_path else b""
 
     def kill(self) -> None:
+        self.exited = True
         if self.popen and self.popen.poll() is None:
             self.popen.kill()
             self.popen.wait()
         if self.strace:
             self.strace.close()
             self.strace = None
-        if self.ipc:
-            self.ipc.close()
-            self.ipc = None
+        for t in self.threads:
+            t.mark_exited()
+            if t.ipc is not None:
+                t.ipc.close()
+                t.ipc = None
 
-    # --- channel helpers -------------------------------------------------
 
-    def _recv(self) -> Optional[I.ShimMsg]:
-        """Blocking receive with child-death detection (the reference pairs
-        this with ChildPidWatcher closing the channel,
-        utility/childpid_watcher.rs)."""
-        while True:
-            msg = self.ipc.recv_from_shim(timeout_ms=100)
-            if msg is not None:
-                return msg
-            if self.popen.poll() is not None:
-                return None
+class KMutex(File):
+    """Kernel-side pthread mutex: lock state lives here so strictly
+    serialized guest threads can never deadlock on a native futex
+    (reference: futex.c/futex_table.c at the syscall layer)."""
 
-    def _reply(self, ret: int = 0, a=(), buf: bytes = b"") -> None:
-        if self._pending is not None and self.strace is not None:
-            name, args = self._pending
-            self.strace.log(self.now, name, args, ret)
-        self._pending = None
-        self.ipc.set_time(SIM_START_UNIX_NS + self.now, 0)
-        m = I.make_msg(I.MSG_SYSCALL_DONE, a=a, ret=ret, buf=buf)
-        if self.pending_sigs:  # deliver one queued signal with this return
-            m.sig = self.pending_sigs.popleft()
-        self.ipc.send_to_shim(m)
+    def __init__(self):
+        super().__init__()
+        self.owner: Optional[int] = None  # tid
+
+
+class KCond(File):
+    """Kernel-side pthread condvar: signal tickets + broadcast generation;
+    waiters re-check through the listener plumbing."""
+
+    def __init__(self):
+        super().__init__()
+        self.signals = 0
+        self.generation = 0
 
 
 class HostKernel:
@@ -394,6 +523,7 @@ class NetKernel:
 
         self.now = 0
         self._seq = 0
+        self._next_tid = 20_000  # thread ids, disjoint from vpids
         self.events: list[tuple[int, int, Callable[[], None]]] = []
         self.procs: list[ManagedProcess] = []
         self.event_log: list[tuple[int, str]] = []
@@ -451,12 +581,16 @@ class NetKernel:
     ERESTART = 512  # kernel-internal ERESTARTSYS: shim re-issues the syscall
 
     def deliver_signal(self, proc: ManagedProcess, sig: int) -> None:
-        """Queue a signal for a process at the current sim time. Handler-
-        registered signals ride the next IPC reply (the shim raises them
-        natively); default-disposition fatal signals terminate the process;
-        ignored signals are dropped. SA_RESTART handlers restart the
-        interrupted file syscall (the shim resends it on ERESTART)."""
-        if proc.state == "exited":
+        """Queue a signal for a process at the current sim time, directed
+        at its main thread (POSIX allows any thread with the signal
+        unblocked; the choice is fixed for determinism). Handler-registered
+        signals ride the next IPC reply (the shim raises them natively);
+        default-disposition fatal signals terminate the process; ignored
+        signals are dropped. SA_RESTART handlers restart the interrupted
+        file syscall (the shim resends it on ERESTART)."""
+        if isinstance(proc, GuestThread):
+            proc = proc.process
+        if proc.exited:
             return
         kind = proc.sig_handlers.get(sig, 0)
         if sig == 9:  # SIGKILL cannot be caught or ignored
@@ -469,19 +603,22 @@ class NetKernel:
             self._terminate_by_signal(proc, sig)
             return
         restart = bool(kind & 0x10)
-        proc.pending_sigs.append(sig)
-        if proc.state == "blocked" and proc.waiter is not None:
-            w = proc.waiter
+        thread = proc.main
+        thread.pending_sigs.append(sig)
+        if thread.state == "blocked" and thread.waiter is not None:
+            w = thread.waiter
+            if not w.sig_interruptible:
+                return  # rides the reply when the wait completes
             w._detach()
-            proc.now = max(proc.now, self.now)
-            proc.state = "running"
+            thread.now = max(thread.now, self.now)
+            thread.state = "running"
             if w.on_interrupt is not None:
                 w.on_interrupt()  # syscall-specific EINTR reply (never restarts)
             elif restart and w.restartable:
-                proc._reply(-self.ERESTART)
+                thread._reply(-self.ERESTART)
             else:
-                proc._reply(-EINTR)
-            self._service(proc)
+                thread._reply(-EINTR)
+            self._service(thread)
 
     def _terminate_by_signal(self, proc: ManagedProcess, sig: int) -> None:
         """Default disposition: the real process gets the real signal, so
@@ -489,9 +626,10 @@ class NetKernel:
         self.event_log.append(
             (self.now, f"killed {proc.host.name}/{proc.vpid} sig={sig}")
         )
-        if proc.waiter is not None:
-            proc.waiter._detach()
-        proc.state = "exited"
+        proc.exited = True
+        for t in proc.threads:
+            if t.waiter is not None:
+                t.waiter._detach()
         for fd in proc.fdtab.fds():
             self._close_fd(proc, fd)
         if proc.popen is not None and proc.popen.poll() is None:
@@ -508,59 +646,67 @@ class NetKernel:
         proc._reply(0)
         return True
 
-    def _itimer_remaining(self, proc: ManagedProcess) -> int:
-        return max(0, proc.itimer_fire_ns - proc.now) if proc.itimer_fire_ns else 0
+    @staticmethod
+    def _itimer_remaining(process: ManagedProcess, now: int) -> int:
+        return max(0, process.itimer_fire_ns - now) if process.itimer_fire_ns else 0
 
-    def _arm_itimer(self, proc: ManagedProcess, value_ns: int, interval_ns: int) -> None:
-        proc.itimer_gen += 1
+    def _arm_itimer(
+        self, process: ManagedProcess, base_ns: int, value_ns: int, interval_ns: int
+    ) -> None:
+        process.itimer_gen += 1
         if value_ns <= 0:
-            proc.itimer_fire_ns = 0
-            proc.itimer_interval_ns = 0
+            process.itimer_fire_ns = 0
+            process.itimer_interval_ns = 0
             return
-        proc.itimer_fire_ns = proc.now + value_ns
-        proc.itimer_interval_ns = interval_ns
-        gen = proc.itimer_gen
-        self._push(proc.itimer_fire_ns, lambda: self._itimer_fire(proc, gen))
+        process.itimer_fire_ns = base_ns + value_ns
+        process.itimer_interval_ns = interval_ns
+        gen = process.itimer_gen
+        self._push(process.itimer_fire_ns, lambda: self._itimer_fire(process, gen))
 
-    def _itimer_fire(self, proc: ManagedProcess, gen: int) -> None:
-        if gen != proc.itimer_gen or proc.state == "exited":
+    def _itimer_fire(self, process: ManagedProcess, gen: int) -> None:
+        if gen != process.itimer_gen or process.exited:
             return  # re-armed or cancelled since scheduled
-        proc.now = max(proc.now, self.now)
-        expiry = proc.itimer_fire_ns
-        interval = proc.itimer_interval_ns
-        proc.itimer_gen += 1
+        expiry = process.itimer_fire_ns
+        interval = process.itimer_interval_ns
+        process.itimer_gen += 1
         if interval > 0:
             # re-arm from the expiry, not the (possibly later) proc clock —
             # the cadence must not drift (as with the kernel's own timers)
-            proc.itimer_fire_ns = expiry + interval
-            new_gen = proc.itimer_gen
-            self._push(proc.itimer_fire_ns, lambda: self._itimer_fire(proc, new_gen))
+            process.itimer_fire_ns = expiry + interval
+            new_gen = process.itimer_gen
+            self._push(process.itimer_fire_ns, lambda: self._itimer_fire(process, new_gen))
         else:
-            proc.itimer_fire_ns = 0
-        self.deliver_signal(proc, 14)  # SIGALRM
+            process.itimer_fire_ns = 0
+        self.deliver_signal(process, 14)  # SIGALRM
 
     def _sys_alarm(self, proc, msg):
-        remaining = self._itimer_remaining(proc)
-        self._arm_itimer(proc, int(msg.a[1]) * 1_000_000_000, 0)
+        remaining = self._itimer_remaining(proc.process, proc.now)
+        self._arm_itimer(proc.process, proc.now, int(msg.a[1]) * 1_000_000_000, 0)
         proc._reply((remaining + 999_999_999) // 1_000_000_000)
         return True
 
     def _sys_setitimer(self, proc, msg):
-        old_val, old_itv = self._itimer_remaining(proc), proc.itimer_interval_ns
-        self._arm_itimer(proc, int(msg.a[1]), int(msg.a[2]))
+        process = proc.process
+        old_val = self._itimer_remaining(process, proc.now)
+        old_itv = process.itimer_interval_ns
+        self._arm_itimer(process, proc.now, int(msg.a[1]), int(msg.a[2]))
         proc._reply(0, a=(0, 0, old_val, old_itv))
         return True
 
     def _sys_getitimer(self, proc, msg):
-        proc._reply(0, a=(0, 0, self._itimer_remaining(proc), proc.itimer_interval_ns))
+        process = proc.process
+        proc._reply(
+            0,
+            a=(0, 0, self._itimer_remaining(process, proc.now), process.itimer_interval_ns),
+        )
         return True
 
     def _sys_kill(self, proc, msg):
         vpid, sig = int(msg.a[1]), int(msg.a[2])
-        target = proc if vpid == 0 else next(
+        target = proc.process if vpid == 0 else next(
             (p for p in self.procs if p.vpid == vpid), None
         )
-        if target is None or target.state == "exited":
+        if target is None or target.exited:
             proc._reply(-ESRCH)
             return True
         if not 0 <= sig <= 64:
@@ -569,12 +715,12 @@ class NetKernel:
         if sig == 0:  # existence probe
             proc._reply(0)
             return True
-        if target is proc:
+        if target is proc.process:
             # queue first so the signal rides this very reply (handler runs
             # before kill() returns, as on Linux); a fatal default kills the
             # process with no reply at all
             self.deliver_signal(target, sig)
-            if proc.state == "exited":
+            if proc.dead:
                 return True
             proc._reply(0)
             return True
@@ -590,6 +736,175 @@ class NetKernel:
             return True
         Waiter(self, proc, [], lambda: False, restartable=False)
         return False
+
+    # --- threads (reference: ManagedThread + native_clone,
+    # managed_thread.rs:294-365; scheduling stays strictly serial) --------
+
+    def _sys_thread_create(self, proc, msg):
+        process = proc.process
+        tid = self._next_tid
+        self._next_tid += 1
+        ipc = I.IpcBlock(
+            tag=f"h{process.host.host_id}p{process.vpid}t{tid}",
+            vdso_latency_ns=self.vdso_latency_ns,
+            syscall_latency_ns=self.syscall_latency_ns,
+            max_unapplied_ns=self.max_unapplied_ns,
+        )
+        t = GuestThread(process, tid, ipc)
+        t.now = proc.now
+        process.threads.append(t)
+        # the creator (still released) spawns the native thread after this
+        # reply; the new thread's STARTED handshake is consumed once the
+        # whole simulation parks (event below), keeping one-at-a-time
+        self._push(proc.now, lambda: self._start_thread(t))
+        proc._reply(0, a=(0, 0, tid), buf=ipc.path.encode())
+        return True
+
+    def _start_thread(self, t: GuestThread) -> None:
+        if t.dead or t.state != "pending":
+            return
+        msg = t._recv(max_wall_s=30.0)
+        if msg is None:  # process died before the thread came up
+            t.process.mark_exited()
+            return
+        if msg is False:
+            raise SimPanic(
+                f"thread {t.tid} of {t.process.host.name}/{t.process.vpid} never "
+                f"announced itself (native start failure?)"
+            )
+        if msg.kind != I.MSG_THREAD_START:
+            raise SimPanic(f"thread {t.tid}: expected THREAD_START, got {msg.kind}")
+        t.now = max(t.now, self.now)
+        t.state = "running"
+        self.event_log.append((self.now, f"thread-start {t.process.host.name}/{t.tid}"))
+        t.ipc.set_time(SIM_START_UNIX_NS + t.now, 0)
+        t.ipc.send_to_shim(I.make_msg(I.MSG_SYSCALL_DONE, ret=0))
+        self._service(t)
+
+    def _sys_thread_exit(self, proc, msg):
+        proc.retval = int(msg.a[1])
+        self.event_log.append((proc.now, f"thread-exit {proc.process.host.name}/{proc.tid}"))
+        proc._reply(0)  # release it to finish dying natively
+        proc.mark_exited()
+        return True
+
+    def _sys_thread_join(self, proc, msg):
+        tid = int(msg.a[1])
+        target = next((t for t in proc.process.threads if t.tid == tid), None)
+        if target is None or target is proc:
+            proc._reply(-EINVAL)
+            return True
+
+        def check() -> bool:
+            if target.state != "exited":
+                return False
+            proc._reply(0, a=(0, 0, target.retval))
+            return True
+
+        if check():
+            return True
+        Waiter(self, proc, [target.exit_evt], check, sig_interruptible=False)
+        return False
+
+    def _sys_thread_failed(self, proc, msg):
+        tid = int(msg.a[1])
+        target = next((t for t in proc.process.threads if t.tid == tid), None)
+        if target is not None:
+            target.mark_exited()
+            if target.ipc is not None:
+                target.ipc.close()
+                target.ipc = None
+        proc._reply(0)
+        return True
+
+    # --- pthread sync objects (kernel-side so serialized threads never
+    # contend on a real futex; reference: futex.c/futex_table.c) ----------
+
+    def _sys_mutex_lock(self, proc, msg):
+        m = proc.process.mutexes.setdefault(int(msg.a[1]), KMutex())
+        if m.owner is None:
+            m.owner = proc.tid
+            proc._reply(0)
+            return True
+
+        def claim() -> bool:
+            if m.owner is not None:
+                return False
+            m.owner = proc.tid
+            proc._reply(0)
+            return True
+
+        Waiter(self, proc, [m], claim, sig_interruptible=False)
+        return False
+
+    def _sys_mutex_trylock(self, proc, msg):
+        m = proc.process.mutexes.setdefault(int(msg.a[1]), KMutex())
+        if m.owner is None:
+            m.owner = proc.tid
+            proc._reply(0)
+        else:
+            proc._reply(-EBUSY)
+        return True
+
+    def _sys_mutex_unlock(self, proc, msg):
+        m = proc.process.mutexes.setdefault(int(msg.a[1]), KMutex())
+        if m.owner != proc.tid:
+            proc._reply(-EPERM)
+            return True
+        m.owner = None
+        m.notify()  # wake blocked lockers first: the woken thread runs via a
+        proc._reply(0)  # nested service while the unlocker stays un-replied
+        return True
+
+    def _sys_cond_wait(self, proc, msg):
+        process = proc.process
+        c = process.conds.setdefault(int(msg.a[1]), KCond())
+        m = process.mutexes.setdefault(int(msg.a[2]), KMutex())
+        timeout_ns = int(msg.a[3])
+        if m.owner != proc.tid:
+            proc._reply(-EPERM)
+            return True
+        m.owner = None
+        st = {"woke": None, "timed_out": False, "gen": c.generation}
+
+        def check() -> bool:
+            if st["woke"] is None:
+                if c.generation != st["gen"]:
+                    st["woke"] = "signal"
+                elif c.signals > 0:
+                    c.signals -= 1
+                    st["woke"] = "signal"
+                elif st["timed_out"]:
+                    st["woke"] = "timeout"
+                else:
+                    return False
+            # woken (or timed out): must re-acquire the mutex to return
+            if m.owner is not None:
+                return False
+            m.owner = proc.tid
+            proc._reply(-ETIMEDOUT if st["woke"] == "timeout" else 0)
+            return True
+
+        if timeout_ns >= 0:
+            def fire_timeout():
+                if st["woke"] is None:
+                    st["timed_out"] = True
+                    c.notify()
+
+            self._push(proc.now + timeout_ns, fire_timeout)
+        m.notify()  # other lockers may take the mutex while we wait
+        Waiter(self, proc, [c, m], check, sig_interruptible=False)
+        return False
+
+    def _sys_cond_signal(self, proc, msg):
+        c = proc.process.conds.setdefault(int(msg.a[1]), KCond())
+        if int(msg.a[2]):  # broadcast
+            c.generation += 1
+        else:
+            c.signals += 1
+        c.notify()  # woken waiters run nested before the signaler resumes
+        proc._reply(0)
+        return True
 
     def _shutdown_proc(self, proc: ManagedProcess) -> None:
         """Config shutdown_time: deliver SIGTERM at sim time (reference
@@ -707,28 +1022,36 @@ class NetKernel:
         proc.ipc.set_time(SIM_START_UNIX_NS + self.now, 0)
         # a[0]=vpid, a[1]=host ip (the shim needs it for getifaddrs)
         proc.ipc.send_to_shim(I.make_msg(I.MSG_START_RES, a=(proc.vpid, proc.host.ip)))
-        self._service(proc)
+        self._service(proc.main)
 
-    def _service(self, proc: ManagedProcess) -> None:
-        """Run the process until it blocks or exits, emulating each syscall
-        (the ManagedThread::resume loop, managed_thread.rs:156-267)."""
+    def _service(self, thread: GuestThread) -> None:
+        """Run one thread until it blocks or exits, emulating each syscall
+        (the ManagedThread::resume loop, managed_thread.rs:156-267).
+        Exactly one thread of the whole simulation executes guest code at
+        a time: every other thread is parked on its own channel, and wakes
+        happen through nested _service calls while the waker stays
+        un-replied."""
+        proc = thread.process
         while True:
-            if proc.state == "exited":  # e.g. fatal self-kill mid-service
+            if thread.dead:  # e.g. fatal self-kill mid-service
                 return
-            msg = proc._recv()
+            msg = thread._recv()
             if msg is None:
-                proc.state = "exited"
-                self.event_log.append((proc.now, f"exit-native {proc.host.name}/{proc.vpid}"))
+                proc.mark_exited()
+                self.event_log.append(
+                    (thread.now, f"exit-native {proc.host.name}/{proc.vpid}")
+                )
                 return
             if msg.kind == I.MSG_PROC_EXIT:
-                proc._reply(0)
-                proc.state = "exited"
-                self.event_log.append((proc.now, f"exit {proc.host.name}/{proc.vpid}"))
+                thread._reply(0)
+                proc.mark_exited()
+                self.event_log.append((thread.now, f"exit {proc.host.name}/{proc.vpid}"))
                 return
             if msg.kind != I.MSG_SYSCALL:
                 raise SimPanic(f"unexpected msg kind {msg.kind}")
-            if not self._syscall(proc, msg):
-                proc.state = "blocked"
+            if not self._syscall(thread, msg):
+                if not thread.dead:
+                    thread.state = "blocked"
                 return  # reply deferred to a later event
 
     # --- syscall dispatch (syscall_handler.c:229-463 analogue) ------------
@@ -1908,5 +2231,14 @@ _DISPATCH = {
     I.VSYS_RESOLVE_REV: NetKernel._sys_resolve_rev,
     I.VSYS_DUP2: NetKernel._sys_dup2,
     I.VSYS_FSTAT: NetKernel._sys_fstat,
+    I.VSYS_THREAD_CREATE: NetKernel._sys_thread_create,
+    I.VSYS_THREAD_EXIT: NetKernel._sys_thread_exit,
+    I.VSYS_THREAD_JOIN: NetKernel._sys_thread_join,
+    I.VSYS_THREAD_FAILED: NetKernel._sys_thread_failed,
+    I.VSYS_MUTEX_LOCK: NetKernel._sys_mutex_lock,
+    I.VSYS_MUTEX_TRYLOCK: NetKernel._sys_mutex_trylock,
+    I.VSYS_MUTEX_UNLOCK: NetKernel._sys_mutex_unlock,
+    I.VSYS_COND_WAIT: NetKernel._sys_cond_wait,
+    I.VSYS_COND_SIGNAL: NetKernel._sys_cond_signal,
     I.VSYS_PAUSE: NetKernel._sys_pause,
 }

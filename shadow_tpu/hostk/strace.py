@@ -34,7 +34,9 @@ class StraceFile:
             pathlib.Path(path).parent.mkdir(parents=True, exist_ok=True)
             self._f = open(path, "w")
 
-    def log(self, now_ns: int, name: str, args: str, ret: "int | str") -> None:
+    def log(
+        self, now_ns: int, name: str, args: str, ret: "int | str", tid: "Optional[int]" = None
+    ) -> None:
         if self._f is None:
             return
         prefix = "" if self.mode == "deterministic" else f"{fmt_emulated(now_ns)} "
@@ -42,7 +44,7 @@ class StraceFile:
             rs = f"{ret} ({_errno_name(-ret)})"
         else:
             rs = str(ret)
-        self._f.write(f"{prefix}[tid {self.vpid}] {name}({args}) = {rs}\n")
+        self._f.write(f"{prefix}[tid {tid if tid is not None else self.vpid}] {name}({args}) = {rs}\n")
 
     def close(self) -> None:
         if self._f is not None:

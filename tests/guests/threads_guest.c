@@ -1,0 +1,137 @@
+/* Guest test program: pthreads under the shim — create/join with return
+ * values, mutex-protected shared counter, condvar producer/consumer,
+ * cond_timedwait timeout on simulated time. */
+#define _GNU_SOURCE
+#include <errno.h>
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+
+#define CHECK(cond, name)                                                      \
+    do {                                                                       \
+        if (!(cond)) {                                                         \
+            printf("FAIL %s (errno=%d)\n", name, errno);                       \
+            return 1;                                                          \
+        }                                                                      \
+        printf("ok %s\n", name);                                               \
+    } while (0)
+
+static long long now_ns(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    return (long long)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+/* --- shared counter under a mutex ------------------------------------- */
+
+static pthread_mutex_t g_mu = PTHREAD_MUTEX_INITIALIZER;
+static long g_counter = 0;
+
+static void *bump(void *arg) {
+    long n = (long)(intptr_t)arg;
+    for (long i = 0; i < n; i++) {
+        pthread_mutex_lock(&g_mu);
+        g_counter++;
+        pthread_mutex_unlock(&g_mu);
+    }
+    return (void *)(intptr_t)(n * 10);
+}
+
+/* --- producer/consumer over a condvar --------------------------------- */
+
+static pthread_mutex_t q_mu = PTHREAD_MUTEX_INITIALIZER;
+static pthread_cond_t q_cv = PTHREAD_COND_INITIALIZER;
+static int q_items = 0, q_consumed = 0, q_done = 0;
+
+static void *producer(void *arg) {
+    (void)arg;
+    for (int i = 0; i < 5; i++) {
+        struct timespec d = {0, 10000000}; /* 10ms cadence */
+        nanosleep(&d, NULL);
+        pthread_mutex_lock(&q_mu);
+        q_items++;
+        pthread_cond_signal(&q_cv);
+        pthread_mutex_unlock(&q_mu);
+    }
+    pthread_mutex_lock(&q_mu);
+    q_done = 1;
+    pthread_cond_broadcast(&q_cv);
+    pthread_mutex_unlock(&q_mu);
+    return NULL;
+}
+
+static void *consumer(void *arg) {
+    (void)arg;
+    pthread_mutex_lock(&q_mu);
+    for (;;) {
+        while (q_items == 0 && !q_done)
+            pthread_cond_wait(&q_cv, &q_mu);
+        if (q_items > 0) {
+            q_items--;
+            q_consumed++;
+        } else if (q_done) {
+            break;
+        }
+    }
+    pthread_mutex_unlock(&q_mu);
+    return NULL;
+}
+
+static void *exiter(void *arg) {
+    (void)arg;
+    pthread_exit((void *)(intptr_t)777); /* exit without returning */
+}
+
+int main(void) {
+    /* pthread_exit path */
+    pthread_t e;
+    CHECK(pthread_create(&e, NULL, exiter, NULL) == 0, "create-exiter");
+    void *re = NULL;
+    CHECK(pthread_join(e, &re) == 0 && (intptr_t)re == 777, "pthread-exit-retval");
+
+    /* create/join with retvals; mutex protects the counter */
+    pthread_t a, b;
+    CHECK(pthread_create(&a, NULL, bump, (void *)(intptr_t)1000) == 0,
+          "create-a");
+    CHECK(pthread_create(&b, NULL, bump, (void *)(intptr_t)500) == 0,
+          "create-b");
+    void *ra = NULL, *rb = NULL;
+    CHECK(pthread_join(a, &ra) == 0, "join-a");
+    CHECK(pthread_join(b, &rb) == 0, "join-b");
+    CHECK((intptr_t)ra == 10000 && (intptr_t)rb == 5000, "join-retvals");
+    CHECK(g_counter == 1500, "mutex-counter");
+
+    /* trylock semantics */
+    CHECK(pthread_mutex_trylock(&g_mu) == 0, "trylock");
+    CHECK(pthread_mutex_unlock(&g_mu) == 0, "trylock-unlock");
+
+    /* producer/consumer */
+    pthread_t p, c;
+    CHECK(pthread_create(&c, NULL, consumer, NULL) == 0, "create-consumer");
+    CHECK(pthread_create(&p, NULL, producer, NULL) == 0, "create-producer");
+    CHECK(pthread_join(p, NULL) == 0, "join-producer");
+    CHECK(pthread_join(c, NULL) == 0, "join-consumer");
+    CHECK(q_consumed == 5, "condvar-consumed");
+
+    /* cond_timedwait times out on simulated time */
+    pthread_mutex_lock(&q_mu);
+    long long t0 = now_ns();
+    struct timespec abst;
+    clock_gettime(CLOCK_REALTIME, &abst);
+    abst.tv_nsec += 200000000; /* +200ms */
+    if (abst.tv_nsec >= 1000000000) {
+        abst.tv_sec++;
+        abst.tv_nsec -= 1000000000;
+    }
+    int rc = pthread_cond_timedwait(&q_cv, &q_mu, &abst);
+    long long waited = now_ns() - t0;
+    pthread_mutex_unlock(&q_mu);
+    CHECK(rc == ETIMEDOUT, "timedwait-etimedout");
+    CHECK(waited >= 190000000LL && waited <= 400000000LL, "timedwait-timing");
+
+    printf("threads all ok counter=%ld consumed=%d\n", g_counter, q_consumed);
+    return 0;
+}
