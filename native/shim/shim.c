@@ -65,6 +65,7 @@ static __thread ShimShmem *t_shm = NULL; /* NULL = use the process block */
 static __thread int64_t t_tid = 0;       /* 0 = main thread (tid == vpid) */
 static __thread int64_t g_unapplied = 0;
 static __thread int g_in_shim = 0; /* recursion guard (reference shim.c:427-439) */
+static int g_main_exited = 0; /* main pthread_exit'ed; kernel-side it is gone */
 
 static inline ShimShmem *cur_shm(void) { return t_shm ? t_shm : g_shm; }
 
@@ -189,6 +190,9 @@ __attribute__((destructor)) static void shim_detach(void) {
     if (!g_active)
         return;
     g_active = 0;
+    if (g_main_exited)
+        return; /* the kernel already saw main's THREAD_EXIT; no one will
+                 * reply to a PROC_EXIT handshake */
     ShimMsg m;
     memset(&m, 0, offsetof(ShimMsg, buf));
     m.kind = SHIM_MSG_PROC_EXIT;
@@ -397,10 +401,22 @@ void pthread_exit(void *retval) {
     static void (*real)(void *) __attribute__((noreturn));
     if (!real)
         real = (void (*)(void *))dlsym(RTLD_NEXT, "pthread_exit");
-    if (g_active && t_tid != 0) /* worker thread: tell the simulator first */
+    /* tell the simulator first — including main (POSIX lets main
+     * pthread_exit while workers run on; the kernel ends the process
+     * when its last thread exits) */
+    if (g_active) {
+        if (t_tid == 0)
+            g_main_exited = 1; /* destructor must not expect a reply */
         vsys(VSYS_THREAD_EXIT, (int64_t)(intptr_t)retval, 0, 0, NULL, 0, NULL);
+    }
     real(retval);
     __builtin_unreachable();
+}
+
+/* glibc pthread_mutex_t layout (x86-64): __lock,__count,__owner,__nusers,
+ * __kind — the kind int sits at index 4; PTHREAD_MUTEX_RECURSIVE_NP == 1 */
+static int64_t mutex_kind(const pthread_mutex_t *m) {
+    return (int64_t)(((const int *)m)[4] & 3);
 }
 
 int pthread_create(pthread_t *t, const pthread_attr_t *attr,
@@ -483,7 +499,8 @@ int pthread_mutex_lock(pthread_mutex_t *m) {
     REAL(pthread_mutex_lock, int, pthread_mutex_t *)
     if (!g_active)
         return real_pthread_mutex_lock(m);
-    int64_t r = vsys(VSYS_MUTEX_LOCK, (int64_t)(intptr_t)m, 0, 0, NULL, 0, NULL);
+    int64_t r = vsys(VSYS_MUTEX_LOCK, (int64_t)(intptr_t)m, mutex_kind(m), 0,
+                     NULL, 0, NULL);
     return r < 0 ? (int)-r : 0;
 }
 
@@ -491,8 +508,8 @@ int pthread_mutex_trylock(pthread_mutex_t *m) {
     REAL(pthread_mutex_trylock, int, pthread_mutex_t *)
     if (!g_active)
         return real_pthread_mutex_trylock(m);
-    int64_t r = vsys(VSYS_MUTEX_TRYLOCK, (int64_t)(intptr_t)m, 0, 0, NULL, 0,
-                     NULL);
+    int64_t r = vsys(VSYS_MUTEX_TRYLOCK, (int64_t)(intptr_t)m, mutex_kind(m),
+                     0, NULL, 0, NULL);
     return r < 0 ? (int)-r : 0;
 }
 

@@ -251,9 +251,18 @@ def handle_one_iteration(
         overflow = overflow + (kept[:, p] & ~has_room).astype(jnp.int32)
     ob = ob.replace(valid=obv, dst=obd, time=obt, tie=obtie, data=obdata, aux=obaux, fill=fill, overflow=overflow)
 
+    min_used = st.min_used_lat
+    if cfg.use_dynamic_runahead:
+        # self-destined packets never cross hosts, so their (often tiny)
+        # self-edge latency must not collapse the window
+        cross = dst_clamped != host_ids[:, None]
+        used = jnp.where(kept & cross & (lat < TIME_MAX), lat, TIME_MAX)
+        min_used = jnp.minimum(min_used, jnp.min(used))
+
     stride = jnp.uint32(model.DRAWS_PER_EVENT + ep)
     return st.replace(
         queue=queue,
+        min_used_lat=min_used,
         outbox=ob,
         net=net,
         model=mstate,
@@ -338,12 +347,22 @@ def run_round(
     return st.replace(now=jnp.maximum(st.now, window_end))
 
 
-def _next_window_end(st: SimState, end_time, runahead_ns, axis_name):
+def _next_window_end(st: SimState, end_time, cfg: EngineConfig, axis_name):
     start = jnp.min(equeue.next_time(st.queue))
     if axis_name is not None:
         start = jax.lax.pmin(start, axis_name)
     start = jnp.minimum(start, end_time)
-    return jnp.minimum(start + runahead_ns, end_time)
+    runahead = jnp.asarray(cfg.runahead_ns, jnp.int64)
+    if cfg.use_dynamic_runahead:
+        # window length = min latency actually used (>= graph min); until a
+        # packet has flown, stay at the conservative graph minimum
+        used = st.min_used_lat
+        if axis_name is not None:
+            used = jax.lax.pmin(used, axis_name)
+        runahead = jnp.maximum(
+            runahead, jnp.where(used == TIME_MAX, runahead, used)
+        )
+    return jnp.minimum(start + runahead, end_time)
 
 
 def run_rounds_scan(
@@ -359,7 +378,7 @@ def run_rounds_scan(
     the simulation, or past the last pending event, are no-ops)."""
 
     def one(s, _):
-        window_end = _next_window_end(s, end_time, cfg.runahead_ns, axis_name)
+        window_end = _next_window_end(s, end_time, cfg, axis_name)
         return run_round(s, window_end, model, tables, cfg, axis_name), None
 
     st, _ = jax.lax.scan(one, st, None, length=num_rounds)

@@ -40,6 +40,11 @@ class EngineConfig:
     # Relays are exempt during the bootstrap period (relay/mod.rs:200-230;
     # config bootstrap_end_time).
     bootstrap_end_ns: int = 0
+    # Dynamic runahead (reference runahead.rs:43-56 + use_dynamic_runahead):
+    # the window grows to the minimum latency actually used, which is >= the
+    # graph minimum; correctness is preserved by the deliver-time clamp to
+    # round end (worker.rs:399-402), identical to the reference's semantics.
+    use_dynamic_runahead: bool = False
     # draws consumed per handled event = model.DRAWS_PER_EVENT + PACKET_EMITS
     # (one loss draw per packet lane), fixed-stride for determinism.
 
@@ -86,6 +91,7 @@ def _empty_outbox(h: int, o: int) -> Outbox:
 @flax.struct.dataclass
 class SimState:
     now: jax.Array  # scalar i64: start of the current window
+    min_used_lat: jax.Array  # scalar i64: min path latency used so far
     queue: EventQueue
     outbox: Outbox
     seq: jax.Array  # [H] u32 per-host event-id counter (tie-key source)
@@ -157,6 +163,7 @@ def init_state(
     h = cfg.num_hosts
     return SimState(
         now=jnp.asarray(0, jnp.int64),
+        min_used_lat=jnp.asarray(TIME_MAX, jnp.int64),
         queue=equeue.create(h, cfg.queue_capacity),
         outbox=_empty_outbox(h, cfg.outbox_capacity),
         seq=jnp.zeros((h,), jnp.uint32),

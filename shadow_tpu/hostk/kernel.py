@@ -410,6 +410,7 @@ class KMutex(File):
     def __init__(self):
         super().__init__()
         self.owner: Optional[int] = None  # tid
+        self.count = 0  # recursion depth (recursive mutexes)
 
 
 class KCond(File):
@@ -786,6 +787,8 @@ class NetKernel:
         self.event_log.append((proc.now, f"thread-exit {proc.process.host.name}/{proc.tid}"))
         proc._reply(0)  # release it to finish dying natively
         proc.mark_exited()
+        if all(t.state == "exited" for t in proc.process.threads):
+            proc.process.mark_exited()  # pthread_exit from main + workers done
         return True
 
     def _sys_thread_join(self, proc, msg):
@@ -822,8 +825,14 @@ class NetKernel:
 
     def _sys_mutex_lock(self, proc, msg):
         m = proc.process.mutexes.setdefault(int(msg.a[1]), KMutex())
+        recursive = int(msg.a[2]) == 1  # PTHREAD_MUTEX_RECURSIVE_NP
+        if m.owner == proc.tid and recursive:
+            m.count += 1
+            proc._reply(0)
+            return True
         if m.owner is None:
             m.owner = proc.tid
+            m.count = 1
             proc._reply(0)
             return True
 
@@ -831,6 +840,7 @@ class NetKernel:
             if m.owner is not None:
                 return False
             m.owner = proc.tid
+            m.count = 1
             proc._reply(0)
             return True
 
@@ -839,8 +849,13 @@ class NetKernel:
 
     def _sys_mutex_trylock(self, proc, msg):
         m = proc.process.mutexes.setdefault(int(msg.a[1]), KMutex())
-        if m.owner is None:
+        recursive = int(msg.a[2]) == 1
+        if m.owner == proc.tid and recursive:
+            m.count += 1
+            proc._reply(0)
+        elif m.owner is None:
             m.owner = proc.tid
+            m.count = 1
             proc._reply(0)
         else:
             proc._reply(-EBUSY)
@@ -850,6 +865,10 @@ class NetKernel:
         m = proc.process.mutexes.setdefault(int(msg.a[1]), KMutex())
         if m.owner != proc.tid:
             proc._reply(-EPERM)
+            return True
+        m.count -= 1
+        if m.count > 0:  # recursive: still held
+            proc._reply(0)
             return True
         m.owner = None
         m.notify()  # wake blocked lockers first: the woken thread runs via a
@@ -893,6 +912,8 @@ class NetKernel:
 
             self._push(proc.now + timeout_ns, fire_timeout)
         m.notify()  # other lockers may take the mutex while we wait
+        if check():  # a thread that ran during notify may have signaled us
+            return True
         Waiter(self, proc, [c, m], check, sig_interruptible=False)
         return False
 

@@ -215,6 +215,7 @@ class Manager:
             max_iters_per_round=cfgo.experimental.max_iters_per_round,
             use_netstack=use_netstack,
             bootstrap_end_ns=cfgo.general.bootstrap_end_time_ns,
+            use_dynamic_runahead=cfgo.experimental.use_dynamic_runahead,
         )
 
         sched = make_scheduler(

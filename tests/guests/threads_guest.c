@@ -85,7 +85,25 @@ static void *exiter(void *arg) {
     pthread_exit((void *)(intptr_t)777); /* exit without returning */
 }
 
-int main(void) {
+static void *late_worker(void *arg) {
+    (void)arg;
+    struct timespec d = {0, 50000000};
+    nanosleep(&d, NULL);
+    printf("worker outlived main\n");
+    fflush(stdout);
+    return NULL;
+}
+
+int main(int argc, char **argv) {
+    if (argc > 1 && strcmp(argv[1], "mainexit") == 0) {
+        /* main pthread_exits while a worker still runs (POSIX) */
+        pthread_t w;
+        if (pthread_create(&w, NULL, late_worker, NULL) != 0)
+            return 1;
+        printf("main exiting early\n");
+        fflush(stdout);
+        pthread_exit(NULL);
+    }
     /* pthread_exit path */
     pthread_t e;
     CHECK(pthread_create(&e, NULL, exiter, NULL) == 0, "create-exiter");
@@ -107,6 +125,13 @@ int main(void) {
     /* trylock semantics */
     CHECK(pthread_mutex_trylock(&g_mu) == 0, "trylock");
     CHECK(pthread_mutex_unlock(&g_mu) == 0, "trylock-unlock");
+
+    /* recursive mutex: same thread may relock */
+    static pthread_mutex_t rec = PTHREAD_RECURSIVE_MUTEX_INITIALIZER_NP;
+    CHECK(pthread_mutex_lock(&rec) == 0, "recursive-lock1");
+    CHECK(pthread_mutex_lock(&rec) == 0, "recursive-lock2");
+    CHECK(pthread_mutex_unlock(&rec) == 0, "recursive-unlock1");
+    CHECK(pthread_mutex_unlock(&rec) == 0, "recursive-unlock2");
 
     /* producer/consumer */
     pthread_t p, c;

@@ -56,6 +56,25 @@ def test_threads_guest_under_shim(tmp_path, threads_bin):
     assert k.syscall_counts["futex_lock"] > 0
 
 
+def test_main_pthread_exit_workers_continue(tmp_path, threads_bin):
+    """main() may pthread_exit while workers keep running; the process
+    ends when the last thread does."""
+    graph = NetworkGraph.from_gml(
+        'graph [\n  node [ id 0 ]\n  edge [ source 0 target 0 latency "1 ms" ]\n]'
+    )
+    tables = compute_routing(graph).with_hosts([0])
+    k = NetKernel(tables, host_names=["box"], host_nodes=[0], data_dir=tmp_path / "m")
+    p = k.add_process(ProcessSpec(host="box", args=[threads_bin, "mainexit"]))
+    try:
+        k.run(5 * NS_PER_SEC)
+    finally:
+        k.shutdown()
+    out = p.stdout().decode()
+    assert "main exiting early" in out
+    assert "worker outlived main" in out
+    assert p.state == "exited"
+
+
 def test_threads_deterministic(tmp_path, threads_bin):
     """Two runs produce identical stdout and syscall sequences even with
     4 guest threads — the serialization discipline is deterministic."""
