@@ -1,0 +1,66 @@
+/* Minimal HTTP/1.0 client (the reference's http example drives nginx
+ * with curl; this guest resolves the server by hostname through the
+ * simulated DNS, fetches repeatedly, and validates the response).
+ * Usage: http_client <server_host> <port> <n> <gap_ms> */
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+static long long now_ns(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    return (long long)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+int main(int argc, char **argv) {
+    if (argc < 5)
+        return 2;
+    const char *host = argv[1];
+    int n = atoi(argv[3]), gap_ms = atoi(argv[4]);
+
+    struct addrinfo hints, *res;
+    memset(&hints, 0, sizeof(hints));
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    if (getaddrinfo(host, argv[2], &hints, &res) != 0)
+        return 3;
+
+    char buf[8192];
+    for (int i = 0; i < n; i++) {
+        long long t0 = now_ns();
+        int fd = socket(AF_INET, SOCK_STREAM, 0);
+        if (fd < 0)
+            return 4;
+        if (connect(fd, res->ai_addr, res->ai_addrlen) != 0)
+            return 5;
+        int qlen = snprintf(buf, sizeof(buf),
+                            "GET / HTTP/1.0\r\nHost: %s\r\n\r\n", host);
+        if (send(fd, buf, (size_t)qlen, 0) != qlen)
+            return 6;
+        size_t total = 0;
+        ssize_t r;
+        while ((r = recv(fd, buf + total, sizeof(buf) - 1 - total, 0)) > 0)
+            total += (size_t)r;
+        buf[total] = 0;
+        close(fd);
+        if (strncmp(buf, "HTTP/1.0 200 OK", 15) != 0)
+            return 7;
+        if (strstr(buf, "quick brown fox") == NULL)
+            return 8;
+        printf("fetch %d: %zu bytes in %lld us\n", i + 1, total,
+               (now_ns() - t0) / 1000);
+        if (gap_ms > 0) {
+            struct timespec d = {gap_ms / 1000, (long)(gap_ms % 1000) * 1000000L};
+            nanosleep(&d, NULL);
+        }
+    }
+    freeaddrinfo(res);
+    printf("client done\n");
+    return 0;
+}

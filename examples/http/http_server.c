@@ -1,0 +1,67 @@
+/* Minimal HTTP/1.0 server for the http example (the reference's
+ * examples/http-server runs nginx; this guest serves the same purpose as
+ * a real, unmodified binary speaking HTTP over the simulated TCP stack).
+ * Usage: http_server <port> <nrequests>
+ * Serves `nrequests` GETs with a fixed body, then exits. */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+static const char *BODY =
+    "<html><body><h1>shadow-tpu http example</h1>"
+    "The quick brown fox jumps over the lazy dog.</body></html>\n";
+
+int main(int argc, char **argv) {
+    if (argc < 3)
+        return 2;
+    int port = atoi(argv[1]), want = atoi(argv[2]);
+    int srv = socket(AF_INET, SOCK_STREAM, 0);
+    if (srv < 0)
+        return 3;
+    int one = 1;
+    setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    struct sockaddr_in a;
+    memset(&a, 0, sizeof(a));
+    a.sin_family = AF_INET;
+    a.sin_addr.s_addr = htonl(INADDR_ANY);
+    a.sin_port = htons((unsigned short)port);
+    if (bind(srv, (struct sockaddr *)&a, sizeof(a)) != 0 || listen(srv, 16) != 0)
+        return 4;
+    char req[4096], resp[4096];
+    for (int served = 0; served < want; served++) {
+        int c = accept(srv, NULL, NULL);
+        if (c < 0)
+            return 5;
+        ssize_t r = recv(c, req, sizeof(req) - 1, 0);
+        if (r <= 0) {
+            close(c);
+            return 6;
+        }
+        req[r] = 0;
+        if (strncmp(req, "GET ", 4) != 0) {
+            close(c);
+            return 7;
+        }
+        int blen = (int)strlen(BODY);
+        int hlen = snprintf(resp, sizeof(resp),
+                            "HTTP/1.0 200 OK\r\n"
+                            "Content-Type: text/html\r\n"
+                            "Content-Length: %d\r\n\r\n%s",
+                            blen, BODY);
+        if (send(c, resp, (size_t)hlen, 0) != hlen) {
+            close(c);
+            return 8;
+        }
+        shutdown(c, SHUT_WR);
+        recv(c, req, sizeof(req), 0); /* drain the client's close */
+        close(c);
+        printf("served %d\n", served + 1);
+    }
+    close(srv);
+    printf("server done\n");
+    return 0;
+}

@@ -521,6 +521,7 @@ class NetKernel:
         self.hosts_file = self.data_dir / "hosts"
         self.dns.write_hosts_file(self.hosts_file)
         self._keys = rng.host_keys(seed, len(self.hosts))
+        self._draw_cache: "dict[int, tuple[int, np.ndarray]]" = {}
 
         self.now = 0
         self._seq = 0
@@ -543,15 +544,25 @@ class NetKernel:
 
     # --- deterministic draws (same threefry streams as the engine) -------
 
+    _DRAW_BLOCK = 512
+
     def _loss_draw(self, src: HostKernel) -> float:
-        u = float(
-            rng.uniform_f32(
-                self._keys[src.host_id : src.host_id + 1],
-                jnp.array([src.rng_counter], jnp.uint32),
-            )[0]
-        )
+        """One uniform from the host's counter stream. Values are computed
+        in jitted blocks of 512 (identical per-counter values to the
+        device engine's uniform_f32) so the serial kernel doesn't pay a
+        JAX dispatch per packet."""
+        c = src.rng_counter
+        cached = self._draw_cache.get(src.host_id)
+        if cached is None or not (cached[0] <= c < cached[0] + self._DRAW_BLOCK):
+            vals = np.asarray(
+                rng.uniform_block(
+                    self._keys[src.host_id], jnp.uint32(c), self._DRAW_BLOCK
+                )
+            )
+            cached = (c, vals)
+            self._draw_cache[src.host_id] = cached
         src.rng_counter += 1
-        return u
+        return float(cached[1][c - cached[0]])
 
     def _random_bytes(self, host: HostKernel, n: int) -> bytes:
         out = rng.raw_bytes(self._keys[host.host_id], host.rng_counter, n)

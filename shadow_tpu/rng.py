@@ -52,6 +52,21 @@ def uniform_int(keys: jax.Array, counters: jax.Array, lo, hi) -> jax.Array:
     return jax.vmap(lambda k, a, b: random.randint(k, (), a, b, dtype=jnp.int64))(ks, lo_b, hi_b)
 
 
+import functools
+
+
+@functools.partial(jax.jit, static_argnums=2)
+def uniform_block(key: jax.Array, start: jax.Array, n: int) -> jax.Array:
+    """[n] uniforms for draws #start..start+n of ONE host key — the same
+    values per-counter as uniform_f32, computed in one compiled call (the
+    serial managed-process kernel batches its loss draws through this to
+    avoid per-packet dispatch overhead)."""
+    counters = start.astype(jnp.uint32) + jnp.arange(n, dtype=jnp.uint32)
+    return jax.vmap(
+        lambda c: random.uniform(random.fold_in(key, c), dtype=jnp.float32)
+    )(counters)
+
+
 def raw_bytes(key: jax.Array, counter: int, n: int):
     """n deterministic bytes for draw #counter of one host key (serves
     getrandom//dev/urandom in managed processes; the reference routes

@@ -1,0 +1,63 @@
+"""The http example end-to-end: real HTTP server + two clients as managed
+processes over the simulated TCP stack, resolved via simulated DNS
+(reference: examples/http-server nginx+curl on the 1_gbit_switch graph,
+mirrored by src/test/examples)."""
+
+import json
+import pathlib
+import subprocess
+
+import pytest
+
+from shadow_tpu.runtime.cli_run import run_from_config
+
+EX = pathlib.Path(__file__).parent.parent / "examples" / "http"
+
+
+@pytest.fixture(scope="module")
+def http_bins(tmp_path_factory):
+    out = tmp_path_factory.mktemp("http")
+    bins = {}
+    for name in ("http_server", "http_client"):
+        dst = out / name
+        subprocess.run(["cc", "-O2", "-o", str(dst), str(EX / f"{name}.c")], check=True)
+        bins[name] = str(dst)
+    return bins
+
+
+def test_http_example(tmp_path, http_bins):
+    cfg = tmp_path / "shadow.yaml"
+    cfg.write_text(
+        f"""
+general:
+  stop_time: 10 s
+  data_directory: {tmp_path / "data"}
+network:
+  graph:
+    type: 1_gbit_switch
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+      - path: {http_bins["http_server"]}
+        args: 80 6
+  client:
+    network_node_id: 0
+    quantity: 2
+    processes:
+      - path: {http_bins["http_client"]}
+        args: [server, "80", "3", "20"]
+        start_time: 100 ms
+"""
+    )
+    assert run_from_config(str(cfg)) == 0
+    data = tmp_path / "data"
+    srv_out = (data / "server" / "http_server.1000.stdout").read_text()
+    assert "server done" in srv_out
+    for host in ("client1", "client2"):
+        out = (data / host / f"http_client.100{1 if host == 'client1' else 2}.stdout").read_text()
+        assert out.count("fetch") == 3
+        assert "client done" in out
+    stats = json.loads((data / "sim-stats.json").read_text())
+    assert stats["syscall_counts"]["accept"] >= 6
+    assert stats["syscall_counts"]["getaddrinfo"] >= 2
