@@ -16,6 +16,8 @@ bits), so simulated timelines are bit-identical across CPU and TPU backends.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import random
@@ -50,9 +52,6 @@ def uniform_int(keys: jax.Array, counters: jax.Array, lo, hi) -> jax.Array:
     lo_b = jnp.broadcast_to(lo, ks.shape)
     hi_b = jnp.broadcast_to(hi, ks.shape)
     return jax.vmap(lambda k, a, b: random.randint(k, (), a, b, dtype=jnp.int64))(ks, lo_b, hi_b)
-
-
-import functools
 
 
 @functools.partial(jax.jit, static_argnums=2)

@@ -45,3 +45,24 @@ def test_exponential_positive_and_mean():
     d = np.asarray(rng.exponential_ns(keys, c, 1_000_000))
     assert (d >= 0).all()
     assert 0.8e6 < d.mean() < 1.2e6
+
+
+def test_uniform_block_matches_uniform_f32():
+    """The managed kernel's batched draws must stay bit-identical to the
+    device engine's per-counter uniforms (shared determinism contract)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from shadow_tpu import rng
+
+    keys = rng.host_keys(seed=5, num_hosts=3)
+    for h in range(3):
+        for start in (0, 7, 1000):
+            block = np.asarray(rng.uniform_block(keys[h], jnp.uint32(start), 16))
+            singles = np.asarray(
+                rng.uniform_f32(
+                    jnp.repeat(keys[h : h + 1], 16, axis=0),
+                    jnp.arange(start, start + 16, dtype=jnp.uint32),
+                )
+            )
+            assert (block == singles).all()
