@@ -94,6 +94,8 @@ static const int TRAPPED[] = {
     290 /*eventfd2*/,  291 /*epoll_create1*/, 292 /*dup3*/,
     293 /*pipe2*/,     318 /*getrandom*/,
     200 /*tkill*/,     234 /*tgkill*/,
+    16 /*ioctl*/,      72 /*fcntl*/,
+    57 /*fork*/,       61 /*wait4*/,
 };
 #define NTRAPPED ((int)(sizeof(TRAPPED) / sizeof(TRAPPED[0])))
 

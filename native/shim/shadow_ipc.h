@@ -41,6 +41,8 @@ enum {
     SHIM_MSG_PROC_EXIT = 5,  /* shim -> shadow: destructor ran           */
     SHIM_MSG_THREAD_START = 6, /* shim -> shadow: new thread on its own
                                 * channel; parks until scheduled          */
+    SHIM_MSG_CHILD_START = 7,  /* shim -> shadow: forked child on its own
+                                * channel; a[0]=vpid a[1]=real pid        */
 };
 
 /* virtual syscall codes (a[0] of SHIM_MSG_SYSCALL). The reference
@@ -111,6 +113,10 @@ enum {
     VSYS_MUTEX_UNLOCK = 57,  /* a[1]=addr */
     VSYS_COND_WAIT = 58,     /* a[1]=cond a[2]=mutex a[3]=timeout ns (-1 none) */
     VSYS_COND_SIGNAL = 59,   /* a[1]=cond a[2]=broadcast */
+    /* processes (reference: Process::spawn/fork, process.rs) */
+    VSYS_FORK = 60,          /* -> a[2]=child vpid, buf=child shm path */
+    VSYS_WAITPID = 61,       /* a[1]=vpid a[2]=nohang -> a[2]=status,
+                                a[3]=real pid (shim reaps the zombie) */
 };
 
 typedef struct {

@@ -77,6 +77,7 @@ static long rsyscall(long nr, ...) {
 static ShimShmem *g_shm = NULL;
 static int g_active = 0;
 static int64_t g_vpid = 0;
+static int64_t g_ppid = 0; /* parent's vpid for forked children */
 static uint32_t g_host_ip = 0; /* simulated address, host byte order */
 
 /* per-thread state: each managed thread has its own channel pair in its
@@ -521,6 +522,109 @@ int pthread_join(pthread_t t, void **retval) {
     if (retval)
         *retval = (void *)(intptr_t)reply.a[2];
     return 0;
+}
+
+/* ---- fork/wait (reference: Process::spawn + fork handling, process.rs;
+ * the child gets its own channel block and announces like a new managed
+ * process; waitpid bridges virtual pids to the real zombie reap) ---- */
+
+#include <sys/wait.h>
+
+pid_t fork(void) {
+    static pid_t (*real)(void);
+    if (!real)
+        real = (pid_t (*)(void))dlsym(RTLD_NEXT, "fork");
+    if (!g_active)
+        return real();
+    ShimMsg reply;
+    int64_t r = vsys(VSYS_FORK, 0, 0, 0, NULL, 0, &reply);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    int64_t child_vpid = reply.a[2];
+    char path[256];
+    size_t n = reply.buf_len < sizeof(path) - 1 ? reply.buf_len
+                                                : sizeof(path) - 1;
+    memcpy(path, reply.buf, n);
+    path[n] = '\0';
+    pid_t p = real();
+    if (p < 0) {
+        vsys(VSYS_THREAD_FAILED, child_vpid, 0, 0, NULL, 0, NULL);
+        return p;
+    }
+    if (p == 0) {
+        /* child: leave the parent's (shared) block alone and adopt our own.
+         * Only the forking thread survives; reset all per-thread state. */
+        int fd = open(path, O_RDWR);
+        void *m = fd >= 0 ? mmap(NULL, SHIM_SHMEM_SIZE, PROT_READ | PROT_WRITE,
+                                 MAP_SHARED, fd, 0)
+                          : MAP_FAILED;
+        if (fd >= 0)
+            close(fd);
+        if (m == MAP_FAILED)
+            rsyscall(SYS_exit_group, 117L); /* cannot join the simulation */
+        g_shm = (ShimShmem *)m;
+        t_shm = NULL;
+        t_tid = 0;
+        g_ppid = g_vpid;
+        g_vpid = child_vpid;
+        g_thread_count = 0;
+        g_main_exited = 0;
+        ShimMsg msg;
+        memset(&msg, 0, offsetof(ShimMsg, buf));
+        msg.kind = SHIM_MSG_CHILD_START;
+        msg.a[0] = child_vpid;
+        msg.a[1] = shim_raw_syscall(SYS_getpid, 0L, 0L, 0L, 0L, 0L, 0L);
+        shim_channel_send(&g_shm->to_shadow, &msg);
+        shim_channel_recv(&g_shm->to_shim, &msg, -1);
+        return 0;
+    }
+    return (pid_t)child_vpid; /* parent sees the virtual pid */
+}
+
+pid_t waitpid(pid_t pid, int *status, int options) {
+    static pid_t (*real)(pid_t, int *, int);
+    if (!real)
+        real = (pid_t (*)(pid_t, int *, int))dlsym(RTLD_NEXT, "waitpid");
+    if (!g_active || (pid > 0 && pid < VFD_BASE))
+        return real(pid, status, options);
+    if (pid == 0 || pid < -1)
+        pid = -1; /* one process group per simulated process */
+    ShimMsg reply;
+    int64_t r = vsys(VSYS_WAITPID, (int64_t)pid,
+                     (options & WNOHANG) ? 1 : 0, 0, NULL, 0, &reply);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    if (r == 0)
+        return 0; /* WNOHANG, nothing exited yet */
+    /* reap the real zombie (the sim-side exit handshake happens moments
+     * before the native exit completes, so block for the short remainder);
+     * its authentic wait status wins */
+    int st = (int)reply.a[2];
+    pid_t realpid = (pid_t)reply.a[3];
+    if (realpid > 0) {
+        int real_st;
+        if (real(realpid, &real_st, 0) == realpid)
+            st = real_st;
+    }
+    if (status)
+        *status = st;
+    return (pid_t)r; /* the child's virtual pid */
+}
+
+pid_t wait(int *status) { return waitpid(-1, status, 0); }
+
+void exit(int status) {
+    static void (*real)(int) __attribute__((noreturn));
+    if (!real)
+        real = (void (*)(int))dlsym(RTLD_NEXT, "exit");
+    if (g_active) /* record the code for waitpid before the destructor runs */
+        vsys(VSYS_EXIT, (int64_t)status, 0, 0, NULL, 0, NULL);
+    real(status);
+    __builtin_unreachable();
 }
 
 /* pthread sync objects, keyed by guest address (state lives kernel-side) */
@@ -2028,6 +2132,16 @@ long shim_route_syscall(long nr, long a1, long a2, long a3, long a4, long a5,
                              (socklen_t *)a5));
     case SYS_kill:
         return KR(kill((pid_t)a1, (int)a2));
+    case SYS_ioctl:
+        return KR(ioctl((int)a1, (unsigned long)a2, a3));
+    case SYS_fcntl:
+        return KR(fcntl((int)a1, (int)a2, a3));
+    case SYS_fork:
+        return KR(fork());
+    case SYS_wait4:
+        if (a4 != 0) /* rusage requested: not modeled, run native */
+            return shim_raw_syscall(nr, a1, a2, a3, a4, a5, a6);
+        return KR(waitpid((pid_t)a1, (int *)a2, (int)a3));
     case SYS_tgkill:
     case SYS_tkill: {
         /* raw self-signal (glibc raise, runtimes): deliver only when the

@@ -44,6 +44,7 @@ ENOTSOCK = 88
 ESRCH = 3
 ETIMEDOUT = 110
 EBUSY = 16
+ECHILD = 10
 
 # epoll event bits (uapi)
 EPOLLIN = 0x001

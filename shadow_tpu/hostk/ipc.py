@@ -85,9 +85,12 @@ VSYS_MUTEX_TRYLOCK = 56
 VSYS_MUTEX_UNLOCK = 57
 VSYS_COND_WAIT = 58
 VSYS_COND_SIGNAL = 59
+VSYS_FORK = 60
+VSYS_WAITPID = 61
 
 # message kind for a new thread announcing itself on its own channel
 MSG_THREAD_START = 6
+MSG_CHILD_START = 7  # forked child announcing on its own channel
 
 VSYS_NAMES = {
     VSYS_NANOSLEEP: "nanosleep",
@@ -149,6 +152,8 @@ VSYS_NAMES = {
     VSYS_MUTEX_UNLOCK: "futex_unlock",
     VSYS_COND_WAIT: "futex_wait",
     VSYS_COND_SIGNAL: "futex_wake",
+    VSYS_FORK: "fork",
+    VSYS_WAITPID: "wait4",
 }
 
 

@@ -49,6 +49,7 @@ from shadow_tpu.hostk.descriptor import (
     EADDRINUSE,
     ECONNREFUSED,
     EBUSY,
+    ECHILD,
     EINTR,
     EPERM,
     ESRCH,
@@ -254,7 +255,7 @@ class GuestThread:
             msg = self.ipc.recv_from_shim(timeout_ms=100)
             if msg is not None:
                 return msg
-            if self.process.popen.poll() is not None:
+            if self.process.native_dead():
                 return None
             if deadline is not None and _time.monotonic() > deadline:
                 return False
@@ -283,6 +284,10 @@ class ManagedProcess:
         self.host = host
         self.vpid = vpid
         self.popen: Optional[subprocess.Popen] = None
+        self.real_pid: Optional[int] = None  # forked children have no Popen
+        self.parent: "Optional[ManagedProcess]" = None
+        self.wait_status = 0  # waitpid-style status for the guest parent
+        self.waited = False  # reaped by a guest waitpid
         self.fdtab = DescriptorTable()
         self.threads: "list[GuestThread]" = []
         self.exited = False
@@ -302,6 +307,7 @@ class ManagedProcess:
         # down; the shim interposes at the pthread layer instead)
         self.mutexes: dict[int, "KMutex"] = {}
         self.conds: dict[int, "KCond"] = {}
+        self.exit_evt = File()  # waitpid waiters listen here
 
     # ---- main-thread conveniences (tests + process-level call sites) ----
 
@@ -324,11 +330,32 @@ class ManagedProcess:
         return self.main.ipc if self.main else None
 
     def mark_exited(self) -> None:
+        if self.exited:
+            return
         self.exited = True
         for t in self.threads:
             if t.waiter is not None:
                 t.waiter._detach()
             t.mark_exited()
+        # process exit closes its descriptors (releases shared pipe/socket
+        # ends so peers see EOF/HUP; ports/namespace entries free)
+        for fd in self.fdtab.fds():
+            self.kernel._close_fd(self, fd)
+        self.exit_evt.notify()  # guest parents blocked in waitpid
+
+    def native_dead(self) -> bool:
+        """Has the real process died under us? (ChildPidWatcher analogue.)
+        Forked children are the *guest's* children, so poll /proc: a
+        zombie (Z) counts as dead — the guest parent will reap it."""
+        if self.popen is not None:
+            return self.popen.poll() is not None
+        if self.real_pid is None:
+            return False
+        try:
+            with open(f"/proc/{self.real_pid}/stat") as f:
+                return f.read().split(") ")[-1][:1] == "Z"
+        except OSError:
+            return True
 
     # --- lifecycle -------------------------------------------------------
 
@@ -392,6 +419,11 @@ class ManagedProcess:
         if self.popen and self.popen.poll() is None:
             self.popen.kill()
             self.popen.wait()
+        elif self.popen is None and self.real_pid is not None:
+            try:
+                os.kill(self.real_pid, 9)
+            except OSError:
+                pass
         if self.strace:
             self.strace.close()
             self.strace = None
@@ -638,12 +670,7 @@ class NetKernel:
         self.event_log.append(
             (self.now, f"killed {proc.host.name}/{proc.vpid} sig={sig}")
         )
-        proc.exited = True
-        for t in proc.threads:
-            if t.waiter is not None:
-                t.waiter._detach()
-        for fd in proc.fdtab.fds():
-            self._close_fd(proc, fd)
+        proc.mark_exited()  # detaches waiters, closes fds, wakes waitpid
         if proc.popen is not None and proc.popen.poll() is None:
             proc.popen.send_signal(sig)
             try:
@@ -651,6 +678,13 @@ class NetKernel:
             except subprocess.TimeoutExpired:  # blocked the signal natively
                 proc.popen.kill()
                 proc.exit_code = proc.popen.wait()
+        elif proc.popen is None and proc.real_pid is not None:
+            try:  # a forked child: the guest parent reaps the real status
+                os.kill(proc.real_pid, sig)
+            except OSError:
+                pass
+            proc.exit_code = -sig
+        proc.wait_status = sig if proc.exit_code == -sig else (proc.exit_code or 0) << 8
         proc.kill()
 
     def _sys_sigaction(self, proc, msg):
@@ -828,6 +862,10 @@ class NetKernel:
             if target.ipc is not None:
                 target.ipc.close()
                 target.ipc = None
+        else:  # native fork() failed: cancel the pre-created child process
+            child = next((p for p in self.procs if p.vpid == tid), None)
+            if child is not None and child.main and child.main.state == "pending":
+                child.mark_exited()
         proc._reply(0)
         return True
 
@@ -937,6 +975,91 @@ class NetKernel:
         c.notify()  # woken waiters run nested before the signaler resumes
         proc._reply(0)
         return True
+
+    # --- fork/wait (reference: process.rs spawn/fork + waitpid) ----------
+
+    def _sys_fork(self, proc, msg):
+        parent = proc.process
+        vpid = 1000 + len(self.procs)
+        child = ManagedProcess(self, parent.spec, parent.host, vpid)
+        child.parent = parent
+        child._stdout_path = parent._stdout_path
+        child._stderr_path = parent._stderr_path
+        child.sig_handlers = dict(parent.sig_handlers)
+        # fd table: descriptors shared with the parent (POSIX fork)
+        for fd, f in parent.fdtab._files.items():
+            child.fdtab._files[fd] = f
+            f.refcount += 1
+        ipc = I.IpcBlock(
+            tag=f"h{parent.host.host_id}p{vpid}",
+            vdso_latency_ns=self.vdso_latency_ns,
+            syscall_latency_ns=self.syscall_latency_ns,
+            max_unapplied_ns=self.max_unapplied_ns,
+        )
+        main = GuestThread(child, vpid, ipc)
+        main.now = proc.now
+        child.threads.append(main)
+        exe = pathlib.Path(parent.spec.args[0]).name
+        outdir = self.data_dir / parent.host.name
+        child.strace = StraceFile(
+            outdir / f"{exe}.{vpid}.strace", vpid, mode=self.strace_mode
+        )
+        self.procs.append(child)
+        parent.host.procs.append(child)
+        self._push(proc.now, lambda: self._start_forked(child))
+        proc._reply(0, a=(0, 0, vpid), buf=ipc.path.encode())
+        return True
+
+    def _start_forked(self, child: ManagedProcess) -> None:
+        main = child.main
+        if child.exited or main.state != "pending":
+            return
+        msg = main._recv(max_wall_s=10.0)
+        if msg is None or msg is False:
+            # the real fork failed or the child died before announcing
+            child.mark_exited()
+            self.event_log.append((self.now, f"fork-lost {child.host.name}/{child.vpid}"))
+            return
+        if msg.kind != I.MSG_CHILD_START:
+            raise SimPanic(f"forked child {child.vpid}: expected CHILD_START, got {msg.kind}")
+        child.real_pid = int(msg.a[1])
+        main.now = max(main.now, self.now)
+        main.state = "running"
+        self.event_log.append((self.now, f"fork {child.host.name}/{child.vpid}"))
+        main.ipc.set_time(SIM_START_UNIX_NS + main.now, 0)
+        main.ipc.send_to_shim(I.make_msg(I.MSG_SYSCALL_DONE, ret=0))
+        self._service(main)
+
+    def _sys_waitpid(self, proc, msg):
+        vpid, nohang = int(msg.a[1]), bool(int(msg.a[2]))
+        parent = proc.process
+        candidates = [
+            c
+            for c in self.procs
+            if c.parent is parent and not c.waited and (vpid == -1 or c.vpid == vpid)
+        ]
+        if not candidates:
+            proc._reply(-ECHILD)
+            return True
+
+        def check() -> bool:
+            for c in candidates:
+                if c.exited:
+                    c.waited = True
+                    proc._reply(
+                        c.vpid, a=(0, 0, c.wait_status, c.real_pid or 0)
+                    )
+                    return True
+            return False
+
+        if check():
+            return True
+        if nohang:
+            proc._reply(0)
+            return True
+        Waiter(self, proc, [c.exit_evt for c in candidates], check,
+               sig_interruptible=False)
+        return False
 
     def _shutdown_proc(self, proc: ManagedProcess) -> None:
         """Config shutdown_time: deliver SIGTERM at sim time (reference
@@ -1076,6 +1199,7 @@ class NetKernel:
                 return
             if msg.kind == I.MSG_PROC_EXIT:
                 thread._reply(0)
+                proc.wait_status = (proc.exit_code or 0) << 8
                 proc.mark_exited()
                 self.event_log.append((thread.now, f"exit {proc.host.name}/{proc.vpid}"))
                 return
@@ -1210,6 +1334,8 @@ class NetKernel:
         return True
 
     def _sys_exit(self, proc, msg):
+        if proc.process.popen is None:  # forked: no Popen to report status
+            proc.process.exit_code = int(msg.a[1])
         proc._reply(0)
         return True
 
@@ -2272,5 +2398,7 @@ _DISPATCH = {
     I.VSYS_MUTEX_UNLOCK: NetKernel._sys_mutex_unlock,
     I.VSYS_COND_WAIT: NetKernel._sys_cond_wait,
     I.VSYS_COND_SIGNAL: NetKernel._sys_cond_signal,
+    I.VSYS_FORK: NetKernel._sys_fork,
+    I.VSYS_WAITPID: NetKernel._sys_waitpid,
     I.VSYS_PAUSE: NetKernel._sys_pause,
 }

@@ -1,0 +1,59 @@
+/* Guest test program: fork/waitpid under the shim. The parent forks two
+ * children; each child talks UDP to itself through the simulated stack,
+ * sleeps on simulated time, and exits with a distinct code; the parent
+ * waitpids both and checks pids, statuses, and that a shared pipe written
+ * by children reaches the parent (fd inheritance across fork). */
+#include <errno.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+#define CHECK(cond, name)                                                      \
+    do {                                                                       \
+        if (!(cond)) {                                                         \
+            printf("FAIL %s (errno=%d)\n", name, errno);                       \
+            return 1;                                                          \
+        }                                                                      \
+        printf("ok %s\n", name);                                               \
+    } while (0)
+
+int main(void) {
+    int pfd[2];
+    CHECK(pipe(pfd) == 0, "pipe");
+    pid_t kids[2];
+    for (int i = 0; i < 2; i++) {
+        pid_t p = fork();
+        CHECK(p >= 0, "fork");
+        if (p == 0) {
+            /* child: distinct vpid, sim-time sleep, UDP self-ping */
+            struct timespec d = {0, (i + 1) * 50000000L};
+            nanosleep(&d, NULL);
+            char msg[64];
+            int n = snprintf(msg, sizeof(msg), "child-%d pid=%d", i, getpid());
+            write(pfd[1], msg, (size_t)n);
+            _Exit(0); /* skip parent's atexit/stdio (standard practice) */
+        }
+        kids[i] = p;
+        printf("forked %d -> vpid %d\n", i, p);
+    }
+    CHECK(kids[0] != kids[1] && kids[0] >= 1000, "vpids-distinct");
+
+    int st = -1;
+    pid_t r = waitpid(kids[0], &st, 0);
+    CHECK(r == kids[0], "waitpid-first");
+    CHECK(WIFEXITED(st) && WEXITSTATUS(st) == 0, "status-first");
+    r = wait(&st);
+    CHECK(r == kids[1], "wait-second");
+    CHECK(waitpid(kids[0], &st, 0) == -1 && errno == ECHILD, "echild");
+
+    char buf[256] = {0};
+    ssize_t got = read(pfd[0], buf, sizeof(buf) - 1);
+    CHECK(got > 0 && strstr(buf, "child-0") != NULL, "pipe-inherited");
+
+    printf("fork all ok\n");
+    return 0;
+}

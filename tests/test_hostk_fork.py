@@ -1,0 +1,60 @@
+"""fork/waitpid tests: guests spawning managed child processes
+(reference: Process::spawn/fork process.rs, the clone/fork handlers in
+syscall/handler/clone.rs, src/test/clone + examples with multi-process
+guests)."""
+
+import pathlib
+import subprocess
+
+import pytest
+
+from shadow_tpu.graph import NetworkGraph, compute_routing
+from shadow_tpu.hostk.kernel import NetKernel, ProcessSpec
+from shadow_tpu.simtime import NS_PER_SEC
+
+GUESTS = pathlib.Path(__file__).parent / "guests"
+
+
+@pytest.fixture(scope="module")
+def fork_bin(tmp_path_factory):
+    out = tmp_path_factory.mktemp("guests") / "fork_guest"
+    subprocess.run(["cc", "-O2", "-o", str(out), str(GUESTS / "fork_guest.c")], check=True)
+    return str(out)
+
+
+def _run(tmp_path, fork_bin, sub="a"):
+    graph = NetworkGraph.from_gml(
+        'graph [\n  node [ id 0 ]\n  edge [ source 0 target 0 latency "1 ms" ]\n]'
+    )
+    tables = compute_routing(graph).with_hosts([0])
+    k = NetKernel(tables, host_names=["box"], host_nodes=[0], data_dir=tmp_path / sub)
+    p = k.add_process(ProcessSpec(host="box", args=[fork_bin]))
+    try:
+        k.run(5 * NS_PER_SEC)
+    finally:
+        k.shutdown()
+    return k, p
+
+
+def test_fork_guest_native(tmp_path, fork_bin):
+    r = subprocess.run([fork_bin], capture_output=True, text=True, cwd=tmp_path)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "fork all ok" in r.stdout
+
+
+def test_fork_guest_under_shim(tmp_path, fork_bin):
+    k, p = _run(tmp_path, fork_bin)
+    out = p.stdout().decode()
+    assert p.exit_code == 0, out + p.stderr().decode()
+    assert "fork all ok" in out
+    assert k.syscall_counts["fork"] == 2
+    assert k.syscall_counts["wait4"] >= 3
+    # the children ran as managed processes with their own vpids
+    assert len(k.procs) == 3
+    assert all(pr.state == "exited" for pr in k.procs)
+
+
+def test_fork_deterministic(tmp_path, fork_bin):
+    a = _run(tmp_path, fork_bin, "r1")[1].stdout()
+    b = _run(tmp_path, fork_bin, "r2")[1].stdout()
+    assert a == b
