@@ -528,6 +528,7 @@ int pthread_join(pthread_t t, void **retval) {
  * the child gets its own channel block and announces like a new managed
  * process; waitpid bridges virtual pids to the real zombie reap) ---- */
 
+#include <sys/resource.h>
 #include <sys/wait.h>
 
 pid_t fork(void) {
@@ -2139,8 +2140,11 @@ long shim_route_syscall(long nr, long a1, long a2, long a3, long a4, long a5,
     case SYS_fork:
         return KR(fork());
     case SYS_wait4:
-        if (a4 != 0) /* rusage requested: not modeled, run native */
-            return shim_raw_syscall(nr, a1, a2, a3, a4, a5, a6);
+        /* rusage is not modeled: zero-fill it, never bypass the sim (a
+         * native wait4 would reap a forked child's real zombie and return
+         * a nondeterministic real pid) */
+        if (a4 != 0)
+            memset((void *)a4, 0, sizeof(struct rusage));
         return KR(waitpid((pid_t)a1, (int *)a2, (int)a3));
     case SYS_tgkill:
     case SYS_tkill: {

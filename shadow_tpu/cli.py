@@ -24,6 +24,11 @@ def main(argv: "list[str] | None" = None) -> int:
     run_p = sub.add_parser("run", help="run a simulation from a YAML config")
     run_p.add_argument("config", help="path to shadow.yaml-style config")
     run_p.add_argument("--show-config", action="store_true", help="print resolved config and exit")
+    sub.add_parser(
+        "shm-cleanup",
+        help="remove stale shared-memory blocks left by crashed runs "
+        "(the reference's --shm-cleanup, main.rs:333)",
+    )
     args = parser.parse_args(argv)
 
     if args.command == "run":
@@ -34,7 +39,29 @@ def main(argv: "list[str] | None" = None) -> int:
         except CliUserError as e:
             print(f"shadow-tpu: error: {e}", file=sys.stderr)
             return 1
+    if args.command == "shm-cleanup":
+        return shm_cleanup()
     parser.print_help()
+    return 0
+
+
+def shm_cleanup(shm_dir: str = "/dev/shm") -> int:
+    """Remove shadow-tpu shm blocks whose owning simulator is gone
+    (reference: shm_cleanup.rs). Blocks are named shadow-tpu-<tag>-*."""
+    import pathlib
+    import time
+
+    removed = 0
+    now = time.time()
+    for p in pathlib.Path(shm_dir).glob("shadow-tpu-*"):
+        try:
+            if now - p.stat().st_mtime < 600:
+                continue  # possibly owned by a live simulation
+            p.unlink()
+            removed += 1
+        except OSError:
+            pass
+    print(f"shm-cleanup: removed {removed} stale block(s) from {shm_dir}")
     return 0
 
 

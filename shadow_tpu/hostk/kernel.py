@@ -419,7 +419,12 @@ class ManagedProcess:
         if self.popen and self.popen.poll() is None:
             self.popen.kill()
             self.popen.wait()
-        elif self.popen is None and self.real_pid is not None:
+        elif (
+            self.popen is None
+            and self.real_pid is not None
+            and not self.waited
+            and self.native_dead() is False
+        ):
             try:
                 os.kill(self.real_pid, 9)
             except OSError:
@@ -670,7 +675,9 @@ class NetKernel:
         self.event_log.append(
             (self.now, f"killed {proc.host.name}/{proc.vpid} sig={sig}")
         )
-        proc.mark_exited()  # detaches waiters, closes fds, wakes waitpid
+        # terminate natively and settle the wait status BEFORE mark_exited:
+        # it wakes waitpid waiters, whose shim-side real reap must find the
+        # child already dying
         if proc.popen is not None and proc.popen.poll() is None:
             proc.popen.send_signal(sig)
             try:
@@ -685,6 +692,7 @@ class NetKernel:
                 pass
             proc.exit_code = -sig
         proc.wait_status = sig if proc.exit_code == -sig else (proc.exit_code or 0) << 8
+        proc.mark_exited()  # detaches waiters, closes fds, wakes waitpid
         proc.kill()
 
     def _sys_sigaction(self, proc, msg):
@@ -865,6 +873,7 @@ class NetKernel:
         else:  # native fork() failed: cancel the pre-created child process
             child = next((p for p in self.procs if p.vpid == tid), None)
             if child is not None and child.main and child.main.state == "pending":
+                child.waited = True  # the guest never saw this vpid
                 child.mark_exited()
         proc._reply(0)
         return True
@@ -1017,6 +1026,7 @@ class NetKernel:
         msg = main._recv(max_wall_s=10.0)
         if msg is None or msg is False:
             # the real fork failed or the child died before announcing
+            child.waited = True  # not reapable: the guest never saw it run
             child.mark_exited()
             self.event_log.append((self.now, f"fork-lost {child.host.name}/{child.vpid}"))
             return
@@ -1155,6 +1165,8 @@ class NetKernel:
     def unexpected_final_states(self) -> "list[str]":
         out = []
         for p in self.procs:
+            if p.parent is not None:
+                continue  # forked children answer to their guest parent
             if p.shutdown_requested and p.state == "exited":
                 continue  # a requested shutdown is an expected exit
             want = p.spec.expected_final_state

@@ -1,0 +1,63 @@
+"""Log-analysis tools (reference: src/tools/parse-shadow.py and
+plot-shadow.py, whose stable heartbeat format tornettools parses)."""
+
+import json
+import subprocess
+import sys
+import pathlib
+
+TOOLS = pathlib.Path(__file__).parent.parent / "tools"
+
+SAMPLE_LOG = """\
+00:00:01.017 [info] [2000-01-01 00:00:01.000000000] [manager] heartbeat: 25 syscalls, 8 packets
+00:00:01.017 [info] [2000-01-01 00:00:01.000000000] [server] tracker: bytes_sent=24 bytes_recv=24 packets_sent=4 packets_dropped=0
+00:00:01.018 [info] [2000-01-01 00:00:02.000000000] [manager] heartbeat: 30 syscalls, 10 packets
+00:00:01.018 [info] [2000-01-01 00:00:02.000000000] [server] tracker: bytes_sent=48 bytes_recv=48 packets_sent=8 packets_dropped=1
+00:00:01.018 [info] [2000-01-01 00:00:02.000000000] [manager] finished: 30 syscalls, 10 packets in 0.29s wall
+"""
+
+
+def test_parse_and_plot(tmp_path):
+    log = tmp_path / "run.log"
+    log.write_text(SAMPLE_LOG)
+    parsed_path = tmp_path / "parsed.json"
+    r = subprocess.run(
+        [sys.executable, str(TOOLS / "parse_shadow.py"), str(log), "-o", str(parsed_path)],
+        capture_output=True,
+        text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    parsed = json.loads(parsed_path.read_text())
+    assert len(parsed["heartbeats"]) == 2
+    assert parsed["heartbeats"][1]["packets"] == 10
+    assert parsed["hosts"]["server"][1]["packets_dropped"] == 1
+    assert parsed["wall_seconds"] == 0.29
+
+    svg = tmp_path / "plot.svg"
+    r = subprocess.run(
+        [sys.executable, str(TOOLS / "plot_shadow.py"), str(parsed_path), "-o", str(svg)],
+        capture_output=True,
+        text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "<svg" in svg.read_text()
+    assert "server" in svg.read_text()
+
+
+def test_shm_cleanup(tmp_path):
+    import os
+    import time
+
+    from shadow_tpu.cli import shm_cleanup
+
+    stale = tmp_path / "shadow-tpu-h0p1000-old"
+    stale.write_bytes(b"x")
+    os.utime(stale, (time.time() - 3600, time.time() - 3600))
+    fresh = tmp_path / "shadow-tpu-h0p1001-live"
+    fresh.write_bytes(b"x")
+    other = tmp_path / "unrelated"
+    other.write_bytes(b"x")
+    assert shm_cleanup(str(tmp_path)) == 0
+    assert not stale.exists()
+    assert fresh.exists()  # too young: possibly a live simulation's block
+    assert other.exists()

@@ -1,0 +1,76 @@
+#!/usr/bin/env python3
+"""Parse a shadow-tpu run's log into structured JSON (the analogue of the
+reference's src/tools/parse-shadow.py, whose heartbeat format tornettools
+consumes). Reads manager heartbeats and per-host tracker lines.
+
+Usage: parse_shadow.py <logfile> [-o out.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+HEARTBEAT = re.compile(
+    r"(?P<real>[0-9:.]+) \[info\] \[(?P<sim>[^\]]+)\] \[manager\] "
+    r"heartbeat: (?P<a>\d+) (?:events|syscalls), (?P<packets>\d+) packets"
+)
+TRACKER = re.compile(
+    r"\[(?P<sim>[^\]]+)\] \[(?P<host>[^\]]+)\] tracker: "
+    r"bytes_sent=(?P<tx>\d+) bytes_recv=(?P<rx>\d+) "
+    r"packets_sent=(?P<ptx>\d+) packets_dropped=(?P<drop>\d+)"
+)
+FINISHED = re.compile(r"finished: .* in (?P<wall>[0-9.]+)s wall")
+
+
+def parse(lines):
+    out = {"heartbeats": [], "hosts": {}, "wall_seconds": None}
+    for line in lines:
+        m = HEARTBEAT.search(line)
+        if m:
+            out["heartbeats"].append(
+                {
+                    "sim_time": m.group("sim"),
+                    "work": int(m.group("a")),
+                    "packets": int(m.group("packets")),
+                }
+            )
+            continue
+        m = TRACKER.search(line)
+        if m:
+            out["hosts"].setdefault(m.group("host"), []).append(
+                {
+                    "sim_time": m.group("sim"),
+                    "bytes_sent": int(m.group("tx")),
+                    "bytes_recv": int(m.group("rx")),
+                    "packets_sent": int(m.group("ptx")),
+                    "packets_dropped": int(m.group("drop")),
+                }
+            )
+            continue
+        m = FINISHED.search(line)
+        if m:
+            out["wall_seconds"] = float(m.group("wall"))
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("logfile")
+    ap.add_argument("-o", "--output", default=None)
+    args = ap.parse_args(argv)
+    with open(args.logfile) as f:
+        data = parse(f)
+    text = json.dumps(data, indent=2)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text + "\n")
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

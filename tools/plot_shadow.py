@@ -1,0 +1,74 @@
+#!/usr/bin/env python3
+"""Plot per-host throughput over simulated time from parse_shadow.py
+output (the analogue of the reference's src/tools/plot-shadow.py).
+Writes an SVG without needing matplotlib.
+
+Usage: plot_shadow.py parsed.json -o plot.svg
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _sim_seconds(ts: str) -> float:
+    clock = ts.split()[-1]
+    h, m, s = clock.split(":")
+    return int(h) * 3600 + int(m) * 60 + float(s)
+
+
+def render_svg(parsed: dict, width=800, height=400) -> str:
+    hosts = parsed.get("hosts", {})
+    series = []
+    for host, samples in sorted(hosts.items()):
+        pts = [(_sim_seconds(s["sim_time"]), s["bytes_recv"]) for s in samples]
+        if pts:
+            series.append((host, pts))
+    if not series:
+        return "<svg xmlns='http://www.w3.org/2000/svg'/>"
+    tmax = max(t for _, pts in series for t, _ in pts) or 1.0
+    vmax = max(v for _, pts in series for _, v in pts) or 1
+    pad = 40
+    out = [
+        f"<svg xmlns='http://www.w3.org/2000/svg' width='{width}' height='{height}'>",
+        f"<text x='{pad}' y='16' font-size='12'>bytes received vs simulated seconds</text>",
+    ]
+    colors = ["#4363d8", "#e6194b", "#3cb44b", "#f58231", "#911eb4", "#46f0f0"]
+    for i, (host, pts) in enumerate(series):
+        path = " ".join(
+            f"{'M' if j == 0 else 'L'}"
+            f"{pad + t / tmax * (width - 2 * pad):.1f},"
+            f"{height - pad - v / vmax * (height - 2 * pad):.1f}"
+            for j, (t, v) in enumerate(pts)
+        )
+        c = colors[i % len(colors)]
+        out.append(f"<path d='{path}' fill='none' stroke='{c}' stroke-width='1.5'/>")
+        out.append(
+            f"<text x='{width - pad + 2}' y='{20 + 14 * i}' font-size='10' fill='{c}'>{host}</text>"
+        )
+    out.append(
+        f"<line x1='{pad}' y1='{height - pad}' x2='{width - pad}' y2='{height - pad}' stroke='#333'/>"
+    )
+    out.append(f"<line x1='{pad}' y1='{pad}' x2='{pad}' y2='{height - pad}' stroke='#333'/>")
+    out.append("</svg>")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("parsed_json")
+    ap.add_argument("-o", "--output", default="shadow-plot.svg")
+    args = ap.parse_args(argv)
+    with open(args.parsed_json) as f:
+        parsed = json.load(f)
+    svg = render_svg(parsed)
+    with open(args.output, "w") as f:
+        f.write(svg)
+    print(f"wrote {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
