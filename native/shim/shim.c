@@ -1203,8 +1203,8 @@ ssize_t recvfrom(int fd, void *buf, size_t n, int flags,
     if (!g_active || !is_vfd(fd))
         return rsyscall(SYS_recvfrom, fd, buf, n, flags, addr, len);
     ShimMsg reply;
-    int64_t r = vsys(VSYS_RECVFROM, fd, (int64_t)(flags & MSG_DONTWAIT) != 0,
-                     (int64_t)n, NULL, 0, &reply);
+    int64_t fl = ((flags & MSG_DONTWAIT) ? 1 : 0) | ((flags & MSG_PEEK) ? 2 : 0);
+    int64_t r = vsys(VSYS_RECVFROM, fd, fl, (int64_t)n, NULL, 0, &reply);
     if (r < 0) {
         errno = (int)-r;
         return -1;
@@ -1992,6 +1992,38 @@ int getentropy(void *buf, size_t buflen) {
     if (!g_active)
         return (int)rsyscall(SYS_getrandom, buf, buflen, 0) >= 0 ? 0 : -1;
     return getrandom(buf, buflen, 0) == (ssize_t)buflen ? 0 : -1;
+}
+
+/* ---- OpenSSL RNG overrides (reference: src/lib/openssl_preload/rng.c) —
+ * only bound when the guest links OpenSSL; deterministic bytes come from
+ * the host RNG stream via our getrandom. ---- */
+
+int RAND_bytes(unsigned char *buf, int num) {
+    if (!g_active) {
+        static int (*real)(unsigned char *, int);
+        if (!real)
+            real = (int (*)(unsigned char *, int))dlsym(RTLD_NEXT, "RAND_bytes");
+        return real ? real(buf, num) : 0;
+    }
+    int off = 0;
+    while (off < num) {
+        ssize_t r = getrandom(buf + off, (size_t)(num - off), 0);
+        if (r <= 0)
+            return 0;
+        off += (int)r;
+    }
+    return 1;
+}
+
+int RAND_priv_bytes(unsigned char *buf, int num) { return RAND_bytes(buf, num); }
+int RAND_pseudo_bytes(unsigned char *buf, int num) { return RAND_bytes(buf, num); }
+int RAND_status(void) { return 1; }
+int RAND_poll(void) { return 1; }
+void RAND_seed(const void *buf, int num) { (void)buf; (void)num; }
+void RAND_add(const void *buf, int num, double entropy) {
+    (void)buf;
+    (void)num;
+    (void)entropy;
 }
 
 /* ---- seccomp SIGSYS routing (tier 2; reference shim_seccomp.c) --------

@@ -46,17 +46,28 @@ def main(argv: "list[str] | None" = None) -> int:
 
 
 def shm_cleanup(shm_dir: str = "/dev/shm") -> int:
-    """Remove shadow-tpu shm blocks whose owning simulator is gone
-    (reference: shm_cleanup.rs). Blocks are named shadow-tpu-<tag>-*."""
+    """Remove shadow-tpu shm blocks no live process has mapped
+    (reference: shm_cleanup.rs checks owner liveness the same way).
+    Blocks are named shadow-tpu-<tag>-*."""
     import pathlib
-    import time
 
+    def mapped_paths():
+        mapped = set()
+        for maps in pathlib.Path("/proc").glob("[0-9]*/maps"):
+            try:
+                for line in maps.read_text().splitlines():
+                    if "shadow-tpu-" in line:
+                        mapped.add(line.split(maxsplit=5)[-1].split(" (deleted)")[0])
+            except OSError:
+                continue  # process went away mid-scan
+        return mapped
+
+    live = mapped_paths()
     removed = 0
-    now = time.time()
     for p in pathlib.Path(shm_dir).glob("shadow-tpu-*"):
+        if str(p) in live:
+            continue  # a running simulation still maps this block
         try:
-            if now - p.stat().st_mtime < 600:
-                continue  # possibly owned by a live simulation
             p.unlink()
             removed += 1
         except OSError:

@@ -553,6 +553,16 @@ class UnixSocket(File):
         self.peer.notify()
         return len(take)
 
+    def stream_peek(self, n: int) -> "bytes | int":
+        """MSG_PEEK: read without consuming."""
+        if self.peer is None and not self.peer_closed:
+            return -ENOTCONN
+        if self.recv_buf:
+            return bytes(self.recv_buf[:n])
+        if self.peer_closed:
+            return b""
+        return -EAGAIN
+
     def stream_recv(self, n: int) -> "bytes | int":
         if self.peer is None and not self.peer_closed:
             return -ENOTCONN

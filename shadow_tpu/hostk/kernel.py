@@ -2007,19 +2007,32 @@ class NetKernel:
         if f is None:
             proc._reply(-EBADF)
             return True
-        dontwait = bool(int(msg.a[2]))
-        n = int(msg.a[3]) or I.SHIM_BUF_SIZE
+        fl = int(msg.a[2])
+        dontwait, peek = bool(fl & 1), bool(fl & 2)
+        n = int(msg.a[3])
+        if n == 0:  # zero-length recv: probe only, never consume (POSIX)
+            proc._reply(0)
+            return True
+        n = min(n, I.SHIM_BUF_SIZE)
         if isinstance(f, T.TcpSocket):
-            return self._tcp_recv(proc, f, min(n, I.SHIM_BUF_SIZE), dontwait)
+            return self._tcp_recv(proc, f, min(n, I.SHIM_BUF_SIZE), dontwait, peek=peek)
         if isinstance(f, UdpSocket):
-            return self._udp_recv(proc, f, min(n, I.SHIM_BUF_SIZE), dontwait)
+            return self._udp_recv(proc, f, min(n, I.SHIM_BUF_SIZE), dontwait, peek=peek)
         if isinstance(f, UnixSocket):
-            return self._unix_recv(proc, f, min(n, I.SHIM_BUF_SIZE), dontwait, include_path=True)
+            return self._unix_recv(
+                proc, f, min(n, I.SHIM_BUF_SIZE), dontwait, include_path=True, peek=peek
+            )
         proc._reply(-ENOTSOCK)
         return True
 
     def _unix_recv(
-        self, proc, sock: UnixSocket, n: int, dontwait: bool, include_path: bool
+        self,
+        proc,
+        sock: UnixSocket,
+        n: int,
+        dontwait: bool,
+        include_path: bool,
+        peek: bool = False,
     ) -> bool:
         """Unix-socket receive. Reply contract when a source address rides
         along: a[4]=1 (unix marker), a[2]=pathlen, a[3]=abstract flag,
@@ -2028,7 +2041,9 @@ class NetKernel:
         def attempt() -> "Optional[tuple]":
             """-> (ret, a, buf) or None if would block."""
             if sock.stype == SOCK_DGRAM:
-                d = sock.dgram_recv()
+                d = sock.dgrams[0] if (peek and sock.dgrams) else (
+                    None if peek else sock.dgram_recv()
+                )
                 if d is None:
                     return None
                 src, data = d
@@ -2039,7 +2054,7 @@ class NetKernel:
                     data = data[: I.SHIM_BUF_SIZE - len(path)]
                     return (len(data), (0, 0, len(path), int(src[0]), 1), path + data)
                 return (len(data), (0, 0, 0, 0, 1), data)
-            r = sock.stream_recv(n)
+            r = sock.stream_peek(n) if peek else sock.stream_recv(n)
             if r == -EAGAIN:
                 return None
             if isinstance(r, int):
@@ -2064,11 +2079,16 @@ class NetKernel:
         proc._reply(got[0], a=got[1], buf=got[2])
         return True
 
-    def _udp_recv(self, proc, sock: UdpSocket, n: int, dontwait: bool) -> bool:
+    def _udp_recv(
+        self, proc, sock: UdpSocket, n: int, dontwait: bool, peek: bool = False
+    ) -> bool:
         def check() -> bool:
             if not sock.recvq:
                 return False
-            data, sip, sport = sock.take()
+            if peek:
+                data, sip, sport = sock.recvq[0]
+            else:
+                data, sip, sport = sock.take()
             proc._reply(len(data), a=(0, 0, sip, sport), buf=data[:n])
             return True
 
@@ -2097,9 +2117,11 @@ class NetKernel:
         proc._reply(r)
         return True
 
-    def _tcp_recv(self, proc, sock: T.TcpSocket, n: int, dontwait: bool) -> bool:
+    def _tcp_recv(
+        self, proc, sock: T.TcpSocket, n: int, dontwait: bool, peek: bool = False
+    ) -> bool:
         def check() -> bool:
-            r = sock.recv(n)
+            r = sock.peek(n) if peek else sock.recv(n)
             if isinstance(r, int):
                 if r == -EAGAIN:
                     return False

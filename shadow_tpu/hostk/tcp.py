@@ -278,6 +278,21 @@ class TcpSocket(File):
         self._flush()
         return len(take)
 
+    def peek(self, n: int) -> "bytes | int":
+        """MSG_PEEK: read without consuming (no window update)."""
+        if self.state == LISTEN:
+            return -EINVAL
+        if self.error:
+            e, self.error = self.error, 0
+            return -e
+        if self.rcv_buf:
+            return bytes(self.rcv_buf[:n])
+        if self._at_eof():
+            return b""
+        if self.state in (CLOSED,):
+            return -ENOTCONN
+        return -EAGAIN
+
     def recv(self, n: int) -> "bytes | int":
         if self.state == LISTEN:
             return -EINVAL

@@ -68,6 +68,18 @@ int main(void) {
     CHECK(recvmsg(sv[1], &rmh, 0) == 9 && memcmp(rb, "ping-pong", 9) == 0,
           "recvmsg");
 
+    /* MSG_PEEK: observe without consuming, then really consume */
+    CHECK(sendmsg(sv[0], &mh, 0) == 9, "peek-refill");
+    char pk[32] = {0};
+    CHECK(recv(sv[1], pk, sizeof(pk), MSG_PEEK) == 9 &&
+              memcmp(pk, "ping-pong", 9) == 0,
+          "msg-peek");
+    memset(pk, 0, sizeof(pk));
+    CHECK(recv(sv[1], pk, sizeof(pk), 0) == 9 && memcmp(pk, "ping-pong", 9) == 0,
+          "peek-then-recv");
+    CHECK(recv(sv[1], pk, sizeof(pk), MSG_DONTWAIT) == -1 && errno == EAGAIN,
+          "peek-consumed");
+
     /* fstat on a socket reports S_IFSOCK; lseek is ESPIPE */
     struct stat st;
     CHECK(fstat(sv[0], &st) == 0 && S_ISSOCK(st.st_mode), "fstat-sock");

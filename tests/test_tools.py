@@ -45,19 +45,25 @@ def test_parse_and_plot(tmp_path):
 
 
 def test_shm_cleanup(tmp_path):
+    import mmap
     import os
-    import time
 
     from shadow_tpu.cli import shm_cleanup
 
-    stale = tmp_path / "shadow-tpu-h0p1000-old"
-    stale.write_bytes(b"x")
-    os.utime(stale, (time.time() - 3600, time.time() - 3600))
-    fresh = tmp_path / "shadow-tpu-h0p1001-live"
-    fresh.write_bytes(b"x")
+    stale = tmp_path / "shadow-tpu-h0p1000-dead"
+    stale.write_bytes(b"x" * 4096)
+    live = tmp_path / "shadow-tpu-h0p1001-live"
+    live.write_bytes(b"x" * 4096)
     other = tmp_path / "unrelated"
     other.write_bytes(b"x")
-    assert shm_cleanup(str(tmp_path)) == 0
-    assert not stale.exists()
-    assert fresh.exists()  # too young: possibly a live simulation's block
-    assert other.exists()
+    # map the live block like a running simulation would
+    fd = os.open(live, os.O_RDWR)
+    mm = mmap.mmap(fd, 4096)
+    os.close(fd)
+    try:
+        assert shm_cleanup(str(tmp_path)) == 0
+        assert not stale.exists()  # nobody maps it: crash debris, removed
+        assert live.exists()  # mapped by a live process: kept
+        assert other.exists()  # not ours
+    finally:
+        mm.close()

@@ -14,9 +14,18 @@ import sys
 
 
 def _sim_seconds(ts: str) -> float:
-    clock = ts.split()[-1]
+    """Seconds since the 2000-01-01 sim epoch (date included so multi-day
+    simulations stay monotonic)."""
+    import datetime
+
+    parts = ts.split()
+    clock = parts[-1]
     h, m, s = clock.split(":")
-    return int(h) * 3600 + int(m) * 60 + float(s)
+    secs = int(h) * 3600 + int(m) * 60 + float(s)
+    if len(parts) == 2:
+        d = datetime.date.fromisoformat(parts[0])
+        secs += (d - datetime.date(2000, 1, 1)).days * 86400.0
+    return secs
 
 
 def render_svg(parsed: dict, width=800, height=400) -> str:
