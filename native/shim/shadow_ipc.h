@@ -53,7 +53,8 @@ enum {
     VSYS_SOCKET = 2,     /* a[1]=domain a[2]=type a[3]=proto */
     VSYS_BIND = 3,       /* a[1]=fd a[2]=ip(be) a[3]=port(host order) */
     VSYS_SENDTO = 4,     /* a[1]=fd a[2]=ip a[3]=port, buf=payload */
-    VSYS_RECVFROM = 5,   /* a[1]=fd a[2]=flags(MSG_DONTWAIT bit) -> buf, a[2]=src ip a[3]=src port */
+    VSYS_RECVFROM = 5,   /* a[1]=fd a[2]=flag bits (1 MSG_DONTWAIT, 2 MSG_PEEK)
+                            a[3]=len -> buf, a[2]=src ip a[3]=src port */
     VSYS_CLOSE = 6,      /* a[1]=fd */
     VSYS_GETPID = 7,
     VSYS_CONNECT = 8,    /* a[1]=fd a[2]=ip a[3]=port */

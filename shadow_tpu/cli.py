@@ -62,11 +62,19 @@ def shm_cleanup(shm_dir: str = "/dev/shm") -> int:
                 continue  # process went away mid-scan
         return mapped
 
+    import time
+
     live = mapped_paths()
     removed = 0
+    now = time.time()
     for p in pathlib.Path(shm_dir).glob("shadow-tpu-*"):
         if str(p) in live:
             continue  # a running simulation still maps this block
+        try:
+            if now - p.stat().st_mtime < 5:
+                continue  # created moments ago: may not be mapped yet
+        except OSError:
+            continue
         try:
             p.unlink()
             removed += 1

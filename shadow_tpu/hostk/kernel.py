@@ -529,6 +529,7 @@ class NetKernel:
         pcap: bool = False,
         host_ips: "Optional[list[int]]" = None,
         heartbeat_ns: int = 0,
+        progress: bool = False,
     ):
         self.tables = tables
         self.lat = np.asarray(tables.lat_ns)
@@ -568,6 +569,8 @@ class NetKernel:
         self.event_log: list[tuple[int, str]] = []
         self.heartbeat_ns = heartbeat_ns
         self._next_hb = heartbeat_ns if heartbeat_ns > 0 else None
+        self.progress = progress
+        self._last_progress_wall = 0.0
         # per-syscall-name counts, aggregated like the reference's
         # worker-local-then-merged counters (worker.rs:428-475, sim_stats.rs)
         import collections
@@ -1088,10 +1091,30 @@ class NetKernel:
         heapq.heappush(self.events, (t, self._seq, fn))
         self._seq += 1
 
+    def _print_progress(self, until_ns: int) -> None:
+        """Status line (reference: utility/status_bar.rs + the controller's
+        progress printer, controller.rs:42-51)."""
+        import sys
+        import time as _time
+
+        w = _time.monotonic()
+        if w - self._last_progress_wall < 0.5:
+            return
+        self._last_progress_wall = w
+        pct = min(100, self.now * 100 // max(until_ns, 1))
+        print(
+            f"\rprogress: {pct:3d}% (sim {self.now / 1e9:.2f}s / {until_ns / 1e9:.2f}s)",
+            end="",
+            file=sys.stderr,
+            flush=True,
+        )
+
     def run(self, until_ns: int) -> None:
         hb = self.heartbeat_ns
         try:
             while self.events:
+                if self.progress:
+                    self._print_progress(until_ns)
                 t = self.events[0][0]
                 if self._next_hb is not None and self._next_hb <= until_ns and self._next_hb < t:
                     self.now = max(self.now, self._next_hb)
@@ -1109,6 +1132,10 @@ class NetKernel:
                 self.now = max(self.now, self._next_hb)
                 self._heartbeat()
                 self._next_hb += hb
+            if self.progress:
+                import sys
+
+                print(f"\rprogress: 100% (sim {until_ns / 1e9:.2f}s)", file=sys.stderr)
         finally:
             self.shutdown_check()
 
@@ -2009,19 +2036,20 @@ class NetKernel:
             return True
         fl = int(msg.a[2])
         dontwait, peek = bool(fl & 1), bool(fl & 2)
-        n = int(msg.a[3])
-        if n == 0:  # zero-length recv: probe only, never consume (POSIX)
-            proc._reply(0)
-            return True
-        n = min(n, I.SHIM_BUF_SIZE)
+        n = min(int(msg.a[3]), I.SHIM_BUF_SIZE)
         if isinstance(f, T.TcpSocket):
-            return self._tcp_recv(proc, f, min(n, I.SHIM_BUF_SIZE), dontwait, peek=peek)
+            if n == 0:  # stream: returns 0 immediately, consumes nothing
+                proc._reply(0)
+                return True
+            return self._tcp_recv(proc, f, n, dontwait, peek=peek)
         if isinstance(f, UdpSocket):
-            return self._udp_recv(proc, f, min(n, I.SHIM_BUF_SIZE), dontwait, peek=peek)
+            # n == 0 on a datagram socket still dequeues (truncate-discard)
+            return self._udp_recv(proc, f, n, dontwait, peek=peek)
         if isinstance(f, UnixSocket):
-            return self._unix_recv(
-                proc, f, min(n, I.SHIM_BUF_SIZE), dontwait, include_path=True, peek=peek
-            )
+            if n == 0 and f.stype == SOCK_STREAM:
+                proc._reply(0)
+                return True
+            return self._unix_recv(proc, f, n, dontwait, include_path=True, peek=peek)
         proc._reply(-ENOTSOCK)
         return True
 

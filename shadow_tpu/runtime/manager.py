@@ -234,7 +234,21 @@ class Manager:
         hb_ns = cfgo.general.heartbeat_interval_ns
         last_hb = [0]
 
+        last_progress = [0.0]
+
         def on_chunk(st):
+            now_chunk = int(np.asarray(st.now))
+            if cfgo.general.progress and time.monotonic() - last_progress[0] >= 0.5:
+                import sys
+
+                last_progress[0] = time.monotonic()
+                pct = min(100, now_chunk * 100 // max(end, 1))
+                print(
+                    f"\rprogress: {pct:3d}% (sim {now_chunk / 1e9:.2f}s / {end / 1e9:.2f}s)",
+                    end="",
+                    file=sys.stderr,
+                    flush=True,
+                )
             if hb_ns <= 0:
                 return
             now = int(np.asarray(st.now))
@@ -254,6 +268,10 @@ class Manager:
         t0 = time.perf_counter()
         final = sched.run(end, on_chunk=on_chunk)
         wall = time.perf_counter() - t0
+        if cfgo.general.progress:
+            import sys
+
+            print(f"\rprogress: 100% (sim {end / 1e9:.2f}s)", file=sys.stderr)
 
         if isinstance(sched, CpuRefScheduler):
             results = SimResults(
@@ -310,6 +328,7 @@ class Manager:
             pcap=cfgo.experimental.use_pcap,
             host_ips=[h.ip for h in self.hosts],
             heartbeat_ns=cfgo.general.heartbeat_interval_ns,
+            progress=cfgo.general.progress,
         )
         for h in self.hosts:
             for p in h.spec.processes:

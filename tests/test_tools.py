@@ -50,8 +50,11 @@ def test_shm_cleanup(tmp_path):
 
     from shadow_tpu.cli import shm_cleanup
 
+    import time
+
     stale = tmp_path / "shadow-tpu-h0p1000-dead"
     stale.write_bytes(b"x" * 4096)
+    os.utime(stale, (time.time() - 60, time.time() - 60))  # past the grace
     live = tmp_path / "shadow-tpu-h0p1001-live"
     live.write_bytes(b"x" * 4096)
     other = tmp_path / "unrelated"
