@@ -569,8 +569,9 @@ class NetKernel:
         self.event_log: list[tuple[int, str]] = []
         self.heartbeat_ns = heartbeat_ns
         self._next_hb = heartbeat_ns if heartbeat_ns > 0 else None
-        self.progress = progress
-        self._last_progress_wall = 0.0
+        from shadow_tpu.utils.progress import ProgressLine
+
+        self.progress = ProgressLine(progress)
         # per-syscall-name counts, aggregated like the reference's
         # worker-local-then-merged counters (worker.rs:428-475, sim_stats.rs)
         import collections
@@ -1091,30 +1092,12 @@ class NetKernel:
         heapq.heappush(self.events, (t, self._seq, fn))
         self._seq += 1
 
-    def _print_progress(self, until_ns: int) -> None:
-        """Status line (reference: utility/status_bar.rs + the controller's
-        progress printer, controller.rs:42-51)."""
-        import sys
-        import time as _time
-
-        w = _time.monotonic()
-        if w - self._last_progress_wall < 0.5:
-            return
-        self._last_progress_wall = w
-        pct = min(100, self.now * 100 // max(until_ns, 1))
-        print(
-            f"\rprogress: {pct:3d}% (sim {self.now / 1e9:.2f}s / {until_ns / 1e9:.2f}s)",
-            end="",
-            file=sys.stderr,
-            flush=True,
-        )
-
     def run(self, until_ns: int) -> None:
         hb = self.heartbeat_ns
         try:
             while self.events:
-                if self.progress:
-                    self._print_progress(until_ns)
+                if self.progress.enabled:
+                    self.progress.update(self.now, until_ns)
                 t = self.events[0][0]
                 if self._next_hb is not None and self._next_hb <= until_ns and self._next_hb < t:
                     self.now = max(self.now, self._next_hb)
@@ -1132,10 +1115,7 @@ class NetKernel:
                 self.now = max(self.now, self._next_hb)
                 self._heartbeat()
                 self._next_hb += hb
-            if self.progress:
-                import sys
-
-                print(f"\rprogress: 100% (sim {until_ns / 1e9:.2f}s)", file=sys.stderr)
+            self.progress.finish(until_ns)
         finally:
             self.shutdown_check()
 
@@ -1145,6 +1125,7 @@ class NetKernel:
         bytes in/out heartbeats)."""
         from shadow_tpu.utils.shadow_log import slog
 
+        self.progress.clear()  # don't interleave with the \r status line
         total_sc = sum(self.syscall_counts.values())
         slog(
             "info",

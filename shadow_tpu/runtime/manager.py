@@ -234,28 +234,22 @@ class Manager:
         hb_ns = cfgo.general.heartbeat_interval_ns
         last_hb = [0]
 
-        last_progress = [0.0]
+        from shadow_tpu.utils.progress import ProgressLine
+
+        progress = ProgressLine(cfgo.general.progress)
 
         def on_chunk(st):
-            now_chunk = int(np.asarray(st.now))
-            if cfgo.general.progress and time.monotonic() - last_progress[0] >= 0.5:
-                import sys
-
-                last_progress[0] = time.monotonic()
-                pct = min(100, now_chunk * 100 // max(end, 1))
-                print(
-                    f"\rprogress: {pct:3d}% (sim {now_chunk / 1e9:.2f}s / {end / 1e9:.2f}s)",
-                    end="",
-                    file=sys.stderr,
-                    flush=True,
-                )
+            if not progress.enabled and hb_ns <= 0:
+                return  # nothing to report: skip the device sync entirely
+            now = int(np.asarray(st.now))
+            progress.update(now, end)
             if hb_ns <= 0:
                 return
-            now = int(np.asarray(st.now))
             if now - last_hb[0] >= hb_ns:
                 last_hb[0] = now
                 ev = int(np.asarray(st.events_handled).sum())
                 pk = int(np.asarray(st.packets_sent).sum())
+                progress.clear()
                 slog(
                     "info",
                     now,
@@ -268,10 +262,7 @@ class Manager:
         t0 = time.perf_counter()
         final = sched.run(end, on_chunk=on_chunk)
         wall = time.perf_counter() - t0
-        if cfgo.general.progress:
-            import sys
-
-            print(f"\rprogress: 100% (sim {end / 1e9:.2f}s)", file=sys.stderr)
+        progress.finish(end)
 
         if isinstance(sched, CpuRefScheduler):
             results = SimResults(

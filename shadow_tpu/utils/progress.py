@@ -1,0 +1,40 @@
+"""Status line (reference: utility/status_bar.rs + the controller's
+progress printer, controller.rs:42-51). One instance per run; both
+schedulers and the managed kernel share it so format/throttle live in
+one place. Regular log lines call clear() first so the \\r status line
+never interleaves with them."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+
+class ProgressLine:
+    def __init__(self, enabled: bool):
+        self.enabled = enabled
+        self._last = 0.0
+
+    def update(self, now_ns: int, end_ns: int) -> None:
+        if not self.enabled:
+            return
+        w = time.monotonic()
+        if w - self._last < 0.5:
+            return
+        self._last = w
+        pct = min(100, now_ns * 100 // max(end_ns, 1))
+        print(
+            f"\r\x1b[Kprogress: {pct:3d}% (sim {now_ns / 1e9:.2f}s / {end_ns / 1e9:.2f}s)",
+            end="",
+            file=sys.stderr,
+            flush=True,
+        )
+
+    def clear(self) -> None:
+        """Erase the status line before an ordinary log record."""
+        if self.enabled:
+            print("\r\x1b[K", end="", file=sys.stderr, flush=True)
+
+    def finish(self, end_ns: int) -> None:
+        if self.enabled:
+            print(f"\r\x1b[Kprogress: 100% (sim {end_ns / 1e9:.2f}s)", file=sys.stderr)

@@ -1,0 +1,107 @@
+// Guest test program: the C++ runtime over the shim (reference:
+// src/test/cpp). std::thread -> pthreads, std::mutex/condition_variable ->
+// kernel-side sync, chrono/sleep_for -> simulated clocks, iostreams, and
+// a TCP self-connection through the simulated stack.
+#include <arpa/inet.h>
+#include <chrono>
+#include <condition_variable>
+#include <cstdlib>
+#include <cstring>
+#include <iostream>
+#include <mutex>
+#include <netinet/in.h>
+#include <string>
+#include <sys/socket.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+#define CHECK(cond, name)                                                      \
+    do {                                                                       \
+        if (!(cond)) {                                                         \
+            std::cout << "FAIL " << name << std::endl;                         \
+            return 1;                                                          \
+        }                                                                      \
+        std::cout << "ok " << name << std::endl;                               \
+    } while (0)
+
+int main() {
+    using clk = std::chrono::system_clock;
+
+    // chrono reads simulated time (epoch 2000-01-01) — sim only; natively
+    // the epoch is the real date
+    auto t0 = clk::now();
+    if (getenv("SHADOW_SHM")) {
+        auto secs = std::chrono::duration_cast<std::chrono::seconds>(
+                        t0.time_since_epoch())
+                        .count();
+        CHECK(secs >= 946684800 && secs < 946684800 + 3600, "chrono-epoch");
+    }
+
+    // sleep_for advances only simulated time
+    std::this_thread::sleep_for(std::chrono::milliseconds(120));
+    auto waited = std::chrono::duration_cast<std::chrono::milliseconds>(
+                      clk::now() - t0)
+                      .count();
+    CHECK(waited >= 120 && waited <= 200, "sleep_for");
+
+    // std::thread + mutex + condition_variable
+    std::mutex mu;
+    std::condition_variable cv;
+    int produced = 0;
+    long sum = 0;
+    std::thread producer([&] {
+        for (int i = 1; i <= 5; i++) {
+            std::this_thread::sleep_for(std::chrono::milliseconds(10));
+            std::lock_guard<std::mutex> g(mu);
+            produced = i;
+            cv.notify_one();
+        }
+    });
+    std::thread consumer([&] {
+        int seen = 0;
+        std::unique_lock<std::mutex> lk(mu);
+        while (seen < 5) {
+            cv.wait(lk, [&] { return produced > seen; });
+            seen = produced;
+            sum += seen;
+        }
+    });
+    producer.join();
+    consumer.join();
+    CHECK(sum >= 15, "thread-condvar");
+
+    // TCP through the simulated loopback
+    int srv = socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in a{};
+    a.sin_family = AF_INET;
+    a.sin_addr.s_addr = htonl(INADDR_ANY);
+    a.sin_port = htons(8080);
+    CHECK(bind(srv, (sockaddr *)&a, sizeof(a)) == 0 && listen(srv, 4) == 0,
+          "tcp-listen");
+    std::string got;
+    std::thread server([&] {
+        int c = accept(srv, nullptr, nullptr);
+        char buf[128];
+        ssize_t r = recv(c, buf, sizeof(buf), 0);
+        if (r > 0)
+            got.assign(buf, (size_t)r);
+        send(c, "pong", 4, 0);
+        close(c);
+    });
+    int cli = socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in dst = a;
+    dst.sin_addr.s_addr = htonl(0x7F000001);
+    CHECK(connect(cli, (sockaddr *)&dst, sizeof(dst)) == 0, "tcp-connect");
+    send(cli, "ping", 4, 0);
+    char rb[16];
+    ssize_t r = recv(cli, rb, sizeof(rb), 0);
+    server.join();
+    CHECK(r == 4 && std::memcmp(rb, "pong", 4) == 0 && got == "ping",
+          "tcp-echo");
+    close(cli);
+    close(srv);
+
+    std::cout << "cpp all ok sum=" << sum << std::endl;
+    return 0;
+}

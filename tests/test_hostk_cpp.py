@@ -1,0 +1,48 @@
+"""C++ runtime guest (reference: src/test/cpp): libstdc++ threads,
+condition variables, chrono, iostreams, and TCP through the shim."""
+
+import pathlib
+import subprocess
+
+import pytest
+
+from shadow_tpu.graph import NetworkGraph, compute_routing
+from shadow_tpu.hostk.kernel import NetKernel, ProcessSpec
+from shadow_tpu.simtime import NS_PER_SEC
+
+GUESTS = pathlib.Path(__file__).parent / "guests"
+
+
+@pytest.fixture(scope="module")
+def cpp_bin(tmp_path_factory):
+    out = tmp_path_factory.mktemp("guests") / "cpp_guest"
+    subprocess.run(
+        ["c++", "-O2", "-std=c++17", "-pthread", "-o", str(out), str(GUESTS / "cpp_guest.cc")],
+        check=True,
+    )
+    return str(out)
+
+
+def test_cpp_guest_native(tmp_path, cpp_bin):
+    """Paired-test contract: threads/condvars/TCP pass on the real
+    kernel (the chrono-epoch check is sim-gated inside the guest)."""
+    r = subprocess.run([cpp_bin], capture_output=True, text=True, cwd=tmp_path)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "cpp all ok" in r.stdout
+    assert "ok thread-condvar" in r.stdout
+
+
+def test_cpp_guest_under_shim(tmp_path, cpp_bin):
+    graph = NetworkGraph.from_gml(
+        'graph [\n  node [ id 0 ]\n  edge [ source 0 target 0 latency "1 ms" ]\n]'
+    )
+    tables = compute_routing(graph).with_hosts([0])
+    k = NetKernel(tables, host_names=["box"], host_nodes=[0], data_dir=tmp_path / "d")
+    p = k.add_process(ProcessSpec(host="box", args=[cpp_bin]))
+    try:
+        k.run(10 * NS_PER_SEC)
+    finally:
+        k.shutdown()
+    out = p.stdout().decode()
+    assert p.exit_code == 0, out + p.stderr().decode()
+    assert "cpp all ok sum=15" in out
