@@ -38,7 +38,7 @@ from typing import Callable, Optional
 import jax.numpy as jnp
 import numpy as np
 
-from shadow_tpu import rng
+from shadow_tpu import netstack, rng
 from shadow_tpu.graph.routing import RoutingTables
 from shadow_tpu.hostk import ipc as I
 from shadow_tpu.hostk import tcp as T
@@ -485,6 +485,13 @@ class HostKernel:
         self.packets_dropped = 0
         self.bytes_sent = 0
         self.bytes_recv = 0
+        # bandwidth shaping (reference: three relays per host,
+        # host.rs:285-296; loopback is unlimited so it has no bucket)
+        self.tx_tb: "Optional[netstack.TokenBucketRef]" = None
+        self.rx_tb: "Optional[netstack.TokenBucketRef]" = None
+        self.rx_codel = netstack.CoDelRef()
+        self.rx_backlog_bytes = 0
+        self.codel_dropped = 0
 
     def alloc_port(self, proto: int) -> int:
         while (proto, self.next_port) in self.ports:
@@ -530,6 +537,9 @@ class NetKernel:
         host_ips: "Optional[list[int]]" = None,
         heartbeat_ns: int = 0,
         progress: bool = False,
+        bw_up_bits: "Optional[list[int]]" = None,
+        bw_down_bits: "Optional[list[int]]" = None,
+        bootstrap_end_ns: int = 0,
     ):
         self.tables = tables
         self.lat = np.asarray(tables.lat_ns)
@@ -560,6 +570,14 @@ class NetKernel:
         self.dns.write_hosts_file(self.hosts_file)
         self._keys = rng.host_keys(seed, len(self.hosts))
         self._draw_cache: "dict[int, tuple[int, np.ndarray]]" = {}
+        self.bootstrap_end_ns = bootstrap_end_ns
+        for i, hk in enumerate(self.hosts):
+            up = bw_up_bits[i] if bw_up_bits else 0
+            down = bw_down_bits[i] if bw_down_bits else 0
+            if up and up > 0:
+                hk.tx_tb = netstack.TokenBucketRef(netstack.bw_bits_per_sec_to_refill(up))
+            if down and down > 0:
+                hk.rx_tb = netstack.TokenBucketRef(netstack.bw_bits_per_sec_to_refill(down))
 
         self.now = 0
         self._seq = 0
@@ -1152,6 +1170,7 @@ class NetKernel:
             "syscall_counts": dict(sorted(self.syscall_counts.items())),
             "packets_sent": sum(h.packets_sent for h in self.hosts),
             "packets_dropped": sum(h.packets_dropped for h in self.hosts),
+            "codel_dropped": sum(h.codel_dropped for h in self.hosts),
             "bytes_sent": sum(h.bytes_sent for h in self.hosts),
             "bytes_recv": sum(h.bytes_recv for h in self.hosts),
             "processes": len(self.procs),
@@ -2284,18 +2303,52 @@ class NetKernel:
             return lat, 1.0
         return int(self.lat[src.node, dst.node]), float(self.rel[src.node, dst.node])
 
+    def _egress_depart(self, src: HostKernel, t: int, size: int) -> int:
+        """Up-bw relay at the source NIC (relay/mod.rs inet-out); charged
+        before the loss draw, exactly like the device engine (lost packets
+        still consume tokens, worker.rs:361-378 ordering)."""
+        if src.tx_tb is None or t < self.bootstrap_end_ns:
+            return t
+        return src.tx_tb.depart(t, size)
+
+    def _arrive(self, dst: HostKernel, size: int, loopback: bool, deliver_fn) -> None:
+        """Down-bw relay + CoDel at the destination's upstream router
+        (relay inet-in + router/codel, mirroring netstack.py's ingress)."""
+        if loopback or dst.rx_tb is None or self.now < self.bootstrap_end_ns:
+            deliver_fn()
+            return
+        snap = (dst.rx_tb.tokens, dst.rx_tb.last)
+        ready = dst.rx_tb.depart(self.now, size)
+        if dst.rx_codel.dequeue(ready, ready - self.now, dst.rx_backlog_bytes):
+            dst.rx_tb.tokens, dst.rx_tb.last = snap  # drop consumes no tokens
+            dst.codel_dropped += 1
+            self.event_log.append((self.now, f"codel-drop {dst.name} {size}B"))
+            return
+        if ready > self.now:
+            dst.rx_backlog_bytes += size
+
+            def later():
+                dst.rx_backlog_bytes -= size
+                deliver_fn()
+
+            self._push(ready, later)
+        else:
+            deliver_fn()
+
     def _send_packet(
         self, src: HostKernel, t: int, dst_ip: int, dst_port: int,
         src_ip: int, src_port: int, data: bytes,
     ) -> None:
         dst = self.host_by_ip.get(dst_ip)
+        loopback = dst is src
         u = self._loss_draw(src)  # drawn even for unroutable, like the engine
         if dst is None:
             return  # no such host: UDP silently drops
         lat, relv = self._path(src, dst)
         if lat >= TIME_MAX:
-            return
-        if src is not dst and not (u < relv):
+            return  # unroutable packets never charge the tx relay
+        dep = t if loopback else self._egress_depart(src, t, len(data))
+        if not loopback and not (u < relv):
             src.packets_dropped += 1
             self.event_log.append((t, f"drop {src.name}->{dst.name}:{dst_port}"))
             return
@@ -2303,10 +2356,13 @@ class NetKernel:
         src.bytes_sent += len(data)
         if self.pcap:
             self.pcap.udp(src.name, t, src_ip, src_port, dst_ip, dst_port, data)
-        deliver = t + lat
+        size = len(data)
         self._push(
-            deliver,
-            lambda: self._deliver(dst, dst_port, data, src_ip, src_port),
+            dep + lat,
+            lambda: self._arrive(
+                dst, size, loopback,
+                lambda: self._deliver(dst, dst_port, data, src_ip, src_port),
+            ),
         )
 
     def _deliver(
@@ -2329,13 +2385,15 @@ class NetKernel:
         """Transmit one TCP segment through the simulated network (the
         TCP-tier Worker::send_packet)."""
         dst = self.host_by_ip.get(seg.dst_ip)
+        loopback = dst is src
         u = self._loss_draw(src)
         if dst is None:
             return
         lat, relv = self._path(src, dst)
         if lat >= TIME_MAX:
-            return
-        if src is not dst and not (u < relv):
+            return  # unroutable packets never charge the tx relay
+        dep = self.now if loopback else self._egress_depart(src, self.now, seg.wire_len())
+        if not loopback and not (u < relv):
             src.packets_dropped += 1
             self.event_log.append(
                 (self.now, f"drop-tcp {src.name}->{dst.name} {seg.flag_str()} seq={seg.seq}")
@@ -2345,7 +2403,12 @@ class NetKernel:
         src.bytes_sent += seg.wire_len()
         if self.pcap:
             self.pcap.tcp(src.name, self.now, seg)
-        self._push(self.now + lat, lambda: self._deliver_segment(dst, seg))
+        self._push(
+            dep + lat,
+            lambda: self._arrive(
+                dst, seg.wire_len(), loopback, lambda: self._deliver_segment(dst, seg)
+            ),
+        )
 
     def _deliver_segment(self, dst: HostKernel, seg: T.Segment) -> None:
         dst.bytes_recv += seg.wire_len()

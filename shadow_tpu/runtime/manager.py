@@ -320,6 +320,9 @@ class Manager:
             host_ips=[h.ip for h in self.hosts],
             heartbeat_ns=cfgo.general.heartbeat_interval_ns,
             progress=cfgo.general.progress,
+            bw_up_bits=[max(h.bw_up_bits, 0) for h in self.hosts],
+            bw_down_bits=[max(h.bw_down_bits, 0) for h in self.hosts],
+            bootstrap_end_ns=cfgo.general.bootstrap_end_time_ns,
         )
         for h in self.hosts:
             for p in h.spec.processes:

@@ -38,12 +38,14 @@ int main() {
         CHECK(secs >= 946684800 && secs < 946684800 + 3600, "chrono-epoch");
     }
 
-    // sleep_for advances only simulated time
+    // sleep_for advances only simulated time (the tight upper bound is
+    // deterministic only under the shim; natively the OS may overshoot)
     std::this_thread::sleep_for(std::chrono::milliseconds(120));
     auto waited = std::chrono::duration_cast<std::chrono::milliseconds>(
                       clk::now() - t0)
                       .count();
-    CHECK(waited >= 120 && waited <= 200, "sleep_for");
+    bool in_sim = getenv("SHADOW_SHM") != nullptr;
+    CHECK(waited >= 120 && (!in_sim || waited <= 200), "sleep_for");
 
     // std::thread + mutex + condition_variable
     std::mutex mu;

@@ -1,0 +1,75 @@
+/* Guest test program: UDP blast for bandwidth-shaping tests.
+ * sender: udp_blast send <ip> <port> <count> <size>
+ * sink:   udp_blast sink <port> <count>   (prints first/last arrival) */
+#include <arpa/inet.h>
+#include <poll.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+static long long now_ns(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    return (long long)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+int main(int argc, char **argv) {
+    if (argc < 3)
+        return 2;
+    if (strcmp(argv[1], "send") == 0) {
+        if (argc < 6)
+            return 2;
+        int port = atoi(argv[3]), count = atoi(argv[4]), size = atoi(argv[5]);
+        int fd = socket(AF_INET, SOCK_DGRAM, 0);
+        struct sockaddr_in dst;
+        memset(&dst, 0, sizeof(dst));
+        dst.sin_family = AF_INET;
+        dst.sin_port = htons((unsigned short)port);
+        inet_pton(AF_INET, argv[2], &dst.sin_addr);
+        char *buf = calloc(1, (size_t)size);
+        long long t0 = now_ns();
+        for (int i = 0; i < count; i++)
+            sendto(fd, buf, (size_t)size, 0, (struct sockaddr *)&dst, sizeof(dst));
+        printf("sent %d x %dB in %lld ns\n", count, size, now_ns() - t0);
+        close(fd);
+        return 0;
+    }
+    if (strcmp(argv[1], "sink") == 0) {
+        if (argc < 4)
+            return 2;
+        int port = atoi(argv[2]), count = atoi(argv[3]);
+        int fd = socket(AF_INET, SOCK_DGRAM, 0);
+        struct sockaddr_in a;
+        memset(&a, 0, sizeof(a));
+        a.sin_family = AF_INET;
+        a.sin_addr.s_addr = htonl(INADDR_ANY);
+        a.sin_port = htons((unsigned short)port);
+        if (bind(fd, (struct sockaddr *)&a, sizeof(a)) != 0)
+            return 3;
+        char buf[65536];
+        long long first = 0, last = 0;
+        int got = 0;
+        while (got < count) {
+            struct pollfd p = {.fd = fd, .events = POLLIN};
+            int pr = poll(&p, 1, 2000); /* drops may leave us short */
+            if (pr <= 0)
+                break;
+            ssize_t r = recv(fd, buf, sizeof(buf), 0);
+            if (r <= 0)
+                break;
+            got++;
+            last = now_ns();
+            if (!first)
+                first = last;
+        }
+        printf("got %d first %lld last %lld span %lld ns\n", got, first, last,
+               last - first);
+        close(fd);
+        return 0;
+    }
+    return 2;
+}

@@ -1,0 +1,87 @@
+"""Bandwidth shaping for managed processes: token-bucket relays + CoDel
+on real-binary traffic (reference: the three per-host relays
+host.rs:285-296 + router CoDel; the device engine shares the exact
+closed forms via netstack.py's reference mirrors)."""
+
+import pathlib
+import subprocess
+
+import pytest
+
+from shadow_tpu.graph import compute_routing
+from shadow_tpu.hostk.kernel import NetKernel, ProcessSpec
+from shadow_tpu.simtime import NS_PER_SEC
+from tests.topo import two_node_graph
+
+GUESTS = pathlib.Path(__file__).parent / "guests"
+
+
+@pytest.fixture(scope="module")
+def blast_bin(tmp_path_factory):
+    out = tmp_path_factory.mktemp("guests") / "udp_blast"
+    subprocess.run(["cc", "-O2", "-o", str(out), str(GUESTS / "udp_blast.c")], check=True)
+    return str(out)
+
+
+def _run(tmp_path, blast_bin, bw_up=0, bw_down=0, count=50, size=1200, sub="a"):
+    tables = compute_routing(two_node_graph(latency_ms=5)).with_hosts([0, 1])
+    k = NetKernel(
+        tables,
+        host_names=["sink", "sender"],
+        host_nodes=[0, 1],
+        data_dir=tmp_path / sub,
+        bw_up_bits=[0, bw_up],
+        bw_down_bits=[bw_down, 0],
+    )
+    snk = k.add_process(
+        ProcessSpec(host="sink", args=[blast_bin, "sink", "7000", str(count)])
+    )
+    k.add_process(
+        ProcessSpec(
+            host="sender",
+            args=[blast_bin, "send", "11.0.0.1", "7000", str(count), str(size)],
+            start_ns=100_000_000,
+        )
+    )
+    try:
+        k.run(30 * NS_PER_SEC)
+    finally:
+        k.shutdown()
+    return k, snk
+
+
+def _span_ns(snk) -> int:
+    line = snk.stdout().decode().strip()
+    assert line.startswith("got"), line
+    return int(line.split()[-2])
+
+
+def test_unshaped_blast_arrives_at_line_rate(tmp_path, blast_bin):
+    k, snk = _run(tmp_path, blast_bin, sub="open")
+    assert "got 50" in snk.stdout().decode()
+    # no shaping: all datagrams arrive in a tight burst
+    assert _span_ns(snk) < 1_000_000
+
+def test_sender_bandwidth_paces_the_burst(tmp_path, blast_bin):
+    # 1 Mbit/s up: 50 x 1200 B = 480 kbit => ~0.48 s of wire time
+    k, snk = _run(tmp_path, blast_bin, bw_up=1_000_000, sub="up")
+    assert "got 50" in snk.stdout().decode()
+    span = _span_ns(snk)
+    assert 380_000_000 <= span <= 600_000_000, span
+
+
+def test_receiver_bandwidth_paces_the_burst(tmp_path, blast_bin):
+    k, snk = _run(tmp_path, blast_bin, bw_down=1_000_000, sub="down")
+    got = int(snk.stdout().decode().split()[1])
+    # CoDel at the ingress router may shed some of the standing queue
+    assert got >= 30
+    span = _span_ns(snk)
+    # surviving datagrams are paced at ~1 Mbit/s
+    assert span >= 250_000_000, span
+    assert sum(h.codel_dropped for h in k.hosts) + got == 50
+
+
+def test_shaping_deterministic(tmp_path, blast_bin):
+    a = _run(tmp_path, blast_bin, bw_down=1_000_000, sub="r1")[1].stdout()
+    b = _run(tmp_path, blast_bin, bw_down=1_000_000, sub="r2")[1].stdout()
+    assert a == b
