@@ -14,6 +14,12 @@ class ProgressLine:
     def __init__(self, enabled: bool):
         self.enabled = enabled
         self._last = 0.0
+        if enabled:
+            # share stderr with the logger as a single writer: records
+            # drain synchronously so clear() truly precedes them
+            from shadow_tpu.utils import shadow_log
+
+            shadow_log.set_sync(True)
 
     def update(self, now_ns: int, end_ns: int) -> None:
         if not self.enabled:

@@ -1,0 +1,34 @@
+"""Async ShadowLogger (reference: shadow_logger.rs — queue + dedicated
+flush thread + panic flush)."""
+
+import io
+
+from shadow_tpu.utils import shadow_log
+
+
+def test_async_records_flush_in_order():
+    buf = io.StringIO()
+    shadow_log.set_sink(buf)
+    try:
+        for i in range(200):
+            shadow_log.slog("info", i * 1000, "host", f"record-{i}")
+        shadow_log.flush()
+        lines = buf.getvalue().splitlines()
+        assert len(lines) == 200
+        assert [ln.rsplit(" ", 1)[-1] for ln in lines] == [
+            f"record-{i}" for i in range(200)
+        ]
+        assert "[2000-01-01 00:00:00.000000000]" in lines[0]
+    finally:
+        shadow_log.set_sink(None)
+
+
+def test_error_records_flush_immediately():
+    buf = io.StringIO()
+    shadow_log.set_sink(buf)
+    try:
+        shadow_log.slog("error", 0, "host", "boom")
+        # no explicit flush: error level drains synchronously
+        assert "boom" in buf.getvalue()
+    finally:
+        shadow_log.set_sink(None)
