@@ -1203,7 +1203,33 @@ ssize_t recvfrom(int fd, void *buf, size_t n, int flags,
     if (!g_active || !is_vfd(fd))
         return rsyscall(SYS_recvfrom, fd, buf, n, flags, addr, len);
     ShimMsg reply;
-    int64_t fl = ((flags & MSG_DONTWAIT) ? 1 : 0) | ((flags & MSG_PEEK) ? 2 : 0);
+    int64_t fl = ((flags & MSG_DONTWAIT) ? 1 : 0) | ((flags & MSG_PEEK) ? 2 : 0) |
+                 ((flags & MSG_WAITALL) ? 4 : 0);
+    if ((flags & MSG_WAITALL) && !(flags & (MSG_PEEK | MSG_DONTWAIT)) &&
+        n > SHIM_BUF_SIZE) {
+        /* larger than one message: accumulate full-buffer rounds; the
+         * kernel returns short only at EOF/error/signal */
+        size_t got = 0;
+        while (got < n) {
+            size_t want = n - got > SHIM_BUF_SIZE ? SHIM_BUF_SIZE : n - got;
+            int64_t rr = vsys(VSYS_RECVFROM, fd, fl, (int64_t)want, NULL, 0,
+                              &reply);
+            if (rr < 0) {
+                if (got)
+                    return (ssize_t)got;
+                errno = (int)-rr;
+                return -1;
+            }
+            size_t cp = (size_t)rr < want ? (size_t)rr : want;
+            memcpy((char *)buf + got, reply.buf, cp);
+            got += cp;
+            if (cp < want)
+                break; /* EOF or interrupted after partial data */
+        }
+        if (addr && len)
+            parts_to_addr(reply.a[2], reply.a[3], addr, len);
+        return (ssize_t)got;
+    }
     int64_t r = vsys(VSYS_RECVFROM, fd, fl, (int64_t)n, NULL, 0, &reply);
     if (r < 0) {
         errno = (int)-r;

@@ -1939,6 +1939,21 @@ class NetKernel:
                 e, f.error = f.error, 0
             proc._reply(0, a=(0, 0, e))
             return True
+        if level == SOL_SOCKET and opt == 3:  # SO_TYPE
+            stream = isinstance(f, T.TcpSocket) or (
+                isinstance(f, UnixSocket) and f.stype == SOCK_STREAM
+            )
+            proc._reply(0, a=(0, 0, 1 if stream else 2))
+            return True
+        if level == SOL_SOCKET and opt == 30:  # SO_ACCEPTCONN
+            listening = (isinstance(f, T.TcpSocket) and f.state == T.LISTEN) or (
+                isinstance(f, UnixSocket) and f.listening
+            )
+            proc._reply(0, a=(0, 0, int(listening)))
+            return True
+        if level == SOL_SOCKET and opt in (7, 8):  # SO_SNDBUF / SO_RCVBUF
+            proc._reply(0, a=(0, 0, 212992))  # net.core default
+            return True
         proc._reply(0, a=(0, 0, 0))
         return True
 
@@ -2036,11 +2051,19 @@ class NetKernel:
             return True
         fl = int(msg.a[2])
         dontwait, peek = bool(fl & 1), bool(fl & 2)
+        waitall = bool(fl & 4) and not dontwait
         n = min(int(msg.a[3]), I.SHIM_BUF_SIZE)
         if isinstance(f, T.TcpSocket):
             if n == 0:  # stream: returns 0 immediately, consumes nothing
                 proc._reply(0)
                 return True
+            # O_NONBLOCK beats MSG_WAITALL on Linux (plain-recv behavior)
+            if waitall and not f.nonblock:
+                if peek:
+                    return self._tcp_peek_all(proc, f, n)
+                return self._stream_recv_all(
+                    proc, f, n, f.recv, (0, 0, f.remote_ip, f.remote_port)
+                )
             return self._tcp_recv(proc, f, n, dontwait, peek=peek)
         if isinstance(f, UdpSocket):
             # n == 0 on a datagram socket still dequeues (truncate-discard)
@@ -2049,6 +2072,15 @@ class NetKernel:
             if n == 0 and f.stype == SOCK_STREAM:
                 proc._reply(0)
                 return True
+            if (
+                waitall
+                and not peek
+                and f.stype == SOCK_STREAM
+                and not f.nonblock
+            ):
+                return self._stream_recv_all(
+                    proc, f, n, f.stream_recv, (0, 0, 0, 0, 1)
+                )
             return self._unix_recv(proc, f, n, dontwait, include_path=True, peek=peek)
         proc._reply(-ENOTSOCK)
         return True
@@ -2162,6 +2194,68 @@ class NetKernel:
             return True
         if sock.nonblock or dontwait:
             proc._reply(-EAGAIN)
+            return True
+        Waiter(self, proc, [sock], check)
+        return False
+
+    def _stream_recv_all(self, proc, sock, n: int, recv_fn, addr_a) -> bool:
+        """MSG_WAITALL: accumulate until n bytes, EOF, error, or a signal
+        (a partial count is returned if interrupted after some data)."""
+        acc = bytearray()
+
+        def check() -> bool:
+            while len(acc) < n:
+                r = recv_fn(n - len(acc))
+                if isinstance(r, int):
+                    if r == -EAGAIN:
+                        return False
+                    if acc:
+                        # partial data wins; re-arm the error for the next
+                        # call (Linux keeps sk_err pending)
+                        if hasattr(sock, "error"):
+                            sock.error = -r
+                        proc._reply(len(acc), a=addr_a, buf=bytes(acc))
+                    else:
+                        proc._reply(r)
+                    return True
+                if r == b"":  # EOF: return what we have
+                    proc._reply(len(acc), a=addr_a, buf=bytes(acc))
+                    return True
+                acc.extend(r)
+            proc._reply(len(acc), a=addr_a, buf=bytes(acc))
+            return True
+
+        if check():
+            return True
+
+        def on_interrupt():
+            # partial data beats EINTR (Linux MSG_WAITALL semantics)
+            if acc:
+                proc._reply(len(acc), a=addr_a, buf=bytes(acc))
+            else:
+                proc._reply(-EINTR)
+
+        Waiter(self, proc, [sock], check, on_interrupt=on_interrupt)
+        return False
+
+    def _tcp_peek_all(self, proc, sock: T.TcpSocket, n: int) -> bool:
+        """MSG_PEEK|MSG_WAITALL: block until n bytes are buffered (or
+        EOF/error), then peek without consuming (Linux computes the
+        WAITALL target irrespective of PEEK)."""
+
+        def check() -> bool:
+            r = sock.peek(n)
+            if isinstance(r, int):
+                if r == -EAGAIN:
+                    return False
+                proc._reply(r)
+                return True
+            if len(r) < n and not sock._at_eof():
+                return False
+            proc._reply(len(r), a=(0, 0, sock.remote_ip, sock.remote_port), buf=r)
+            return True
+
+        if check():
             return True
         Waiter(self, proc, [sock], check)
         return False

@@ -1,0 +1,73 @@
+/* Guest test program: MSG_WAITALL over simulated TCP loopback. A writer
+ * thread sends 30000 bytes in paced chunks; the reader's single
+ * recv(MSG_WAITALL) must return the full count. */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <pthread.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+#define TOTAL 30000
+
+static void *writer(void *arg) {
+    int fd = *(int *)arg;
+    char chunk[10000];
+    memset(chunk, 'x', sizeof(chunk));
+    for (int i = 0; i < 3; i++) {
+        struct timespec d = {0, 20000000};
+        nanosleep(&d, NULL);
+        ssize_t off = 0;
+        while (off < (ssize_t)sizeof(chunk)) {
+            ssize_t w = send(fd, chunk + off, sizeof(chunk) - off, 0);
+            if (w <= 0)
+                return (void *)1;
+            off += w;
+        }
+    }
+    return NULL;
+}
+
+int main(void) {
+    int srv = socket(AF_INET, SOCK_STREAM, 0);
+    struct sockaddr_in a;
+    memset(&a, 0, sizeof(a));
+    a.sin_family = AF_INET;
+    a.sin_addr.s_addr = htonl(INADDR_ANY);
+    a.sin_port = 0; /* ephemeral: no collisions in the native pairing run */
+    if (bind(srv, (struct sockaddr *)&a, sizeof(a)) || listen(srv, 1))
+        return 2;
+    socklen_t alen = sizeof(a);
+    if (getsockname(srv, (struct sockaddr *)&a, &alen))
+        return 2;
+    int cli = socket(AF_INET, SOCK_STREAM, 0);
+    struct sockaddr_in dst = a;
+    dst.sin_addr.s_addr = htonl(0x7F000001);
+    if (connect(cli, (struct sockaddr *)&dst, sizeof(dst)))
+        return 3;
+    int conn = accept(srv, NULL, NULL);
+    if (conn < 0)
+        return 4;
+
+    pthread_t w;
+    pthread_create(&w, NULL, writer, &cli);
+
+    static char buf[TOTAL + 16];
+    ssize_t r = recv(conn, buf, TOTAL, MSG_WAITALL);
+    pthread_join(w, NULL);
+    if (r != TOTAL) {
+        printf("FAIL waitall got %zd\n", r);
+        return 5;
+    }
+    /* after the writer closes, WAITALL returns the short remainder */
+    close(cli);
+    r = recv(conn, buf, 1000, MSG_WAITALL);
+    if (r != 0) {
+        printf("FAIL waitall-eof got %zd\n", r);
+        return 6;
+    }
+    printf("waitall ok\n");
+    return 0;
+}

@@ -55,3 +55,32 @@ def test_breadth_deterministic(tmp_path, breadth_bin):
     a = _run(tmp_path, breadth_bin, "r1")[1].stdout()
     b = _run(tmp_path, breadth_bin, "r2")[1].stdout()
     assert a == b
+
+
+def test_msg_waitall(tmp_path):
+    import subprocess
+
+    guests = pathlib.Path(__file__).parent / "guests"
+    out = tmp_path / "waitall_guest"
+    subprocess.run(
+        ["cc", "-O2", "-pthread", "-o", str(out), str(guests / "waitall_guest.c")],
+        check=True,
+    )
+    # native pairing
+    r = subprocess.run([str(out)], capture_output=True, text=True, cwd=tmp_path)
+    assert r.returncode == 0 and "waitall ok" in r.stdout, r.stdout + r.stderr
+
+    from shadow_tpu.graph import NetworkGraph
+
+    graph = NetworkGraph.from_gml(
+        'graph [\n  node [ id 0 ]\n  edge [ source 0 target 0 latency "1 ms" ]\n]'
+    )
+    tables = compute_routing(graph).with_hosts([0])
+    k = NetKernel(tables, host_names=["box"], host_nodes=[0], data_dir=tmp_path / "d")
+    p = k.add_process(ProcessSpec(host="box", args=[str(out)]))
+    try:
+        k.run(5 * NS_PER_SEC)
+    finally:
+        k.shutdown()
+    assert p.exit_code == 0, p.stdout() + p.stderr()
+    assert b"waitall ok" in p.stdout()
