@@ -1,0 +1,72 @@
+#!/usr/bin/env python3
+"""Generate a k-ary fat-tree GML topology (the BASELINE iperf-saturation
+ladder rung: iperf-like bulk TCP on a 10k-host fat-tree).
+
+A k-ary fat-tree has (k/2)^2 core switches, k pods of k switches
+(k/2 aggregation + k/2 edge), and (k/2)^2 * k host-facing edge slots;
+hosts attach to edge switches via network_node_id. Usage:
+
+  gen_fattree.py [k] > fattree.gml        # k even, default 8
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def fattree_gml(k: int, core_latency_us=50, agg_latency_us=20, edge_latency_us=10,
+                host_bw_bits=10_000_000_000) -> str:
+    assert k % 2 == 0
+    half = k // 2
+    lines = ["graph [", "  directed 0"]
+    ids = {}
+    next_id = 0
+
+    def node(name, bw=None):
+        nonlocal next_id
+        ids[name] = next_id
+        extra = (
+            f' host_bandwidth_up "{bw} bit" host_bandwidth_down "{bw} bit"'
+            if bw
+            else ""
+        )
+        lines.append(f"  node [ id {ids[name]}{extra} ]")
+        next_id += 1
+
+    def edge(a, b, lat_us):
+        lines.append(
+            f'  edge [ source {ids[a]} target {ids[b]} latency "{lat_us} us" ]'
+        )
+
+    for c in range(half * half):
+        node(f"core{c}")
+    for p in range(k):
+        for a in range(half):
+            node(f"agg{p}.{a}")
+        for e in range(half):
+            # hosts attach here: edge switches carry the host bandwidth
+            node(f"edge{p}.{e}", bw=host_bw_bits)
+    # self-loops so same-node host pairs have a path
+    for p in range(k):
+        for e in range(half):
+            name = f"edge{p}.{e}"
+            lines.append(
+                f'  edge [ source {ids[name]} target {ids[name]} latency "5 us" ]'
+            )
+    # edge <-> agg within a pod (full bipartite)
+    for p in range(k):
+        for e in range(half):
+            for a in range(half):
+                edge(f"edge{p}.{e}", f"agg{p}.{a}", edge_latency_us + agg_latency_us)
+    # agg <-> core: agg a connects to cores [a*half, (a+1)*half)
+    for p in range(k):
+        for a in range(half):
+            for c in range(a * half, (a + 1) * half):
+                edge(f"agg{p}.{a}", f"core{c}", agg_latency_us + core_latency_us)
+    lines.append("]")
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    k = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    print(fattree_gml(k))

@@ -1220,6 +1220,20 @@ ssize_t recvfrom(int fd, void *buf, size_t n, int flags,
                 errno = (int)-rr;
                 return -1;
             }
+            if (reply.a[4] == 1) {
+                /* unix socket reply: buf = [path][payload]; single round
+                 * (dgram semantics; unix-stream WAITALL > one buffer
+                 * returns the first chunk) */
+                size_t plen = (size_t)reply.a[2];
+                size_t cp = (size_t)rr;
+                if (cp > n - got)
+                    cp = n - got;
+                memcpy((char *)buf + got, reply.buf + plen, cp);
+                got += cp;
+                if (addr && len)
+                    unix_addr_fill(addr, len, (int)reply.a[3], reply.buf, plen);
+                return (ssize_t)got;
+            }
             size_t cp = (size_t)rr < want ? (size_t)rr : want;
             memcpy((char *)buf + got, reply.buf, cp);
             got += cp;

@@ -2250,7 +2250,13 @@ class NetKernel:
                     return False
                 proc._reply(r)
                 return True
-            if len(r) < n and not sock._at_eof():
+            # complete the peek when the target is reached OR no more data
+            # can ever arrive (FIN already received and in-sequence)
+            fin_in = (
+                sock.fin_rcvd_seq is not None
+                and sock.rcv_nxt >= sock.fin_rcvd_seq + 1
+            )
+            if len(r) < n and not fin_in:
                 return False
             proc._reply(len(r), a=(0, 0, sock.remote_ip, sock.remote_port), buf=r)
             return True

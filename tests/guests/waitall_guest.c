@@ -10,13 +10,13 @@
 #include <time.h>
 #include <unistd.h>
 
-#define TOTAL 30000
+#define TOTAL 100000 /* > SHIM_BUF_SIZE: exercises the shim's multi-round loop */
 
 static void *writer(void *arg) {
     int fd = *(int *)arg;
     char chunk[10000];
     memset(chunk, 'x', sizeof(chunk));
-    for (int i = 0; i < 3; i++) {
+    for (int i = 0; i < TOTAL / 10000; i++) {
         struct timespec d = {0, 20000000};
         nanosleep(&d, NULL);
         ssize_t off = 0;

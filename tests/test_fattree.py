@@ -1,0 +1,47 @@
+"""Fat-tree topology ladder (BASELINE: iperf-like TCP saturation on a
+fat-tree). Smoke at k=4 on the device engine; the generator scales to the
+10k-host rung by k."""
+
+import subprocess
+import sys
+import pathlib
+
+import jax.numpy as jnp
+
+GEN = pathlib.Path(__file__).parent.parent / "examples" / "fattree" / "gen_fattree.py"
+
+
+def test_fattree_bulk_tcp_smoke():
+    gml = subprocess.run(
+        [sys.executable, str(GEN), "4"], capture_output=True, text=True, check=True
+    ).stdout
+    from shadow_tpu.engine import EngineConfig, init_state
+    from shadow_tpu.engine.round import bootstrap, check_capacity, run_rounds_scan
+    from shadow_tpu.graph import NetworkGraph, compute_routing
+    from shadow_tpu.models.bulk import BulkTcpModel
+    from shadow_tpu.simtime import NS_PER_SEC
+
+    graph = NetworkGraph.from_gml(gml)
+    # k=4: 4 core + 4 pods x (2 agg + 2 edge) = 20 nodes; edges hold hosts
+    assert graph.num_nodes == 20
+    edge_nodes = [i for i in range(graph.num_nodes) if graph.bw_up_bits[i] > 0]
+    assert len(edge_nodes) == 8
+    num_hosts = 32
+    host_node = [edge_nodes[i % len(edge_nodes)] for i in range(num_hosts)]
+    tables = compute_routing(graph).with_hosts(host_node)
+    cfg = EngineConfig(
+        num_hosts=num_hosts,
+        queue_capacity=512,
+        outbox_capacity=128,
+        runahead_ns=graph.min_latency_ns(),
+        seed=7,
+    )
+    model = BulkTcpModel(num_hosts=num_hosts, num_pairs=num_hosts // 2, total_bytes=200_000)
+    st = init_state(cfg, model.init())
+    st = bootstrap(st, model, cfg)
+    st = run_rounds_scan(st, jnp.asarray(NS_PER_SEC, jnp.int64), 400, model, tables, cfg)
+    check_capacity(st)
+    # every server host received the full stream, exactly once
+    delivered = jnp.sum(st.model.tcp.delivered, axis=1)[num_hosts // 2 :]
+    assert int(jnp.sum(delivered == 200_000)) == num_hosts // 2, delivered
+    assert int(st.packets_unroutable.sum()) == 0
