@@ -1174,6 +1174,19 @@ class NetKernel:
             "bytes_sent": sum(h.bytes_sent for h in self.hosts),
             "bytes_recv": sum(h.bytes_recv for h in self.hosts),
             "processes": len(self.procs),
+            # per-host breakdown (the tracker's final sample; reference
+            # tracker.c heartbeats + sim-stats detail)
+            "hosts": {
+                h.name: {
+                    "bytes_sent": h.bytes_sent,
+                    "bytes_recv": h.bytes_recv,
+                    "packets_sent": h.packets_sent,
+                    "packets_dropped": h.packets_dropped,
+                    "codel_dropped": h.codel_dropped,
+                }
+                for h in self.hosts
+                if h.procs
+            },
         }
 
     def shutdown(self) -> None:
