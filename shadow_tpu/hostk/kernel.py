@@ -1185,7 +1185,6 @@ class NetKernel:
                     "codel_dropped": h.codel_dropped,
                 }
                 for h in self.hosts
-                if h.procs
             },
         }
 
