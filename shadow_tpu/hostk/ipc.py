@@ -103,7 +103,7 @@ VSYS_NAMES = {
     VSYS_CONNECT: "connect",
     VSYS_GETSOCKNAME: "getsockname",
     VSYS_YIELD: "yield",
-    VSYS_EXIT: "exit",
+    VSYS_EXIT: "exit_group",  # process exit, as in real strace output
     VSYS_CLOCK_GETTIME: "clock_gettime",
     VSYS_LISTEN: "listen",
     VSYS_ACCEPT: "accept",

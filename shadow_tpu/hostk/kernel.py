@@ -299,6 +299,10 @@ class ManagedProcess:
         # pending-unblocked-signal handoff shim_shmem.rs:252-268)
         self.sig_handlers: dict[int, int] = {}  # sig -> 0 dfl | 1 ign | 2 handler
         self.shutdown_requested = False  # config shutdown_time fired
+        # still running when the simulation ended and shadow killed it; the
+        # final-state check reports this as "running" (reference
+        # process.rs:1215 maps ExitStatus::StoppedByShadow -> Running)
+        self.stopped_by_shadow = False
         self.itimer_fire_ns = 0  # 0 = disarmed
         self.itimer_interval_ns = 0
         self.itimer_gen = 0
@@ -307,7 +311,7 @@ class ManagedProcess:
         # down; the shim interposes at the pthread layer instead)
         self.mutexes: dict[int, "KMutex"] = {}
         self.conds: dict[int, "KCond"] = {}
-        self.exit_evt = File()  # waitpid waiters listen here
+        self.child_evt = File()  # notified whenever any of our children exits
 
     # ---- main-thread conveniences (tests + process-level call sites) ----
 
@@ -341,7 +345,8 @@ class ManagedProcess:
         # ends so peers see EOF/HUP; ports/namespace entries free)
         for fd in self.fdtab.fds():
             self.kernel._close_fd(self, fd)
-        self.exit_evt.notify()  # guest parents blocked in waitpid
+        if self.parent is not None:
+            self.parent.child_evt.notify()  # guest parents blocked in waitpid
 
     def native_dead(self) -> bool:
         """Has the real process died under us? (ChildPidWatcher analogue.)
@@ -415,6 +420,8 @@ class ManagedProcess:
         return pathlib.Path(self._stderr_path).read_bytes() if self._stderr_path else b""
 
     def kill(self) -> None:
+        if not self.exited:
+            self.stopped_by_shadow = True
         self.exited = True
         if self.popen and self.popen.poll() is None:
             self.popen.kill()
@@ -674,7 +681,11 @@ class NetKernel:
             self._terminate_by_signal(proc, sig)
             return
         restart = bool(kind & 0x10)
-        thread = proc.main
+        # the main thread may have pthread_exit'ed while workers run; pick
+        # the first live thread deterministically (lowest tid)
+        thread = next((t for t in proc.threads if t.state != "exited"), None)
+        if thread is None:
+            return
         thread.pending_sigs.append(sig)
         if thread.state == "blocked" and thread.waiter is not None:
             w = thread.waiter
@@ -1065,17 +1076,28 @@ class NetKernel:
     def _sys_waitpid(self, proc, msg):
         vpid, nohang = int(msg.a[1]), bool(int(msg.a[2]))
         parent = proc.process
-        candidates = [
-            c
-            for c in self.procs
-            if c.parent is parent and not c.waited and (vpid == -1 or c.vpid == vpid)
-        ]
-        if not candidates:
+
+        # re-scan per check: a child forked by another guest thread after a
+        # blocking waitpid(-1) begins must still be waitable
+        def matching():
+            return [
+                c
+                for c in self.procs
+                if c.parent is parent and not c.waited and (vpid == -1 or c.vpid == vpid)
+            ]
+
+        if not matching():
             proc._reply(-ECHILD)
             return True
 
         def check() -> bool:
-            for c in candidates:
+            remaining = matching()
+            if not remaining:
+                # another thread reaped the last matching child while we were
+                # blocked; real Linux returns ECHILD, not an eternal block
+                proc._reply(-ECHILD)
+                return True
+            for c in remaining:
                 if c.exited:
                     c.waited = True
                     proc._reply(
@@ -1089,8 +1111,7 @@ class NetKernel:
         if nohang:
             proc._reply(0)
             return True
-        Waiter(self, proc, [c.exit_evt for c in candidates], check,
-               sig_interruptible=False)
+        Waiter(self, proc, [parent.child_evt], check, sig_interruptible=False)
         return False
 
     def _shutdown_proc(self, proc: ManagedProcess) -> None:
@@ -1209,8 +1230,11 @@ class NetKernel:
             if p.shutdown_requested and p.state == "exited":
                 continue  # a requested shutdown is an expected exit
             want = p.spec.expected_final_state
-            got = "exited" if p.state == "exited" else "running"
-            if want != got or (want == "exited" and (p.exit_code or 0) != 0):
+            if p.stopped_by_shadow:
+                got = "running"  # alive at sim end, killed by shadow itself
+            else:
+                got = "exited" if p.state == "exited" else "running"
+            if want != got or (got == "exited" and (p.exit_code or 0) != 0):
                 out.append(
                     f"{p.host.name}/{pathlib.Path(p.spec.args[0]).name}: "
                     f"expected {want}, got {got} (exit_code={p.exit_code})"

@@ -385,6 +385,7 @@ class Manager:
                     "sim_seconds": results.sim_seconds,
                     "scheduler": results.scheduler,
                     "num_hosts": len(results.hosts),
+                    "unexpected_final_states": results.unexpected_final_states,
                     **results.extra_stats,
                 },
                 f,

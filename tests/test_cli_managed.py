@@ -160,6 +160,29 @@ def test_cli_managed_shutdown_while_blocked(tmp_path, guest_bins):
     assert stats["syscall_counts"]["recvfrom"] >= 1
 
 
+def test_cli_expected_running_killed_at_stop(tmp_path, guest_bins):
+    """A process configured with expected_final_state: running that is still
+    alive at stop_time is killed by shadow itself — that is the *expected*
+    outcome and must not fail the run (reference process.rs:1215 maps
+    ExitStatus::StoppedByShadow to ProcessFinalState::Running)."""
+    cfg = tmp_path / "running.yaml"
+    cfg.write_text(
+        """
+general: {{ stop_time: 2 sec, data_directory: {d} }}
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+      - path: {b}
+        args: 7000 9999
+        expected_final_state: running
+""".format(d=tmp_path / "data", b=guest_bins["udp_echo"])
+    )
+    assert run_from_config(str(cfg)) == 0
+    stats = json.loads((tmp_path / "data" / "sim-stats.json").read_text())
+    assert stats["unexpected_final_states"] == []
+
+
 def test_cli_managed_mapping_args_rejected(tmp_path, guest_bins):
     cfg = tmp_path / "maparg.yaml"
     cfg.write_text(
