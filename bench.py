@@ -16,9 +16,16 @@ the TPU round engine over running identical semantics on the host CPU,
 the closest in-repo stand-in for the reference's thread_per_core
 scheduler until the native conformance scheduler lands.
 
+Resilience (round-1 postmortem: the TPU worker crashed mid-run and the
+whole bench died with it, BENCH_r01.json): every measurement now runs in
+a disposable subprocess that emits a progress line after each device
+chunk. The orchestrator walks a retry ladder of smaller configurations
+on crash/hang, and if nothing completes it still reports a rate from
+the furthest partial progress instead of nothing.
+
 Env knobs: SHADOW_TPU_BENCH_HOSTS (default 10240),
 SHADOW_TPU_BENCH_SIMSEC (default 3), SHADOW_TPU_BENCH_CPU_SIMSEC
-(default 0.4), SHADOW_TPU_FORCE_CPU=1 (run the main measurement on the
+(default 0.25), SHADOW_TPU_FORCE_CPU=1 (run the main measurement on the
 CPU backend too).
 """
 
@@ -97,6 +104,9 @@ def _build(num_hosts: int, seed: int = 7):
 
 
 def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
+    """Runs in a disposable child. Emits one {"progress": ...} line per
+    device chunk (so a parent can salvage a rate from a crash) and one
+    final {"backend": ...} result line."""
     import jax
     import numpy as np
 
@@ -107,8 +117,27 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
     # warm-up/compile on a short horizon, then measure a fresh full run
     run_until(st0, 10_000_000, model, tables, cfg, rounds_per_chunk=rounds_per_chunk)
     t0 = time.perf_counter()
+
+    def on_chunk(st):
+        print(
+            json.dumps(
+                {
+                    "progress": int(np.asarray(st.now)),
+                    "wall": round(time.perf_counter() - t0, 3),
+                }
+            ),
+            flush=True,
+        )
+
     st = run_until(
-        st0, end, model, tables, cfg, rounds_per_chunk=rounds_per_chunk, max_chunks=1_000_000
+        st0,
+        end,
+        model,
+        tables,
+        cfg,
+        rounds_per_chunk=rounds_per_chunk,
+        max_chunks=1_000_000,
+        on_chunk=on_chunk,
     )
     jax.block_until_ready(st.events_handled)
     wall = time.perf_counter() - t0
@@ -122,69 +151,191 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
     }
 
 
+def _child_env(**extra) -> dict:
+    env = dict(os.environ)
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _cpu_env(**extra) -> dict:
+    env = _child_env(**extra)
+    env.update(PYTHONPATH="", JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
+def _run_attempt(env: dict, timeout_s: float) -> dict:
+    """Run one measurement subprocess; returns
+    {ok, result?, partial?, error?} where partial carries the furthest
+    progress line seen before a crash/timeout."""
+    t0 = time.perf_counter()
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+        out_lines = r.stdout.strip().splitlines()
+        err_tail = r.stderr[-800:]
+        timed_out = False
+    except subprocess.TimeoutExpired as e:
+        # TimeoutExpired carries bytes even under text=True
+        def _s(v):
+            return v.decode(errors="replace") if isinstance(v, bytes) else (v or "")
+
+        out_lines = _s(e.stdout).strip().splitlines()
+        err_tail = f"timeout after {timeout_s}s; stderr: {_s(e.stderr)[-500:]}"
+        timed_out = True
+
+    result, last_progress = None, None
+    for ln in out_lines:
+        try:
+            obj = json.loads(ln)
+        except ValueError:
+            continue
+        if "progress" in obj:
+            last_progress = obj
+        elif "backend" in obj:
+            result = obj
+    if result is not None:
+        return {"ok": True, "result": result}
+    out = {
+        "ok": False,
+        "error": err_tail if timed_out else f"rc={getattr(r, 'returncode', '?')}: {err_tail}",
+        "wall_s": round(time.perf_counter() - t0, 1),
+    }
+    if last_progress is not None and last_progress.get("wall", 0) > 0:
+        out["partial"] = {
+            "sim_s_reached": last_progress["progress"] / NS_PER_SEC,
+            "wall_s": last_progress["wall"],
+            "rate": last_progress["progress"] / NS_PER_SEC / last_progress["wall"],
+        }
+    return out
+
+
 def main():
     role = os.environ.get("SHADOW_TPU_BENCH_ROLE", "main")
     num_hosts = int(os.environ.get("SHADOW_TPU_BENCH_HOSTS", 10240))
     sim_sec = float(os.environ.get("SHADOW_TPU_BENCH_SIMSEC", 3))
-    cpu_sim_sec = float(os.environ.get("SHADOW_TPU_BENCH_CPU_SIMSEC", 0.4))
+    cpu_sim_sec = float(os.environ.get("SHADOW_TPU_BENCH_CPU_SIMSEC", 0.25))
+    rpc = int(os.environ.get("SHADOW_TPU_BENCH_RPC", 64))
 
-    if role == "cpu_probe":
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-        print(json.dumps(_measure(num_hosts, cpu_sim_sec)))
+    if role == "measure":
+        print(json.dumps(_measure(num_hosts, sim_sec, rounds_per_chunk=rpc)))
         return
 
-    if os.environ.get("SHADOW_TPU_BENCH_REEXEC") != "1":
-        force_cpu = os.environ.get("SHADOW_TPU_FORCE_CPU") == "1"
-        if force_cpu or not _device_probe_ok():
-            env = dict(os.environ)
-            env.update(SHADOW_TPU_BENCH_REEXEC="1", PYTHONPATH="", JAX_PLATFORMS="cpu")
-            env.pop("PALLAS_AXON_POOL_IPS", None)
-            os.execve(sys.executable, [sys.executable] + sys.argv, env)
-        os.environ["SHADOW_TPU_BENCH_REEXEC"] = "1"
+    # ---- orchestrator -------------------------------------------------
+    force_cpu = os.environ.get("SHADOW_TPU_FORCE_CPU") == "1"
+    tpu_up = not force_cpu and _device_probe_ok()
 
-    main_res = _measure(num_hosts, sim_sec)
+    # Retry ladder: same size with shorter device calls first (the likely
+    # failure is the tunnel's dislike of long-running device executions),
+    # then progressively smaller worlds. (hosts, sim_sec, rounds_per_chunk)
+    ladder = [
+        (num_hosts, sim_sec, rpc),
+        (num_hosts, sim_sec, 16),
+        (num_hosts // 2, sim_sec, 32),
+        (num_hosts // 4, sim_sec, 32),
+        (num_hosts // 8, sim_sec, 64),
+    ]
+    seen, attempts_cfg = set(), []
+    for cfgt in ladder:
+        if cfgt[0] >= min(64, num_hosts) and cfgt not in seen:
+            seen.add(cfgt)
+            attempts_cfg.append(cfgt)
 
-    # CPU-backend baseline in a subprocess (same semantics, short horizon)
+    attempts_log, main_res, used = [], None, None
+    best_partial = None
+    for i, (h, s, r) in enumerate(attempts_cfg):
+        env_extra = dict(
+            SHADOW_TPU_BENCH_ROLE="measure",
+            SHADOW_TPU_BENCH_HOSTS=h,
+            SHADOW_TPU_BENCH_SIMSEC=s,
+            SHADOW_TPU_BENCH_RPC=r,
+        )
+        env = _child_env(**env_extra) if tpu_up else _cpu_env(**env_extra)
+        att = _run_attempt(env, timeout_s=1200 if i == 0 else 700)
+        att["config"] = {"hosts": h, "sim_sec": s, "rounds_per_chunk": r}
+        attempts_log.append(att)
+        if att["ok"]:
+            main_res, used = att["result"], (h, s, r)
+            break
+        # "best" partial = the one that simulated furthest (not the highest
+        # rate — smaller fallback worlds run faster and would win unfairly)
+        if "partial" in att and (
+            best_partial is None
+            or att["partial"]["sim_s_reached"] > best_partial[0]["partial"]["sim_s_reached"]
+        ):
+            best_partial = (att, (h, s, r))
+        if not tpu_up:
+            break  # CPU fallback crashing is not tunnel flakiness; stop
+
+    if main_res is None and best_partial is not None:
+        att, used = best_partial
+        main_res = {
+            "backend": "tpu" if tpu_up else "cpu",
+            "rate": att["partial"]["rate"],
+            "wall_s": att["partial"]["wall_s"],
+            "partial": True,
+            "sim_s_reached": att["partial"]["sim_s_reached"],
+        }
+    if main_res is None:
+        print(
+            json.dumps(
+                {
+                    "metric": f"tgen_{num_hosts}h_sim_sec_per_wall_sec",
+                    "value": None,
+                    "unit": "sim_s/wall_s",
+                    "vs_baseline": None,
+                    "detail": {"error": "all attempts failed", "attempts": attempts_log},
+                }
+            )
+        )
+        return
+
+    # ---- CPU-backend baseline (same semantics, same world size, short
+    # horizon) in a subprocess --------------------------------------------
+    bh = used[0]
     if main_res["backend"] == "cpu":
         base_rate = main_res["rate"]
         base = {"note": "main run already on cpu backend; ratio=1"}
     else:
-        env = dict(os.environ)
-        env.update(
-            SHADOW_TPU_BENCH_ROLE="cpu_probe",
-            SHADOW_TPU_BENCH_REEXEC="1",
-            PYTHONPATH="",
-            JAX_PLATFORMS="cpu",
+        att = _run_attempt(
+            _cpu_env(
+                SHADOW_TPU_BENCH_ROLE="measure",
+                SHADOW_TPU_BENCH_HOSTS=bh,
+                SHADOW_TPU_BENCH_SIMSEC=cpu_sim_sec,
+                SHADOW_TPU_BENCH_RPC=64,
+            ),
+            timeout_s=1500,
         )
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        try:
-            r = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                env=env,
-                capture_output=True,
-                text=True,
-                timeout=3600,
-            )
-            base = json.loads(r.stdout.strip().splitlines()[-1])
+        if att["ok"]:
+            base = att["result"]
             base_rate = base["rate"]
-        except Exception as e:
-            err = getattr(e, "stderr", None) or str(e)
-            base, base_rate = {"error": str(err)[-500:]}, None
+        elif "partial" in att:
+            base = att
+            base_rate = att["partial"]["rate"]
+        else:
+            base, base_rate = {"error": att.get("error", "?")[:500]}, None
 
     rate = main_res["rate"]
     print(
         json.dumps(
             {
-                "metric": f"tgen_{num_hosts}h_sim_sec_per_wall_sec",
+                "metric": f"tgen_{used[0]}h_sim_sec_per_wall_sec",
                 "value": round(rate, 4),
                 "unit": "sim_s/wall_s",
                 "vs_baseline": round(rate / base_rate, 2) if base_rate else None,
                 "detail": {
                     "workload": "tgen 100KB req/resp streams, TCP+netstack, 32-node lossy graph",
+                    "config": {"hosts": used[0], "sim_sec": used[1], "rounds_per_chunk": used[2]},
                     "main": main_res,
                     "cpu_baseline": base,
+                    "attempts": [
+                        {k: v for k, v in a.items() if k != "result"} for a in attempts_log
+                    ],
                 },
             }
         )
