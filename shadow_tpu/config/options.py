@@ -101,11 +101,15 @@ class NetworkOptions:
 
 @dataclasses.dataclass
 class ExperimentalOptions:
-    scheduler: str = "tpu"  # "tpu" | "cpu-ref"
+    # "tpu": device engine for scripted models; hybrid (CPU guests, device
+    # network plane) for managed executables. "managed": serial CPU kernel
+    # for managed executables. "cpu-ref": the pure-Python conformance oracle.
+    scheduler: str = "tpu"
     runahead_ns: Optional[int] = None  # None = min graph latency
     use_dynamic_runahead: bool = False
     queue_capacity: int = 64
     outbox_capacity: int = 16
+    record_capacity: int = 128  # hybrid per-host outcome-record ring
     rounds_per_chunk: int = 256
     max_iters_per_round: int = 1_000_000
     # managed-process options (reference: configuration.rs:298-455)
@@ -133,6 +137,7 @@ class ExperimentalOptions:
             "use_dynamic_runahead",
             "queue_capacity",
             "outbox_capacity",
+            "record_capacity",
             "rounds_per_chunk",
             "max_iters_per_round",
             "strace_logging_mode",
@@ -147,8 +152,11 @@ class ExperimentalOptions:
                 f"unknown strace_logging_mode {out.strace_logging_mode!r} "
                 "(expected 'off', 'standard', or 'deterministic')"
             )
-        if out.scheduler not in ("tpu", "cpu-ref"):
-            raise ValueError(f"unknown scheduler {out.scheduler!r} (expected 'tpu' or 'cpu-ref')")
+        if out.scheduler not in ("tpu", "cpu-ref", "managed"):
+            raise ValueError(
+                f"unknown scheduler {out.scheduler!r} "
+                "(expected 'tpu', 'cpu-ref', or 'managed')"
+            )
         _reject_unknown("experimental", d)
         return out
 

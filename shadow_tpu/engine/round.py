@@ -61,9 +61,13 @@ class Draw:
 
 
 def _lane_seqs(valid: jax.Array, base: jax.Array):
-    """Per-lane sequence numbers: base + (# valid lanes before this one)."""
+    """Per-lane sequence numbers: base + (# valid lanes before this one).
+    Kept in uint32 explicitly (jnp.sum/cumsum promote unsigned ints under
+    x64, which would flip the carry dtype between rounds)."""
     ranks = jnp.cumsum(valid.astype(jnp.uint32), axis=1) - valid.astype(jnp.uint32)
-    return base[:, None] + ranks, base + jnp.sum(valid.astype(jnp.uint32), axis=1)
+    lane = (base[:, None] + ranks).astype(jnp.uint32)
+    nxt = (base + jnp.sum(valid.astype(jnp.uint32), axis=1)).astype(jnp.uint32)
+    return lane, nxt
 
 
 def bootstrap(st: SimState, model, cfg: EngineConfig) -> SimState:
@@ -146,6 +150,8 @@ def handle_one_iteration(
         net = net.replace(
             rx_backlog_bytes=net.rx_backlog_bytes + jnp.where(defer, size_in, 0)
         )
+        if hasattr(model, "on_codel_drop"):
+            st = st.replace(model=model.on_codel_drop(st.model, ev, codel_drop))
         ev = ev.replace(valid=ev.valid & ~(defer | codel_drop))
         net = net.replace(
             bytes_recv=net.bytes_recv
@@ -167,9 +173,23 @@ def handle_one_iteration(
     rel = tables.rel[src_node[:, None], dst_node]  # [H, EP] f32
 
     unroutable = pvalid & (lat >= TIME_MAX)
-    loss_u = jnp.stack(
-        [draw.uniform(model.DRAWS_PER_EVENT + p) for p in range(ep)], axis=1
-    )  # [H, EP]; one loss draw per packet lane, drawn in lane order
+    loss_lane = getattr(model, "LOSS_COUNTER_LANE", None)
+    if loss_lane is None:
+        loss_u = jnp.stack(
+            [draw.uniform(model.DRAWS_PER_EVENT + p) for p in range(ep)], axis=1
+        )  # [H, EP]; one loss draw per packet lane, drawn in lane order
+    else:
+        # hybrid managed traffic: the loss counter was allocated from the
+        # host's stream at send time on the CPU and rides the payload, so
+        # the uniform is bit-identical to the serial kernel's _loss_draw
+        # no matter when the event pops here
+        loss_u = jnp.stack(
+            [
+                rng.uniform_f32(st.rng_key, pemits.data[:, p, loss_lane].astype(jnp.uint32))
+                for p in range(ep)
+            ],
+            axis=1,
+        )
     kept = pvalid & ~unroutable & (loss_u < rel)
     dropped = pvalid & ~unroutable & ~(loss_u < rel)
 
@@ -199,6 +219,11 @@ def handle_one_iteration(
         deliver = jnp.maximum(dep + lat, window_end)  # [H, EP]
     else:
         deliver = jnp.maximum(ev.time[:, None] + lat, window_end)  # [H, EP]
+
+    if hasattr(model, "on_packet_outcomes"):
+        mstate = model.on_packet_outcomes(
+            mstate, ev, pemits, kept, dropped, unroutable, deliver, dst_clamped
+        )
 
     # --- sequence numbers: local lanes first, then surviving packets ---
     lseq, seq_after_locals = _lane_seqs(lvalid, st.seq)
@@ -259,7 +284,8 @@ def handle_one_iteration(
         used = jnp.where(kept & cross & (lat < TIME_MAX), lat, TIME_MAX)
         min_used = jnp.minimum(min_used, jnp.min(used))
 
-    stride = jnp.uint32(model.DRAWS_PER_EVENT + ep)
+    # carried-counter models consume no live draws for packet loss
+    stride = jnp.uint32(model.DRAWS_PER_EVENT + (0 if loss_lane is not None else ep))
     return st.replace(
         queue=queue,
         min_used_lat=min_used,

@@ -487,6 +487,9 @@ class HostKernel:
         self.unix_ns: "dict[tuple[bool, str], UnixSocket]" = {}
         self.next_port = EPHEMERAL_PORT_BASE
         self.rng_counter = 0
+        # per-host send counter: the seq half of the packet total-order key
+        # (time, Packet<Local, src_host, seq), reference event.rs:104-155
+        self.send_seq = 0
         self.procs: list[ManagedProcess] = []
         self.packets_sent = 0
         self.packets_dropped = 0
@@ -547,6 +550,7 @@ class NetKernel:
         bw_up_bits: "Optional[list[int]]" = None,
         bw_down_bits: "Optional[list[int]]" = None,
         bootstrap_end_ns: int = 0,
+        window_ns: "Optional[int]" = None,
     ):
         self.tables = tables
         self.lat = np.asarray(tables.lat_ns)
@@ -589,7 +593,26 @@ class NetKernel:
         self.now = 0
         self._seq = 0
         self._next_tid = 20_000  # thread ids, disjoint from vpids
-        self.events: list[tuple[int, int, Callable[[], None]]] = []
+        # heap entries are (time, variant, a, b, fn) where packets carry
+        # variant 0 with (a, b) = (src_host, src_seq) and local events carry
+        # variant 1 with (a, b) = (global_seq, 0) — the same total order the
+        # device engine packs into its tie key (events.py; reference
+        # event.rs:104-155, Packet sorts before Local at equal times)
+        self.events: list[tuple[int, int, int, int, Callable[[], None]]] = []
+        # conservative-window delivery clamp (reference worker.rs:399-402):
+        # when set, non-loopback deliveries are clamped to the end of the
+        # round window containing the send. The hybrid scheduler requires
+        # this (the device engine clamps identically); None = continuous
+        # timeline (no rounds), the legacy serial behavior.
+        self.window_ns = window_ns
+        # hybrid mode (runtime/hybrid.py): sends are buffered for the device
+        # engine instead of being simulated locally
+        self.hybrid = False
+        self.pending_sends: "list[tuple]" = []
+        self.payloads: "dict[tuple[int, int], tuple]" = {}
+        # the true horizon for the progress line when run_window is driven
+        # per round window (the per-window end would pin the bar at ~100%)
+        self._progress_total: "Optional[int]" = None
         self.procs: list[ManagedProcess] = []
         self.event_log: list[tuple[int, str]] = []
         self.heartbeat_ns = heartbeat_ns
@@ -1128,35 +1151,71 @@ class NetKernel:
     # --- event machinery --------------------------------------------------
 
     def _push(self, t: int, fn: Callable[[], None]) -> None:
-        heapq.heappush(self.events, (t, self._seq, fn))
+        heapq.heappush(self.events, (t, 1, self._seq, 0, fn))
         self._seq += 1
 
+    def _push_packet(self, t: int, src_host: int, src_seq: int, fn: Callable[[], None]) -> None:
+        """Network-plane event carrying the packet total-order key."""
+        heapq.heappush(self.events, (t, 0, src_host, src_seq, fn))
+
+    def _grid_end(self, t: int) -> int:
+        """End of the round window containing time t (windows are fixed
+        multiples of window_ns, half-open [k*W, (k+1)*W); the engine pops
+        events strictly below the window end the same way)."""
+        return (t // self.window_ns + 1) * self.window_ns
+
     def run(self, until_ns: int) -> None:
-        hb = self.heartbeat_ns
         try:
-            while self.events:
-                if self.progress.enabled:
-                    self.progress.update(self.now, until_ns)
-                t = self.events[0][0]
-                if self._next_hb is not None and self._next_hb <= until_ns and self._next_hb < t:
-                    self.now = max(self.now, self._next_hb)
-                    self._heartbeat()
-                    self._next_hb += hb
-                    continue
-                if t > until_ns:
-                    break
-                _, _, fn = heapq.heappop(self.events)
-                self.now = max(self.now, t)
-                fn()
-            # sim time runs to until_ns even after the queue drains; keep
-            # the heartbeat cadence to the end (manager.rs:738-780)
-            while self._next_hb is not None and self._next_hb <= until_ns:
+            self.run_window(until_ns, inclusive=True)
+            self.finish(until_ns)
+        finally:
+            self.shutdown_check()
+
+    def finish(self, until_ns: int) -> None:
+        """Sim time runs to until_ns even after the queue drains; keep the
+        heartbeat cadence to the end (manager.rs:738-780)."""
+        hb = self.heartbeat_ns
+        while self._next_hb is not None and self._next_hb <= until_ns:
+            self.now = max(self.now, self._next_hb)
+            self._heartbeat()
+            self._next_hb += hb
+        self.progress.finish(until_ns)
+
+    def run_window(
+        self, end_ns: int, inclusive: bool = False, stop_at_send_grid: bool = False
+    ) -> None:
+        """Drain events with t < end_ns (or <= when inclusive), advancing
+        heartbeats on cadence. The hybrid driver calls this per round
+        window; run() calls it once for the whole horizon.
+
+        stop_at_send_grid (hybrid free-run): once a send has been buffered,
+        tighten the horizon to the end of that send's round window — the
+        device engine must process the send before the CPU may cross that
+        boundary (its arrivals land at or after it)."""
+        hb = self.heartbeat_ns
+        total = self._progress_total if self._progress_total is not None else end_ns
+        while self.events:
+            if stop_at_send_grid and self.pending_sends:
+                lim = self._grid_end(self.pending_sends[0][0])
+                if lim < end_ns or (inclusive and lim <= end_ns):
+                    end_ns, inclusive = lim, False
+                stop_at_send_grid = False
+            if self.progress.enabled:
+                self.progress.update(self.now, total)
+            t = self.events[0][0]
+            hb_due = self._next_hb is not None and (
+                self._next_hb <= end_ns if inclusive else self._next_hb < end_ns
+            )
+            if hb_due and self._next_hb < t:
                 self.now = max(self.now, self._next_hb)
                 self._heartbeat()
                 self._next_hb += hb
-            self.progress.finish(until_ns)
-        finally:
-            self.shutdown_check()
+                continue
+            if (t > end_ns) if inclusive else (t >= end_ns):
+                break
+            fn = heapq.heappop(self.events)[4]
+            self.now = max(self.now, t)
+            fn()
 
     def _heartbeat(self) -> None:
         """Manager heartbeat + per-host tracker lines (reference:
@@ -2447,7 +2506,15 @@ class NetKernel:
             return t
         return src.tx_tb.depart(t, size)
 
-    def _arrive(self, dst: HostKernel, size: int, loopback: bool, deliver_fn) -> None:
+    def _arrive(
+        self,
+        dst: HostKernel,
+        size: int,
+        loopback: bool,
+        deliver_fn,
+        src_host: int = 0,
+        src_seq: int = 0,
+    ) -> None:
         """Down-bw relay + CoDel at the destination's upstream router
         (relay inet-in + router/codel, mirroring netstack.py's ingress)."""
         if loopback or dst.rx_tb is None or self.now < self.bootstrap_end_ns:
@@ -2467,9 +2534,18 @@ class NetKernel:
                 dst.rx_backlog_bytes -= size
                 deliver_fn()
 
-            self._push(ready, later)
+            # the deferred dequeue keeps the packet's total-order key, like
+            # the engine's shaped re-enqueue (round.py push_self with ev.tie)
+            self._push_packet(ready, src_host, src_seq, later)
         else:
             deliver_fn()
+
+    def _clamp(self, arr_t: int, send_t: int) -> int:
+        """Conservative-window delivery clamp (worker.rs:399-402): the
+        delivery may not land inside the send's own round window."""
+        if self.window_ns is None:
+            return arr_t
+        return max(arr_t, self._grid_end(send_t))
 
     def _send_packet(
         self, src: HostKernel, t: int, dst_ip: int, dst_port: int,
@@ -2477,27 +2553,63 @@ class NetKernel:
     ) -> None:
         dst = self.host_by_ip.get(dst_ip)
         loopback = dst is src
-        u = self._loss_draw(src)  # drawn even for unroutable, like the engine
+        if self.hybrid and not loopback:
+            # the loss uniform is evaluated on device from this counter;
+            # the stream position advances exactly as _loss_draw would
+            ctr = src.rng_counter
+            src.rng_counter += 1
+            u = None
+        else:
+            u = self._loss_draw(src)  # drawn even for unroutable, like the engine
         if dst is None:
             return  # no such host: UDP silently drops
         lat, relv = self._path(src, dst)
         if lat >= TIME_MAX:
             return  # unroutable packets never charge the tx relay
-        dep = t if loopback else self._egress_depart(src, t, len(data))
-        if not loopback and not (u < relv):
+        size = len(data)
+        seq = src.send_seq
+        src.send_seq += 1
+        if loopback:
+            src.packets_sent += 1
+            src.bytes_sent += size
+            if self.pcap:
+                self.pcap.udp(src.name, t, src_ip, src_port, dst_ip, dst_port, data)
+            self._push_packet(
+                t + lat,
+                src.host_id,
+                seq,
+                lambda: self._arrive(
+                    dst, size, True,
+                    lambda: self._deliver(dst, dst_port, data, src_ip, src_port),
+                    src.host_id, seq,
+                ),
+            )
+            return
+        if self.hybrid:
+            self.payloads[(src.host_id, seq)] = (
+                "udp", t, dst.host_id, dst_port, data, src_ip, src_port,
+            )
+            src.packets_sent += 1  # tentative; reverted by a loss record
+            src.bytes_sent += size
+            self.pending_sends.append((t, src.host_id, seq, ctr, dst.host_id, size))
+            return
+        dep = self._egress_depart(src, t, size)
+        if not (u < relv):
             src.packets_dropped += 1
             self.event_log.append((t, f"drop {src.name}->{dst.name}:{dst_port}"))
             return
         src.packets_sent += 1
-        src.bytes_sent += len(data)
+        src.bytes_sent += size
         if self.pcap:
             self.pcap.udp(src.name, t, src_ip, src_port, dst_ip, dst_port, data)
-        size = len(data)
-        self._push(
-            dep + lat,
+        self._push_packet(
+            self._clamp(dep + lat, t),
+            src.host_id,
+            seq,
             lambda: self._arrive(
-                dst, size, loopback,
+                dst, size, False,
                 lambda: self._deliver(dst, dst_port, data, src_ip, src_port),
+                src.host_id, seq,
             ),
         )
 
@@ -2522,27 +2634,60 @@ class NetKernel:
         TCP-tier Worker::send_packet)."""
         dst = self.host_by_ip.get(seg.dst_ip)
         loopback = dst is src
-        u = self._loss_draw(src)
+        if self.hybrid and not loopback:
+            ctr = src.rng_counter
+            src.rng_counter += 1
+            u = None
+        else:
+            u = self._loss_draw(src)
         if dst is None:
             return
         lat, relv = self._path(src, dst)
         if lat >= TIME_MAX:
             return  # unroutable packets never charge the tx relay
-        dep = self.now if loopback else self._egress_depart(src, self.now, seg.wire_len())
-        if not loopback and not (u < relv):
+        t = self.now
+        size = seg.wire_len()
+        seq = src.send_seq
+        src.send_seq += 1
+        if loopback:
+            src.packets_sent += 1
+            src.bytes_sent += size
+            if self.pcap:
+                self.pcap.tcp(src.name, t, seg)
+            self._push_packet(
+                t + lat,
+                src.host_id,
+                seq,
+                lambda: self._arrive(
+                    dst, size, True, lambda: self._deliver_segment(dst, seg),
+                    src.host_id, seq,
+                ),
+            )
+            return
+        if self.hybrid:
+            self.payloads[(src.host_id, seq)] = ("tcp", t, dst.host_id, seg)
+            src.packets_sent += 1  # tentative; reverted by a loss record
+            src.bytes_sent += size
+            self.pending_sends.append((t, src.host_id, seq, ctr, dst.host_id, size))
+            return
+        dep = self._egress_depart(src, t, size)
+        if not (u < relv):
             src.packets_dropped += 1
             self.event_log.append(
-                (self.now, f"drop-tcp {src.name}->{dst.name} {seg.flag_str()} seq={seg.seq}")
+                (t, f"drop-tcp {src.name}->{dst.name} {seg.flag_str()} seq={seg.seq}")
             )
             return
         src.packets_sent += 1
-        src.bytes_sent += seg.wire_len()
+        src.bytes_sent += size
         if self.pcap:
-            self.pcap.tcp(src.name, self.now, seg)
-        self._push(
-            dep + lat,
+            self.pcap.tcp(src.name, t, seg)
+        self._push_packet(
+            self._clamp(dep + lat, t),
+            src.host_id,
+            seq,
             lambda: self._arrive(
-                dst, seg.wire_len(), loopback, lambda: self._deliver_segment(dst, seg)
+                dst, size, False, lambda: self._deliver_segment(dst, seg),
+                src.host_id, seq,
             ),
         )
 
@@ -2579,6 +2724,75 @@ class NetKernel:
                 wnd=0,
             )
             self.send_segment(dst, rst)
+
+    # --- hybrid coupling API (runtime/hybrid.py) --------------------------
+
+    def hybrid_take_sends(self) -> "list[tuple]":
+        """Drain the buffered sends: (t, src_host, seq, loss_ctr, dst_host,
+        size) tuples in emission order."""
+        out = self.pending_sends
+        self.pending_sends = []
+        return out
+
+    def hybrid_apply_record(
+        self, flag: int, t: int, src_host: int, seq: int, horizon_ns: "Optional[int]" = None
+    ) -> None:
+        """Apply one device-engine outcome record for send (src_host, seq):
+        the packet was delivered at t (push the socket delivery event),
+        lost to path loss at send time, or dropped by the ingress AQM at t.
+        Log lines and counters mirror the serial transport path exactly —
+        including the horizon: an AQM drop timed past horizon_ns is an
+        arrival event the serial kernel would never pop, so it must not be
+        counted (deliveries past the horizon equivalently land in the heap
+        and never fire)."""
+        from shadow_tpu.models.managed_net import REC_CODEL_DROP, REC_LOSS_DROP
+
+        pl = self.payloads.pop((src_host, seq))
+        src = self.hosts[src_host]
+        past_horizon = horizon_ns is not None and t > horizon_ns
+        if pl[0] == "udp":
+            _, t_send, dst_id, dst_port, data, src_ip, src_port = pl
+            dst = self.hosts[dst_id]
+            size = len(data)
+            if flag == REC_LOSS_DROP:
+                src.packets_sent -= 1
+                src.bytes_sent -= size
+                src.packets_dropped += 1
+                self.event_log.append((t_send, f"drop {src.name}->{dst.name}:{dst_port}"))
+                return
+            if self.pcap:
+                self.pcap.udp(src.name, t_send, src_ip, src_port, dst.ip, dst_port, data)
+            if flag == REC_CODEL_DROP:
+                if not past_horizon:
+                    dst.codel_dropped += 1
+                    self.event_log.append((t, f"codel-drop {dst.name} {size}B"))
+                return
+            self._push_packet(
+                t, src_host, seq,
+                lambda: self._deliver(dst, dst_port, data, src_ip, src_port),
+            )
+        else:
+            _, t_send, dst_id, seg = pl
+            dst = self.hosts[dst_id]
+            size = seg.wire_len()
+            if flag == REC_LOSS_DROP:
+                src.packets_sent -= 1
+                src.bytes_sent -= size
+                src.packets_dropped += 1
+                self.event_log.append(
+                    (t_send, f"drop-tcp {src.name}->{dst.name} {seg.flag_str()} seq={seg.seq}")
+                )
+                return
+            if self.pcap:
+                self.pcap.tcp(src.name, t_send, seg)
+            if flag == REC_CODEL_DROP:
+                if not past_horizon:
+                    dst.codel_dropped += 1
+                    self.event_log.append((t, f"codel-drop {dst.name} {size}B"))
+                return
+            self._push_packet(
+                t, src_host, seq, lambda: self._deliver_segment(dst, seg)
+            )
 
 
 _DISPATCH = {

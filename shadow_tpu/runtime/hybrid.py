@@ -1,0 +1,242 @@
+"""The hybrid scheduler: managed processes on the CPU kernel, their
+packets on the device engine.
+
+This is the coupling the whole design aims at (reference: the one round
+loop that serves real processes, src/main/core/manager.rs:392-478): the
+serial host kernel executes guests window by window; every non-loopback
+packet they emit is staged into the device engine, which applies egress
+token-bucket shaping, the path loss draw, routing latency, and ingress
+token-bucket + CoDel — the identical closed forms the scripted models use
+— and reports each packet's outcome (delivery time / loss / AQM drop)
+back through per-host record buffers drained at round boundaries.
+
+Lockstep per grid boundary E (windows are fixed multiples of the runahead,
+the engine's conservative window; worker.rs:399-402 clamp semantics):
+
+  pass A   device drains arrival events < E (ingress shaping, records)
+  drain    records -> CPU: socket delivery events, drop logs, counters
+  CPU      executes guests in [E-W, E), buffering sends
+  upload   buffered sends -> device queues (as KIND_MSEND events)
+  pass B   device drains the new sends < E (egress + loss + latency,
+           deliveries clamped to >= E), arrivals land in device queues
+
+When nothing is in flight the CPU free-runs (no device calls, windows
+skipped) until a send appears — outcomes are unchanged because the clamp
+grid is fixed, not adaptive.
+
+Determinism: the loss uniform for send (src, seq) is threefry(src_key,
+counter) with the counter allocated from the src host's stream at send
+time on the CPU — bit-identical to the serial kernel's _loss_draw — and
+all bucket/AQM math is the same int64 closed forms on both sides, so a
+hybrid run and a serial run with the same window grid produce identical
+transfers, delivery times, and logs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu import equeue
+from shadow_tpu.engine import EngineConfig
+from shadow_tpu.engine.round import CapacityError, run_round
+from shadow_tpu.engine.state import init_state
+from shadow_tpu.events import pack_tie
+from shadow_tpu.graph.routing import RoutingTables
+from shadow_tpu.models.managed_net import (
+    KIND_MSEND,
+    LANE_CTR,
+    LANE_DST,
+    LANE_SEQ,
+    LANE_SIZE,
+    LANE_SRC,
+    ManagedNetModel,
+)
+
+
+class _SortingPcap:
+    """Hybrid-mode pcap shim: frames become known out of chronological
+    order (send-side frames only once the device reports the packet's
+    outcome), so buffer and flush time-sorted per host at close."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self._buf: "list[tuple]" = []
+
+    def udp(self, host, t, *args):
+        self._buf.append((host, t, len(self._buf), "udp", args))
+
+    def tcp(self, host, t, *args):
+        self._buf.append((host, t, len(self._buf), "tcp", args))
+
+    def close(self):
+        for host, t, _i, kind, args in sorted(self._buf, key=lambda r: (r[0], r[1], r[2])):
+            getattr(self.inner, kind)(host, t, *args)
+        self.inner.close()
+
+
+class HybridScheduler:
+    """Drives a NetKernel (hybrid mode) and the device engine in lockstep."""
+
+    name = "tpu-hybrid"
+
+    def __init__(
+        self,
+        kernel,
+        tables: RoutingTables,
+        cfg: EngineConfig,
+        tx_bytes_per_interval=None,
+        rx_bytes_per_interval=None,
+        record_capacity: int = 128,
+    ):
+        if kernel.window_ns != cfg.runahead_ns:
+            raise ValueError(
+                f"hybrid needs kernel.window_ns == engine runahead "
+                f"({kernel.window_ns} != {cfg.runahead_ns})"
+            )
+        from shadow_tpu.engine.round import validate_runahead
+
+        validate_runahead(cfg, tables)
+        self.k = kernel
+        kernel.hybrid = True
+        if kernel.pcap is not None:
+            kernel.pcap = _SortingPcap(kernel.pcap)
+        self.tables = tables
+        self.cfg = cfg
+        self.model = ManagedNetModel(cfg.num_hosts, record_capacity=record_capacity)
+        self.st = init_state(
+            cfg,
+            self.model.init(),
+            tx_bytes_per_interval=tx_bytes_per_interval,
+            rx_bytes_per_interval=rx_bytes_per_interval,
+        )
+        self.W = cfg.runahead_ns
+        self.inflight = 0
+        self.device_passes = 0
+        self._horizon: "int | None" = None
+
+        model, cfgs, tabs = self.model, self.cfg, self.tables
+
+        def _pass(st, window_end):
+            st = st.replace(model=model.reset_records(st.model))
+            return run_round(st, window_end, model, tabs, cfgs)
+
+        self._pass_jit = jax.jit(_pass)
+
+        def _upload(st, valid, src, time, tie, data):
+            q = equeue.push_many(
+                st.queue,
+                dst=src,
+                valid=valid,
+                time=time,
+                tie=tie,
+                kind=jnp.full(valid.shape, KIND_MSEND, jnp.int32),
+                data=data,
+                aux=jnp.zeros(valid.shape, jnp.int32),
+            )
+            return st.replace(queue=q)
+
+        self._upload_jit = jax.jit(_upload)
+
+    # --- device interaction ------------------------------------------------
+
+    def _upload_sends(self, sends: "list[tuple]") -> None:
+        """Stage buffered sends as KIND_MSEND events on their source hosts'
+        device queues. Shapes are padded to powers of two to bound the jit
+        cache."""
+        m = len(sends)
+        cap = 8
+        while cap < m:
+            cap *= 2
+        time = np.zeros(cap, np.int64)
+        src = np.zeros(cap, np.int32)
+        data = np.zeros((cap, equeue.PAYLOAD_LANES), np.int32)
+        valid = np.zeros(cap, bool)
+        tie = np.zeros(cap, np.int64)
+        for i, (t, s, seq, ctr, dst, size) in enumerate(sends):
+            time[i] = t
+            src[i] = s
+            valid[i] = True
+            data[i, LANE_DST] = dst
+            data[i, LANE_SRC] = s
+            data[i, LANE_SIZE] = size
+            data[i, LANE_CTR] = np.uint32(ctr).astype(np.int32)
+            data[i, LANE_SEQ] = np.uint32(seq).astype(np.int32)
+            tie[i] = pack_tie(KIND_MSEND, s, seq & 0xFFFFFFFF)
+        self.st = self._upload_jit(self.st, valid, src, time, tie, data)
+        self.inflight += m
+
+    def _run_pass(self, window_end: int) -> None:
+        self.st = self._pass_jit(self.st, jnp.asarray(window_end, jnp.int64))
+        self.device_passes += 1
+
+    def _drain_records(self) -> None:
+        m = self.st.model
+        rec = jax.device_get(
+            (
+                m.rec_time,
+                m.rec_data,
+                m.rec_flag,
+                m.rec_overflow,
+                self.st.queue.overflow,
+                self.st.outbox.overflow,
+            )
+        )
+        r_time, r_data, r_flag, r_ov, q_ov, o_ov = rec
+        if int(r_ov.sum()) or int(q_ov.sum()) or int(o_ov.sum()):
+            raise CapacityError(
+                f"hybrid device capacity exhausted (records={int(r_ov.sum())}, "
+                f"queue={int(q_ov.sum())}, outbox={int(o_ov.sum())}); raise "
+                f"record_capacity/queue_capacity/outbox_capacity"
+            )
+        hh, aa = np.nonzero(r_flag > 0)
+        if hh.size == 0:
+            return
+        t = r_time[hh, aa]
+        d = r_data[hh, aa]
+        seqs = d[:, LANE_SEQ].astype(np.uint32)
+        srcs = d[:, LANE_SRC]
+        flags = r_flag[hh, aa]
+        order = np.lexsort((seqs, srcs, t))
+        for i in order:
+            self.k.hybrid_apply_record(
+                int(flags[i]), int(t[i]), int(srcs[i]), int(seqs[i]),
+                horizon_ns=self._horizon,
+            )
+        self.inflight -= hh.size
+
+    # --- the lockstep loop -------------------------------------------------
+
+    def run(self, until_ns: int) -> None:
+        k = self.k
+        W = self.W
+        self._horizon = until_ns
+        k._progress_total = until_ns
+        try:
+            E = W
+            while True:
+                if self.inflight == 0 and not k.pending_sends:
+                    # free-run: nothing on the wire; the grid clamp is
+                    # time-based so skipping idle windows changes nothing
+                    k.run_window(until_ns, inclusive=True, stop_at_send_grid=True)
+                    if not k.pending_sends:
+                        break
+                    E = k._grid_end(k.pending_sends[0][0])
+                else:
+                    self._run_pass(E)  # pass A: arrivals < E
+                    self._drain_records()
+                    if E > until_ns:
+                        k.run_window(until_ns, inclusive=True)
+                    else:
+                        k.run_window(E)
+                if k.pending_sends:
+                    self._upload_sends(k.hybrid_take_sends())
+                    self._run_pass(E)  # pass B: sends < E, arrivals >= E
+                    self._drain_records()
+                if E > until_ns and self.inflight == 0 and not k.pending_sends:
+                    break
+                E += W
+            k.finish(until_ns)
+        finally:
+            k.shutdown_check()

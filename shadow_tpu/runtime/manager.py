@@ -168,6 +168,16 @@ class Manager:
                 )
         return out
 
+    def _resolve_runahead(self, tables) -> int:
+        """The conservative round window: the configured value, else the
+        minimum link/path latency (reference runahead.rs:43-56). One
+        definition for the scripted and managed paths — the hybrid/serial
+        clamp grid must match the engine's window exactly."""
+        ra = self.config.experimental.runahead_ns
+        if ra is None:
+            ra = min(self.graph.min_latency_ns(), tables.min_path_latency_ns())
+        return ra
+
     def run(self) -> SimResults:
         cfgo = self.config
         num_hosts = len(self.hosts)
@@ -192,9 +202,7 @@ class Manager:
         tables = compute_routing(self.graph, use_shortest_path=cfgo.network.use_shortest_path)
         tables = tables.with_hosts(host_node)
 
-        runahead = cfgo.experimental.runahead_ns
-        if runahead is None:
-            runahead = min(self.graph.min_latency_ns(), tables.min_path_latency_ns())
+        runahead = self._resolve_runahead(tables)
 
         # Any host with a resolved bandwidth turns the relays/AQM on; hosts
         # without one stay unshaped (refill 0).
@@ -294,17 +302,21 @@ class Manager:
 
     def _run_managed(self) -> SimResults:
         """Run real executables as managed processes under the LD_PRELOAD
-        shim on the CPU-side serial kernel (the reference's only execution
-        mode; spawn/resume managed_thread.rs:156-267). The device engine
-        stays out of the loop until the hybrid scheduler lands; network
-        semantics (latency/loss/routing/DNS) are shared with it via
-        RoutingTables + the threefry RNG streams."""
+        shim (spawn/resume managed_thread.rs:156-267). scheduler=tpu (the
+        default) couples the CPU kernel to the device engine: guests
+        execute on the CPU, their packets ride the device network plane
+        (runtime/hybrid.py; reference manager.rs:392-478). scheduler=
+        managed keeps the whole simulation on the serial CPU kernel. Both
+        use the same round-window delivery clamp (worker.rs:399-402) and
+        the same threefry streams, so their timelines are bit-identical."""
         from shadow_tpu.hostk.kernel import NetKernel, ProcessSpec
 
         cfgo = self.config
         host_node = [h.node_index for h in self.hosts]
         tables = compute_routing(self.graph, use_shortest_path=cfgo.network.use_shortest_path)
         tables = tables.with_hosts(host_node)
+
+        runahead = self._resolve_runahead(tables)
 
         k = NetKernel(
             tables,
@@ -323,6 +335,7 @@ class Manager:
             bw_up_bits=[max(h.bw_up_bits, 0) for h in self.hosts],
             bw_down_bits=[max(h.bw_down_bits, 0) for h in self.hosts],
             bootstrap_end_ns=cfgo.general.bootstrap_end_time_ns,
+            window_ns=runahead,
         )
         for h in self.hosts:
             for p in h.spec.processes:
@@ -337,13 +350,47 @@ class Manager:
                     )
                 )
 
+        sched_name = cfgo.experimental.scheduler
+        if sched_name == "tpu":
+            from shadow_tpu.netstack import bw_bits_per_sec_to_refill
+            from shadow_tpu.runtime.hybrid import HybridScheduler
+
+            bw_up = np.array([max(h.bw_up_bits, 0) for h in self.hosts], dtype=np.int64)
+            bw_down = np.array([max(h.bw_down_bits, 0) for h in self.hosts], dtype=np.int64)
+            use_netstack = bool((bw_up > 0).any() or (bw_down > 0).any())
+            ecfg = EngineConfig(
+                num_hosts=len(self.hosts),
+                queue_capacity=cfgo.experimental.queue_capacity,
+                outbox_capacity=cfgo.experimental.outbox_capacity,
+                runahead_ns=runahead,
+                seed=cfgo.general.seed,
+                max_iters_per_round=cfgo.experimental.max_iters_per_round,
+                use_netstack=use_netstack,
+                bootstrap_end_ns=cfgo.general.bootstrap_end_time_ns,
+            )
+            runner = HybridScheduler(
+                k,
+                tables,
+                ecfg,
+                tx_bytes_per_interval=(
+                    np.asarray(bw_bits_per_sec_to_refill(bw_up)) if use_netstack else None
+                ),
+                rx_bytes_per_interval=(
+                    np.asarray(bw_bits_per_sec_to_refill(bw_down)) if use_netstack else None
+                ),
+                record_capacity=cfgo.experimental.record_capacity,
+            )
+            run_fn, sched_label = runner.run, HybridScheduler.name
+        else:
+            run_fn, sched_label = k.run, "managed"
+
         end = cfgo.general.stop_time_ns
         slog("info", 0, "manager",
-             f"starting: {len(self.hosts)} hosts, scheduler=managed, "
+             f"starting: {len(self.hosts)} hosts, scheduler={sched_label}, "
              f"{len(k.procs)} managed processes, stop={fmt_time_ns(end)}")
         t0 = time.perf_counter()
         try:
-            k.run(end)
+            run_fn(end)
         finally:
             k.shutdown()
         wall = time.perf_counter() - t0
@@ -360,7 +407,7 @@ class Manager:
             packets_unroutable=0,
             wall_seconds=wall,
             sim_seconds=end / NS_PER_SEC,
-            scheduler="managed",
+            scheduler=sched_label,
             unexpected_final_states=unexpected,
             extra_stats=stats,
         )

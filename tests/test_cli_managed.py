@@ -79,7 +79,9 @@ def test_cli_managed_end_to_end(tmp_path, guest_bins):
 
     data = tmp_path / "data"
     stats = json.loads((data / "sim-stats.json").read_text())
-    assert stats["scheduler"] == "managed"
+    # managed configs default to the hybrid scheduler: guests on the CPU
+    # kernel, packets on the device engine
+    assert stats["scheduler"] == "tpu-hybrid"
     assert stats["syscalls_handled"] > 0
     assert stats["syscall_counts"]["sendto"] >= 3
     assert stats["packets_sent"] >= 6  # 3 pings + 3 echoes
@@ -98,6 +100,40 @@ def test_cli_managed_end_to_end(tmp_path, guest_bins):
     # hosts file exported (dns.c:115 analogue)
     hosts = (data / "hosts").read_text()
     assert "11.0.0.1 server" in hosts and "11.0.0.2 client" in hosts
+
+
+def test_cli_serial_scheduler_matches_hybrid(tmp_path, guest_bins):
+    """experimental.scheduler: managed keeps everything on the serial CPU
+    kernel; guest-visible output must match the hybrid default exactly
+    (same clamp grid, same threefry streams)."""
+    outs = []
+    for run, extra in (("hy", ""), ("se", "experimental:\n  scheduler: managed\n")):
+        d = tmp_path / run
+        d.mkdir()
+        cfg = d / "shadow.yaml"
+        cfg.write_text(
+            CONFIG.format(
+                data_dir=d / "data",
+                server_bin=guest_bins["udp_echo"],
+                client_bin=guest_bins["udp_client"],
+            )
+            + extra
+        )
+        assert run_from_config(str(cfg)) == 0
+        data = d / "data"
+        stats = json.loads((data / "sim-stats.json").read_text())
+        outs.append(
+            (
+                (data / "client" / "udp_client.1001.stdout").read_bytes(),
+                stats["packets_sent"],
+                stats["syscall_counts"],
+                stats["scheduler"],
+            )
+        )
+    assert outs[0][0] == outs[1][0]
+    assert outs[0][1] == outs[1][1]
+    assert outs[0][2] == outs[1][2]
+    assert (outs[0][3], outs[1][3]) == ("tpu-hybrid", "managed")
 
 
 def test_cli_double_run_strace_identical(tmp_path, guest_bins):
