@@ -97,6 +97,23 @@ SOL_SOCKET = 1
 SO_ERROR = 4
 
 
+def _disable_aslr() -> None:
+    """Child-side pre-exec: ADDR_NO_RANDOMIZE so guest heap/stack/mmap
+    addresses replay identically run to run (pointer values leak into
+    guest behavior and strace; the reference disables ASLR for all
+    managed processes the same way, main.rs:203-206 disable_aslr)."""
+    import ctypes
+
+    ADDR_NO_RANDOMIZE = 0x0040000
+    try:
+        libc = ctypes.CDLL(None, use_errno=True)
+        pers = libc.personality(0xFFFFFFFF)  # query
+        if pers != -1:
+            libc.personality(pers | ADDR_NO_RANDOMIZE)
+    except Exception:
+        pass  # ASLR stays on; determinism of pointer values degrades only
+
+
 class SimPanic(RuntimeError):
     pass
 
@@ -403,6 +420,7 @@ class ManagedProcess:
             stdout=open(self._stdout_path, "wb"),
             stderr=open(self._stderr_path, "wb"),
             stdin=subprocess.DEVNULL,
+            preexec_fn=_disable_aslr,
         )
         # shim constructor sends START_REQ before main() runs
         msg = main._recv()
