@@ -89,6 +89,13 @@ def _build(num_hosts: int, seed: int = 7):
         runahead_ns=graph.min_latency_ns(),
         seed=seed,
         use_netstack=True,
+        # Bound each round's pop-iteration loop so no single device call
+        # can run unboundedly long (shaping backlogs concentrate events on
+        # single hosts; an over-long XLA execution kills the TPU tunnel
+        # worker — the round-1 crash). Splitting a round is semantically
+        # free: the next window re-opens over the leftovers and per-host
+        # pop order is unchanged.
+        max_iters_per_round=256,
     )
     model = TgenModel(
         num_hosts=num_hosts,
@@ -220,7 +227,7 @@ def main():
     num_hosts = int(os.environ.get("SHADOW_TPU_BENCH_HOSTS", 10240))
     sim_sec = float(os.environ.get("SHADOW_TPU_BENCH_SIMSEC", 3))
     cpu_sim_sec = float(os.environ.get("SHADOW_TPU_BENCH_CPU_SIMSEC", 0.25))
-    rpc = int(os.environ.get("SHADOW_TPU_BENCH_RPC", 64))
+    rpc = int(os.environ.get("SHADOW_TPU_BENCH_RPC", 16))
 
     if role == "measure":
         print(json.dumps(_measure(num_hosts, sim_sec, rounds_per_chunk=rpc)))
@@ -235,10 +242,10 @@ def main():
     # then progressively smaller worlds. (hosts, sim_sec, rounds_per_chunk)
     ladder = [
         (num_hosts, sim_sec, rpc),
-        (num_hosts, sim_sec, 16),
-        (num_hosts // 2, sim_sec, 32),
-        (num_hosts // 4, sim_sec, 32),
-        (num_hosts // 8, sim_sec, 64),
+        (num_hosts, sim_sec, 8),
+        (num_hosts // 2, sim_sec, 16),
+        (num_hosts // 4, sim_sec, 16),
+        (num_hosts // 8, sim_sec, 32),
     ]
     seen, attempts_cfg = set(), []
     for cfgt in ladder:

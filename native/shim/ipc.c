@@ -15,8 +15,27 @@
 #include <time.h>
 #include <unistd.h>
 
+/* In the shim (seccomp active), channel futexes must ride the BPF-allowed
+ * gadget — through libc syscall() they would SIGSYS-trap on every park and
+ * wake. The host-side library never sets the hook and uses libc. */
+static long (*g_raw_syscall)(long, long, long, long, long, long, long) = 0;
+
+void shim_ipc_use_raw_syscall(
+    long (*fn)(long, long, long, long, long, long, long)) {
+    g_raw_syscall = fn;
+}
+
 static long sys_futex(shim_atomic_u32 *uaddr, int op, uint32_t val,
                       const struct timespec *timeout) {
+    if (g_raw_syscall) {
+        long r = g_raw_syscall(SYS_futex, (long)uaddr, (long)op, (long)val,
+                               (long)timeout, 0L, 0L);
+        if ((unsigned long)r >= (unsigned long)-4095L) {
+            errno = (int)-r;
+            return -1;
+        }
+        return r;
+    }
     return syscall(SYS_futex, uaddr, op, val, timeout, NULL, 0);
 }
 

@@ -57,14 +57,21 @@ long shim_raw_syscall(long nr, ...);
 long shim_route_syscall(long nr, long a1, long a2, long a3, long a4, long a5,
                         long a6);
 
+/* the interrupted context, for handlers that must re-issue a clone with
+ * a child-continuation fix-up (shim.c reads the trapped RIP from it) */
+__thread void *shim_sigsys_uctx = 0;
+
 static void sigsys_handler(int sig, siginfo_t *si, void *ucv) {
     (void)sig;
     int saved_errno = errno; /* routed emulation must not leak errno */
     ucontext_t *uc = (ucontext_t *)ucv;
     greg_t *g = uc->uc_mcontext.gregs;
     long nr = si->si_syscall;
+    void *prev = shim_sigsys_uctx;
+    shim_sigsys_uctx = ucv;
     g[REG_RAX] = shim_route_syscall(nr, g[REG_RDI], g[REG_RSI], g[REG_RDX],
                                     g[REG_R10], g[REG_R8], g[REG_R9]);
+    shim_sigsys_uctx = prev;
     errno = saved_errno;
 }
 
@@ -96,6 +103,16 @@ static const int TRAPPED[] = {
     200 /*tkill*/,     234 /*tgkill*/,
     16 /*ioctl*/,      72 /*fcntl*/,
     57 /*fork*/,       61 /*wait4*/,
+    /* serialization-critical: raw futex joins the simulated futex table;
+     * clone/exec family must never silently escape (shim.c routes or
+     * fails loudly; the shim's own IPC futexes ride the gadget) */
+    202 /*futex*/,     56 /*clone*/,       435 /*clone3*/,
+    58 /*vfork*/,      59 /*execve*/,      322 /*execveat*/,
+    /* guests must never block SIGSYS (a blocked seccomp trap is a forced
+     * kill — glibc blocks *all* signals around pthread_create/fork);
+     * emulated against the signal frame so the change survives sigreturn */
+    14 /*rt_sigprocmask*/,
+    231 /*exit_group*/, /* raw _exit must record the status in-sim */
 };
 #define NTRAPPED ((int)(sizeof(TRAPPED) / sizeof(TRAPPED[0])))
 
@@ -108,7 +125,11 @@ int shim_install_seccomp(void) {
     struct sigaction sa;
     memset(&sa, 0, sizeof(sa));
     sa.sa_sigaction = sigsys_handler;
-    sa.sa_flags = SA_SIGINFO | SA_RESTART;
+    /* SA_NODEFER: a clone child born inside the handler inherits the
+     * handler-time signal mask and never sigreturns through our frame —
+     * a deferred (blocked) SIGSYS would turn its next trapped syscall
+     * into a forced kill */
+    sa.sa_flags = SA_SIGINFO | SA_RESTART | SA_NODEFER;
     /* the real libc sigaction: shim.c's interposer deliberately refuses
      * guest attempts to (re)register SIGSYS, including this one */
     int (*real_sigaction)(int, const struct sigaction *, struct sigaction *) =
@@ -157,6 +178,90 @@ int shim_install_seccomp(void) {
     if (shim_raw_syscall(SYS_prctl, PR_SET_SECCOMP, SECCOMP_MODE_FILTER,
                          (long)&fprog, 0L, 0L, 0L))
         return -1;
+    return 0;
+}
+
+/* ---- rdtsc/rdtscp trap (reference: src/lib/tsc/src/lib.rs:20 +
+ * src/lib/shim/shim_rdtsc.c) ----
+ * PR_SET_TSC(PR_TSC_SIGSEGV) makes every rdtsc/rdtscp fault; the SIGSEGV
+ * handler decodes the two encodings and serves cycles derived from
+ * simulated time at a fixed nominal 1 GHz (cycles == sim ns), so hardware
+ * time never leaks into the guest and timings replay deterministically.
+ * The flag is inherited by clone children, covering all guest threads. */
+
+int64_t shim_sim_now_ns(void); /* shim.c: the locally-served sim clock */
+
+static struct sigaction g_prev_segv;
+
+static void sigsegv_handler(int sig, siginfo_t *si, void *ucv) {
+    ucontext_t *uc = (ucontext_t *)ucv;
+    greg_t *g = uc->uc_mcontext.gregs;
+    /* PR_TSC_SIGSEGV faults arrive as SI_KERNEL (GP fault), memory faults
+     * as SEGV_MAPERR/ACCERR — only decode the former (reading an
+     * arbitrary bad RIP here could fault recursively) */
+    if (si->si_code == SI_KERNEL) {
+        const uint8_t *ip = (const uint8_t *)g[REG_RIP];
+        int is_rdtsc = ip && ip[0] == 0x0f && ip[1] == 0x31;
+        int is_rdtscp = ip && ip[0] == 0x0f && ip[1] == 0x01 && ip[2] == 0xf9;
+        if (is_rdtsc || is_rdtscp) {
+            uint64_t cycles = (uint64_t)shim_sim_now_ns();
+            g[REG_RAX] = (greg_t)(cycles & 0xffffffffu);
+            g[REG_RDX] = (greg_t)(cycles >> 32);
+            if (is_rdtscp) {
+                g[REG_RCX] = 0; /* IA32_TSC_AUX: core 0 */
+                g[REG_RIP] += 3;
+            } else {
+                g[REG_RIP] += 2;
+            }
+            return;
+        }
+    }
+    /* a real fault: chain to the guest's handler without uninstalling
+     * ours (rdtsc must keep serving sim time afterwards) */
+    if ((g_prev_segv.sa_flags & SA_SIGINFO) && g_prev_segv.sa_sigaction) {
+        g_prev_segv.sa_sigaction(sig, si, ucv);
+        return;
+    }
+    if (g_prev_segv.sa_handler != SIG_DFL && g_prev_segv.sa_handler != SIG_IGN &&
+        g_prev_segv.sa_handler) {
+        g_prev_segv.sa_handler(sig);
+        return;
+    }
+    /* no guest handler: restore the default disposition and replay the
+     * faulting instruction (honest crash semantics) */
+    int (*real_sigaction)(int, const struct sigaction *, struct sigaction *) =
+        (int (*)(int, const struct sigaction *, struct sigaction *))dlsym(
+            RTLD_NEXT, "sigaction");
+    struct sigaction dfl;
+    memset(&dfl, 0, sizeof(dfl));
+    dfl.sa_handler = SIG_DFL;
+    if (real_sigaction)
+        real_sigaction(SIGSEGV, &dfl, NULL);
+}
+
+/* A guest SIGSEGV registration becomes the chain target for real faults
+ * (the shim's handler stays installed so rdtsc keeps serving sim time) */
+void shim_tsc_chain_guest_segv(const struct sigaction *act,
+                               struct sigaction *old) {
+    if (old)
+        *old = g_prev_segv;
+    g_prev_segv = *act;
+}
+
+int shim_install_tsc_trap(void) {
+    struct sigaction sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sa_sigaction = sigsegv_handler;
+    sa.sa_flags = SA_SIGINFO | SA_NODEFER;
+    int (*real_sigaction)(int, const struct sigaction *, struct sigaction *) =
+        (int (*)(int, const struct sigaction *, struct sigaction *))dlsym(
+            RTLD_NEXT, "sigaction");
+    if (!real_sigaction || real_sigaction(SIGSEGV, &sa, &g_prev_segv) != 0)
+        return -1;
+#ifdef PR_SET_TSC
+    if (prctl(PR_SET_TSC, PR_TSC_SIGSEGV, 0, 0, 0) != 0)
+        return -1;
+#endif
     return 0;
 }
 

@@ -118,6 +118,16 @@ enum {
     VSYS_FORK = 60,          /* -> a[2]=child vpid, buf=child shm path */
     VSYS_WAITPID = 61,       /* a[1]=vpid a[2]=nohang -> a[2]=status,
                                 a[3]=real pid (shim reaps the zombie) */
+    /* raw SYS_futex emulation (reference: src/main/host/futex.c,
+     * futex_table.c, syscall/futex.c). The shim performs the *uaddr==val
+     * check (race-free: guests are strictly serialized), the kernel owns
+     * the per-process wait queues. */
+    VSYS_FUTEX_WAIT = 62,    /* a[1]=addr a[2]=timeout_ns(-1 none)
+                                a[3]=0 rel | 1 abs-monotonic | 2 abs-realtime
+                                -> 0 / -ETIMEDOUT / -EINTR */
+    VSYS_FUTEX_WAKE = 63,    /* a[1]=addr a[2]=max -> n woken */
+    VSYS_FUTEX_REQUEUE = 64, /* a[1]=addr a[2]=nwake a[3]=nrequeue
+                                a[5]=addr2 -> n woken + requeued */
 };
 
 typedef struct {

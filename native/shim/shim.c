@@ -39,6 +39,8 @@
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <linux/futex.h>
+#include <sched.h>
 #include <sys/mman.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
@@ -51,11 +53,69 @@
 
 void shim_channel_send(ShimChannel *ch, const ShimMsg *msg);
 int shim_channel_recv(ShimChannel *ch, ShimMsg *out, int timeout_ms);
+void shim_ipc_use_raw_syscall(long (*fn)(long, long, long, long, long, long,
+                                         long));
 
 /* seccomp.c: the one BPF-allowed syscall instruction + filter install */
 long shim_raw_syscall(long nr, ...);
 int shim_install_seccomp(void);
 int shim_patch_vdso(void);
+int shim_install_tsc_trap(void);
+void shim_tsc_chain_guest_segv(const struct sigaction *act,
+                               struct sigaction *old);
+
+/* fixed-arity gadget entry for the IPC library's futex hook (the gadget
+ * is assembly and reads registers directly, so the arity mismatch with
+ * the variadic declaration is immaterial) */
+static long raw7(long nr, long a1, long a2, long a3, long a4, long a5,
+                 long a6) {
+    return shim_raw_syscall(nr, a1, a2, a3, a4, a5, a6);
+}
+
+/* seccomp.c: the interrupted user context of the SIGSYS being handled */
+extern __thread void *shim_sigsys_uctx;
+
+/* kernel clone_args layout (clone3 ABI) — declared locally to avoid the
+ * <linux/sched.h> vs <sched.h> macro collision */
+struct shim_clone_args {
+    uint64_t flags, pidfd, child_tid, parent_tid, exit_signal;
+    uint64_t stack, stack_size, tls, set_tid, set_tid_size, cgroup;
+};
+
+/* Re-issue a trapped clone/clone3 from glibc internals through the
+ * gadget. A child on a NEW stack resumes at the gadget's post-syscall
+ * `ret` with RSP = the new stack — so we seed the stack top with the
+ * interrupted RIP, making that `ret` land exactly at glibc's own
+ * post-syscall instruction with RAX = 0 (the child protocol glibc
+ * expects). Fork-style clones (no new stack) need no fix-up: the child
+ * replays the copied signal frame through rt_sigreturn. (The reference
+ * solves the same problem with hand-rolled clone asm in its shim,
+ * shim_syscall.c; this gadget-ret route avoids asm entirely.) */
+static long native_clone_reissue(long nr, long a1, long a2, long a3, long a4,
+                                 long a5, long a6) {
+    ucontext_t *uc = (ucontext_t *)shim_sigsys_uctx;
+    if (uc == NULL) /* not inside a SIGSYS trap: plain passthrough */
+        return shim_raw_syscall(nr, a1, a2, a3, a4, a5, a6);
+    long rip = (long)uc->uc_mcontext.gregs[REG_RIP];
+    if (nr == SYS_clone && a2 != 0) {
+        long *sp = (long *)a2 - 1;
+        *sp = rip;
+        return shim_raw_syscall(nr, a1, (long)sp, a3, a4, a5, a6);
+    }
+    if (nr == SYS_clone3) {
+        struct shim_clone_args *ca = (struct shim_clone_args *)a1;
+        if (ca->stack != 0 && ca->stack_size >= 16) {
+            long *top = (long *)(ca->stack + ca->stack_size) - 1;
+            *top = rip;
+            ca->stack_size -= 8;
+            long r = shim_raw_syscall(nr, a1, a2, a3, a4, a5, a6);
+            ca->stack_size += 8; /* parent-side restore; the child already
+                                  * popped the seeded slot */
+            return r;
+        }
+    }
+    return shim_raw_syscall(nr, a1, a2, a3, a4, a5, a6);
+}
 
 /* gadget-routed syscall with glibc syscall() errno semantics */
 static long rsyscall(long nr, ...) {
@@ -74,6 +134,49 @@ static long rsyscall(long nr, ...) {
 
 #define VFD_BASE 1000 /* virtual fds live above real ones */
 
+/* Every mapped ShimShmem block (process block, per-thread blocks, forked
+ * children's blocks). Futexes inside these are the IPC channel's own
+ * parking futexes and must execute natively — routing them into the
+ * simulated futex table would deadlock the channel on itself. Mutated
+ * only by the single running thread; read from the SIGSYS handler. */
+#define MAX_SHM_MAPS 272
+static void *g_shm_maps[MAX_SHM_MAPS];
+static int g_shm_map_count = 0;
+
+static void shim_warn(const char *msg);
+
+static void register_shm_map(void *p) {
+    if (g_shm_map_count < MAX_SHM_MAPS)
+        g_shm_maps[g_shm_map_count++] = p;
+    else
+        shim_warn("shadow-shim: shm map table full; a channel futex may "
+                  "mis-route through the simulated table\n");
+}
+
+static void unregister_shm_map(void *p) {
+    for (int i = 0; i < g_shm_map_count; i++)
+        if (g_shm_maps[i] == p) {
+            g_shm_maps[i] = g_shm_maps[--g_shm_map_count];
+            return;
+        }
+}
+
+static int is_shim_shmem_addr(const void *p) {
+    for (int i = 0; i < g_shm_map_count; i++)
+        if ((const char *)p >= (const char *)g_shm_maps[i] &&
+            (const char *)p < (const char *)g_shm_maps[i] + SHIM_SHMEM_SIZE)
+            return 1;
+    return 0;
+}
+
+/* raw-write(2) warning: stdio may be unusable inside a syscall trap */
+static void shim_warn(const char *msg) {
+    size_t n = 0;
+    while (msg[n])
+        n++;
+    shim_raw_syscall(SYS_write, 2L, (long)msg, (long)n, 0L, 0L, 0L);
+}
+
 static ShimShmem *g_shm = NULL;
 static int g_active = 0;
 static int64_t g_vpid = 0;
@@ -87,7 +190,17 @@ static __thread ShimShmem *t_shm = NULL; /* NULL = use the process block */
 static __thread int64_t t_tid = 0;       /* 0 = main thread (tid == vpid) */
 static __thread int64_t g_unapplied = 0;
 static __thread int g_in_shim = 0; /* recursion guard (reference shim.c:427-439) */
+/* set while the shim itself calls glibc fork/pthread_create, whose raw
+ * clone must execute natively (the managed birth already happened) */
+static __thread int t_native_clone_ok = 0;
+/* set while glibc's pthread lifecycle machinery runs under our
+ * interposition (create/join/exit): its internal futexes (the ctid wait
+ * in join, robust-list wakes at thread death) are woken by the *Linux*
+ * kernel, so routing them into the simulated futex table would park the
+ * guest forever. Guest-application futexes never run under this flag. */
+static __thread int t_native_futex_ok = 0;
 static int g_main_exited = 0; /* main pthread_exit'ed; kernel-side it is gone */
+static int g_exit_sent = 0;  /* VSYS_EXIT already recorded for this process */
 
 static inline ShimShmem *cur_shm(void) { return t_shm ? t_shm : g_shm; }
 
@@ -199,6 +312,8 @@ __attribute__((constructor)) static void shim_attach(void) {
     if (p == MAP_FAILED)
         return;
     g_shm = (ShimShmem *)p;
+    register_shm_map(p);
+    shim_ipc_use_raw_syscall(raw7);
     if (g_shm->magic != SHIM_MAGIC || g_shm->version != SHIM_VERSION)
         return;
     ShimMsg m;
@@ -218,9 +333,13 @@ __attribute__((constructor)) static void shim_attach(void) {
     const char *sec = getenv("SHADOW_SECCOMP");
     if (!(sec && sec[0] == '0')) {
         shim_patch_vdso();
+        shim_install_tsc_trap(); /* rdtsc serves sim time (lib/tsc) */
         shim_install_seccomp();
     }
 }
+
+/* the locally-served sim clock for the rdtsc trap (seccomp.c) */
+int64_t shim_sim_now_ns(void) { return local_now_ns(); }
 
 __attribute__((destructor)) static void shim_detach(void) {
     if (!g_active)
@@ -419,6 +538,7 @@ static void *thread_trampoline(void *p) {
     if (m == MAP_FAILED)
         return NULL;
     t_shm = (ShimShmem *)m;
+    register_shm_map(m);
     t_tid = tb.tid;
     /* announce on our own channel and park until scheduled */
     ShimMsg msg;
@@ -430,6 +550,8 @@ static void *thread_trampoline(void *p) {
     shim_channel_recv(&t_shm->to_shim, &msg, -1);
     void *ret = tb.fn(tb.arg);
     vsys(VSYS_THREAD_EXIT, (int64_t)(intptr_t)ret, 0, 0, NULL, 0, NULL);
+    t_native_futex_ok = 1; /* glibc thread-death cleanup runs native */
+    unregister_shm_map((void *)t_shm); /* reclaim the table slot */
     return ret;
 }
 
@@ -445,6 +567,7 @@ void pthread_exit(void *retval) {
             g_main_exited = 1; /* destructor must not expect a reply */
         vsys(VSYS_THREAD_EXIT, (int64_t)(intptr_t)retval, 0, 0, NULL, 0, NULL);
     }
+    t_native_futex_ok = 1; /* glibc thread-death cleanup runs native */
     real(retval);
     __builtin_unreachable();
 }
@@ -480,7 +603,11 @@ int pthread_create(pthread_t *t, const pthread_attr_t *attr,
                                                     : sizeof(tb->path) - 1;
     memcpy(tb->path, reply.buf, n);
     tb->path[n] = '\0';
+    t_native_clone_ok = 1;
+    t_native_futex_ok = 1;
     int rc = real(t, attr, thread_trampoline, tb);
+    t_native_futex_ok = 0;
+    t_native_clone_ok = 0;
     if (rc != 0) {
         vsys(VSYS_THREAD_FAILED, tb->tid, 0, 0, NULL, 0, NULL);
         free(tb);
@@ -517,7 +644,9 @@ int pthread_join(pthread_t t, void **retval) {
     int64_t r = vsys(VSYS_THREAD_JOIN, tid, 0, 0, NULL, 0, &reply);
     if (r < 0)
         return (int)-r;
+    t_native_futex_ok = 1;
     real(t, NULL); /* reap the native thread; it has already exited */
+    t_native_futex_ok = 0;
     g_thread_map[slot] = g_thread_map[--g_thread_count];
     if (retval)
         *retval = (void *)(intptr_t)reply.a[2];
@@ -549,7 +678,9 @@ pid_t fork(void) {
                                                 : sizeof(path) - 1;
     memcpy(path, reply.buf, n);
     path[n] = '\0';
+    t_native_clone_ok = 1;
     pid_t p = real();
+    t_native_clone_ok = 0;
     if (p < 0) {
         vsys(VSYS_THREAD_FAILED, child_vpid, 0, 0, NULL, 0, NULL);
         return p;
@@ -566,12 +697,15 @@ pid_t fork(void) {
         if (m == MAP_FAILED)
             rsyscall(SYS_exit_group, 117L); /* cannot join the simulation */
         g_shm = (ShimShmem *)m;
+        register_shm_map(m);
         t_shm = NULL;
         t_tid = 0;
+        t_native_clone_ok = 0;
         g_ppid = g_vpid;
         g_vpid = child_vpid;
         g_thread_count = 0;
         g_main_exited = 0;
+        g_exit_sent = 0;
         ShimMsg msg;
         memset(&msg, 0, offsetof(ShimMsg, buf));
         msg.kind = SHIM_MSG_CHILD_START;
@@ -622,8 +756,11 @@ void exit(int status) {
     static void (*real)(int) __attribute__((noreturn));
     if (!real)
         real = (void (*)(int))dlsym(RTLD_NEXT, "exit");
-    if (g_active) /* record the code for waitpid before the destructor runs */
+    if (g_active && !g_exit_sent) {
+        /* record the code for waitpid before the destructor runs */
+        g_exit_sent = 1;
         vsys(VSYS_EXIT, (int64_t)status, 0, 0, NULL, 0, NULL);
+    }
     real(status);
     __builtin_unreachable();
 }
@@ -721,6 +858,19 @@ int sigaction(int sig, const struct sigaction *act, struct sigaction *old) {
          * shim_signals.c hides its internal signals the same way). */
         if (old)
             memset(old, 0, sizeof(*old));
+        return 0;
+    }
+    if (g_active && sig == SIGSEGV && act != NULL) {
+        /* SIGSEGV carries the rdtsc trap (PR_SET_TSC); record the guest
+         * handler as the chain target for real faults instead of letting
+         * it displace ours (seccomp.c dispatches non-TSC faults to it) */
+        shim_tsc_chain_guest_segv(act, old);
+        int64_t kind = 2;
+        if (act->sa_handler == SIG_DFL && !(act->sa_flags & SA_SIGINFO))
+            kind = 0;
+        else if (act->sa_handler == SIG_IGN && !(act->sa_flags & SA_SIGINFO))
+            kind = 1;
+        vsys(VSYS_SIGACTION, sig, kind, 0, NULL, 0, NULL);
         return 0;
     }
     if (real(sig, act, old) != 0)
@@ -2271,6 +2421,159 @@ long shim_route_syscall(long nr, long a1, long a2, long a3, long a4, long a5,
         return KR(timerfd_gettime((int)a1, (struct itimerspec *)a2));
     case SYS_getrandom:
         return KR(getrandom((void *)a1, (size_t)a2, (unsigned int)a3));
+
+    case SYS_futex: {
+        /* raw futex emulation (reference src/main/host/futex.c + syscall/
+         * futex.c). The value check happens here: guests are strictly
+         * serialized, so nothing can change *uaddr between this load and
+         * the kernel arming the waiter. Bitset masks are treated as
+         * MATCH_ANY (glibc's only use). */
+        if (is_shim_shmem_addr((const void *)a1) || g_in_shim ||
+            t_native_futex_ok)
+            /* the IPC channel's own parking futex, a nested trap while
+             * already inside the shim, or glibc pthread-lifecycle
+             * internals: must run natively */
+            return shim_raw_syscall(nr, a1, a2, a3, a4, a5, a6);
+        if (!((int)a2 & FUTEX_PRIVATE_FLAG)) {
+            /* Non-PRIVATE ops are simulated per process all the same
+             * (plenty of code omits the flag on private memory). True
+             * cross-process sharing (MAP_SHARED + fork) would need the
+             * reference's physical-address keys — warn once so a guest
+             * that actually needs it is diagnosable, never silent. */
+            static int warned;
+            if (!warned) {
+                warned = 1;
+                shim_warn("shadow-shim: non-private futex treated as "
+                          "process-local (cross-process futex sharing is "
+                          "not simulated)\n");
+            }
+        }
+        int op = (int)a2 & ~(FUTEX_PRIVATE_FLAG | FUTEX_CLOCK_REALTIME);
+        switch (op) {
+        case FUTEX_WAIT:
+        case FUTEX_WAIT_BITSET: {
+            uint32_t cur =
+                __atomic_load_n((volatile uint32_t *)a1, __ATOMIC_SEQ_CST);
+            if (cur != (uint32_t)a3)
+                return -EAGAIN;
+            int64_t timeout_ns = -1;
+            const struct timespec *ts = (const struct timespec *)a4;
+            if (ts)
+                timeout_ns = (int64_t)ts->tv_sec * 1000000000 + ts->tv_nsec;
+            /* FUTEX_WAIT timeouts are relative; WAIT_BITSET absolute
+             * (monotonic unless FUTEX_CLOCK_REALTIME) */
+            int64_t mode = 0;
+            if (op == FUTEX_WAIT_BITSET && ts)
+                mode = ((int)a2 & FUTEX_CLOCK_REALTIME) ? 2 : 1;
+            return (long)vsys(VSYS_FUTEX_WAIT, (int64_t)a1, timeout_ns, mode,
+                              NULL, 0, NULL);
+        }
+        case FUTEX_WAKE:
+        case FUTEX_WAKE_BITSET:
+            return (long)vsys(VSYS_FUTEX_WAKE, (int64_t)a1,
+                              (int64_t)(uint32_t)a3, 0, NULL, 0, NULL);
+        case FUTEX_REQUEUE:
+        case FUTEX_CMP_REQUEUE: {
+            if (op == FUTEX_CMP_REQUEUE) {
+                uint32_t cur =
+                    __atomic_load_n((volatile uint32_t *)a1, __ATOMIC_SEQ_CST);
+                if (cur != (uint32_t)a6)
+                    return -EAGAIN;
+            }
+            /* a4 carries val2 (max requeued) for requeue ops */
+            return (long)vsys_ex(VSYS_FUTEX_REQUEUE, (int64_t)a1,
+                                 (int64_t)(uint32_t)a3, (int64_t)a4,
+                                 (int64_t)a5, NULL, 0, NULL);
+        }
+        default:
+            shim_warn("shadow-shim: unsupported futex op, failing ENOSYS\n");
+            return -ENOSYS;
+        }
+    }
+
+    case SYS_clone: {
+        unsigned long flags = (unsigned long)a1;
+        if (t_native_clone_ok) /* glibc fork/pthread_create internals */
+            return native_clone_reissue(nr, a1, a2, a3, a4, a5, a6);
+        if (!(flags & (CLONE_THREAD | CLONE_VM | CLONE_VFORK)))
+            /* fork-style clone (glibc fork issues clone(SIGCHLD|...)):
+             * route through the managed fork path */
+            return KR(fork());
+        /* raw thread birth needs the reference's no-libc TLS scheme
+         * (managed_thread.rs:294-365); executing it natively would
+         * silently desimulate the guest — fail loudly instead */
+        shim_warn("shadow-shim: raw clone(CLONE_THREAD/VM) is not yet "
+                  "simulated, failing ENOSYS\n");
+        return -ENOSYS;
+    }
+    case SYS_clone3:
+        if (t_native_clone_ok)
+            return native_clone_reissue(nr, a1, a2, a3, a4, a5, a6);
+        shim_warn("shadow-shim: raw clone3 is not simulated, failing ENOSYS "
+                  "(callers fall back to clone/fork)\n");
+        return -ENOSYS;
+    case SYS_rt_sigprocmask: {
+        /* Emulated against the *signal frame*: a native rt_sigprocmask
+         * inside the handler would be undone by sigreturn restoring the
+         * frame's saved mask. SIGSYS is filtered from every new mask —
+         * a guest that blocks it turns its next trapped syscall into a
+         * forced kill (glibc blocks all signals around pthread_create/
+         * fork; the reference sanitizes shim signals identically,
+         * shim_signals.c). */
+        ucontext_t *uc = (ucontext_t *)shim_sigsys_uctx;
+        if (uc == NULL || a4 != 8)
+            return shim_raw_syscall(nr, a1, a2, a3, a4, a5, a6);
+        uint64_t cur;
+        memcpy(&cur, &uc->uc_sigmask, 8);
+        if (a3)
+            memcpy((void *)a3, &cur, 8);
+        if (a2) {
+            uint64_t m;
+            memcpy(&m, (const void *)a2, 8);
+            uint64_t nm;
+            switch ((int)a1) {
+            case SIG_BLOCK:
+                nm = cur | m;
+                break;
+            case SIG_UNBLOCK:
+                nm = cur & ~m;
+                break;
+            case SIG_SETMASK:
+                nm = m;
+                break;
+            default:
+                return -EINVAL;
+            }
+            /* SIGSEGV also stays deliverable: it carries the rdtsc trap
+             * (on real hardware rdtsc cannot fault, so a guest blocking
+             * SIGSEGV must not turn rdtsc into a forced kill) */
+            nm &= ~((1ULL << (SIGSYS - 1)) | (1ULL << (SIGSEGV - 1)));
+            memcpy(&uc->uc_sigmask, &nm, 8);
+        }
+        return 0;
+    }
+
+    case SYS_vfork:
+        shim_warn("shadow-shim: vfork is not simulated, failing ENOSYS\n");
+        return -ENOSYS;
+    case SYS_exit_group:
+        /* raw _exit/exit_group: record the status like the libc exit
+         * interposer, then die natively (double-send guarded: libc exit
+         * reaches here after already reporting) */
+        if (!g_exit_sent && !g_main_exited) {
+            g_exit_sent = 1;
+            vsys(VSYS_EXIT, (int64_t)a1, 0, 0, NULL, 0, NULL);
+        }
+        return shim_raw_syscall(nr, a1, a2, a3, a4, a5, a6);
+
+    case SYS_execve:
+    case SYS_execveat:
+        /* exec would shed the shim and escape the simulation entirely
+         * (the reference handles exec via managed re-spawn; future work) */
+        shim_warn("shadow-shim: execve escaping the simulation is blocked, "
+                  "failing ENOSYS\n");
+        return -ENOSYS;
+
     default:
         /* not ours after all: execute natively via the gadget */
         return shim_raw_syscall(nr, a1, a2, a3, a4, a5, a6);

@@ -87,6 +87,9 @@ VSYS_COND_WAIT = 58
 VSYS_COND_SIGNAL = 59
 VSYS_FORK = 60
 VSYS_WAITPID = 61
+VSYS_FUTEX_WAIT = 62
+VSYS_FUTEX_WAKE = 63
+VSYS_FUTEX_REQUEUE = 64
 
 # message kind for a new thread announcing itself on its own channel
 MSG_THREAD_START = 6
@@ -154,6 +157,10 @@ VSYS_NAMES = {
     VSYS_COND_SIGNAL: "futex_wake",
     VSYS_FORK: "fork",
     VSYS_WAITPID: "wait4",
+    # raw SYS_futex emulation: real strace shows one name for all ops
+    VSYS_FUTEX_WAIT: "futex",
+    VSYS_FUTEX_WAKE: "futex",
+    VSYS_FUTEX_REQUEUE: "futex",
 }
 
 

@@ -329,6 +329,15 @@ class ManagedProcess:
         self.mutexes: dict[int, "KMutex"] = {}
         self.conds: dict[int, "KCond"] = {}
         self.child_evt = File()  # notified whenever any of our children exits
+        # raw-futex wait queues (reference: per-host futex table,
+        # futex_table.c; here per address space, which is what private
+        # futexes actually key on): addr -> FIFO of waiting tids. One hub
+        # event source for the whole table so requeued waiters keep their
+        # listener (wake/requeue re-check every futex waiter; counts are
+        # tiny and order stays FIFO-deterministic).
+        self.futex_q: dict[int, list[int]] = {}
+        self.futex_woken: set[int] = set()
+        self.futex_hub = File()
 
     # ---- main-thread conveniences (tests + process-level call sites) ----
 
@@ -1059,6 +1068,107 @@ class NetKernel:
         proc._reply(0)
         return True
 
+    # --- raw futex (reference: futex.c, futex_table.c, syscall/futex.c) --
+    # The shim already performed the *uaddr == val check (race-free under
+    # strict serialization); the kernel owns per-address-space FIFO wait
+    # queues. All guest clocks serve the unix-epoch sim time, so absolute
+    # timeouts (monotonic or realtime) convert identically.
+
+    def _futex_remove(self, process: ManagedProcess, tid: int) -> None:
+        for addr, q in list(process.futex_q.items()):
+            if tid in q:
+                q.remove(tid)
+                if not q:
+                    del process.futex_q[addr]
+                break
+        process.futex_woken.discard(tid)
+
+    @staticmethod
+    def _futex_prune(process: ManagedProcess, q: "list[int]") -> None:
+        """Drop waiters whose thread died while queued so a wake is never
+        spent on a corpse (Linux only ever wakes live waiters)."""
+        live = {t.tid for t in process.threads if t.state != "exited"}
+        q[:] = [t for t in q if t in live]
+
+    def _sys_futex_wait(self, proc, msg):
+        process = proc.process
+        timeout_ns, mode = int(msg.a[2]), int(msg.a[3])
+        addr = int(msg.a[1])
+        tid = proc.tid
+        process.futex_q.setdefault(addr, []).append(tid)
+
+        def check() -> bool:
+            if tid in process.futex_woken:
+                process.futex_woken.discard(tid)
+                proc._reply(0)
+                return True
+            return False
+
+        timeout_at = None
+        if timeout_ns >= 0:
+            if mode == 0:  # relative
+                timeout_at = proc.now + timeout_ns
+            else:  # absolute on the unix-epoch sim clock
+                timeout_at = max(timeout_ns - SIM_START_UNIX_NS, self.now)
+
+        def on_timeout():
+            self._futex_remove(process, tid)
+            proc._reply(-ETIMEDOUT)
+
+        def on_interrupt():
+            self._futex_remove(process, tid)
+            proc._reply(-EINTR)
+
+        Waiter(
+            self,
+            proc,
+            [process.futex_hub],
+            check,
+            timeout_at=timeout_at,
+            on_timeout=on_timeout,
+            on_interrupt=on_interrupt,
+            restartable=False,
+        )
+        return False
+
+    def _sys_futex_wake(self, proc, msg):
+        process = proc.process
+        addr, maxn = int(msg.a[1]), int(msg.a[2])
+        q = process.futex_q.get(addr, [])
+        self._futex_prune(process, q)
+        n = min(max(maxn, 0), len(q))
+        for tid in q[:n]:  # FIFO wake order, like the reference's table
+            process.futex_woken.add(tid)
+        del q[:n]
+        if not q:
+            process.futex_q.pop(addr, None)
+        if n:
+            process.futex_hub.notify()
+        proc._reply(n)
+        return True
+
+    def _sys_futex_requeue(self, proc, msg):
+        process = proc.process
+        addr, nwake, nreq = int(msg.a[1]), int(msg.a[2]), int(msg.a[3])
+        addr2 = int(msg.a[5])
+        q = process.futex_q.get(addr, [])
+        self._futex_prune(process, q)
+        n = min(max(nwake, 0), len(q))
+        for tid in q[:n]:
+            process.futex_woken.add(tid)
+        del q[:n]
+        moved = 0
+        if nreq > 0 and q:
+            moved = min(nreq, len(q))
+            process.futex_q.setdefault(addr2, []).extend(q[:moved])
+            del q[:moved]
+        if not q:
+            process.futex_q.pop(addr, None)
+        if n:
+            process.futex_hub.notify()
+        proc._reply(n + moved)
+        return True
+
     # --- fork/wait (reference: process.rs spawn/fork + waitpid) ----------
 
     def _sys_fork(self, proc, msg):
@@ -1488,6 +1598,9 @@ class NetKernel:
     def _sys_exit(self, proc, msg):
         if proc.process.popen is None:  # forked: no Popen to report status
             proc.process.exit_code = int(msg.a[1])
+            # raw _exit skips the shim destructor's PROC_EXIT message, so
+            # stamp the waitpid status here (guest parents read it)
+            proc.process.wait_status = (int(msg.a[1]) & 0xFF) << 8
         proc._reply(0)
         return True
 
@@ -2872,6 +2985,9 @@ _DISPATCH = {
     I.VSYS_MUTEX_UNLOCK: NetKernel._sys_mutex_unlock,
     I.VSYS_COND_WAIT: NetKernel._sys_cond_wait,
     I.VSYS_COND_SIGNAL: NetKernel._sys_cond_signal,
+    I.VSYS_FUTEX_WAIT: NetKernel._sys_futex_wait,
+    I.VSYS_FUTEX_WAKE: NetKernel._sys_futex_wake,
+    I.VSYS_FUTEX_REQUEUE: NetKernel._sys_futex_requeue,
     I.VSYS_FORK: NetKernel._sys_fork,
     I.VSYS_WAITPID: NetKernel._sys_waitpid,
     I.VSYS_PAUSE: NetKernel._sys_pause,

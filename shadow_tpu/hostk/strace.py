@@ -32,7 +32,9 @@ class StraceFile:
         self._f = None
         if mode != "off":
             pathlib.Path(path).parent.mkdir(parents=True, exist_ok=True)
-            self._f = open(path, "w")
+            # line-buffered like real strace: a hung guest's trace shows
+            # exactly how far it got
+            self._f = open(path, "w", buffering=1)
 
     def log(
         self, now_ns: int, name: str, args: str, ret: "int | str", tid: "Optional[int]" = None

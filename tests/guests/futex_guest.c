@@ -1,0 +1,103 @@
+/* Raw-futex guest: exercises the kernel's SYS_futex emulation directly
+ * (syscall(SYS_futex, ...)) and through glibc semaphores (sem_wait/post
+ * issue raw futex, not interposed pthread symbols), plus a WAIT timeout
+ * and a raw fork-style clone. Prints sim-time measurements so the test
+ * can assert both semantics and determinism. */
+#define _GNU_SOURCE
+#include <errno.h>
+#include <linux/futex.h>
+#include <pthread.h>
+#include <semaphore.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <sys/syscall.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+static uint32_t word = 0;
+static sem_t sem_a, sem_b;
+static long pings = 0;
+
+static int64_t now_ns(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (int64_t)ts.tv_sec * 1000000000 + ts.tv_nsec;
+}
+
+static long futex(uint32_t *uaddr, int op, uint32_t val,
+                  const struct timespec *ts) {
+    return syscall(SYS_futex, uaddr, op, val, ts, NULL, 0);
+}
+
+static void *waiter_thread(void *arg) {
+    (void)arg;
+    int64_t t0 = now_ns();
+    long r = futex(&word, FUTEX_WAIT, 0, NULL);
+    int64_t waited = now_ns() - t0;
+    printf("futex_wait ret=%ld val=%u waited_ms=%lld\n", r, word,
+           (long long)(waited / 1000000));
+    return NULL;
+}
+
+static void *pong_thread(void *arg) {
+    (void)arg;
+    for (int i = 0; i < 5; i++) {
+        sem_wait(&sem_a);
+        pings++;
+        sem_post(&sem_b);
+    }
+    return NULL;
+}
+
+int main(void) {
+    /* 1. raw FUTEX_WAIT/WAKE across threads with simulated sleep */
+    pthread_t th;
+    pthread_create(&th, NULL, waiter_thread, NULL);
+    struct timespec d = {0, 50 * 1000000}; /* 50 ms sim */
+    nanosleep(&d, NULL);
+    __atomic_store_n(&word, 7, __ATOMIC_SEQ_CST);
+    long woken = futex(&word, FUTEX_WAKE, 1, NULL);
+    pthread_join(th, NULL);
+    printf("woken=%ld\n", woken);
+
+    /* 2. glibc semaphore ping-pong (sem_wait/post -> raw futex) */
+    sem_init(&sem_a, 0, 0);
+    sem_init(&sem_b, 0, 0);
+    pthread_t pp;
+    pthread_create(&pp, NULL, pong_thread, NULL);
+    for (int i = 0; i < 5; i++) {
+        sem_post(&sem_a);
+        sem_wait(&sem_b);
+    }
+    pthread_join(pp, NULL);
+    printf("pings=%ld\n", pings);
+
+    /* 3. FUTEX_WAIT with a relative timeout: must time out on sim time */
+    uint32_t never = 0;
+    struct timespec to = {0, 30 * 1000000}; /* 30 ms */
+    int64_t t0 = now_ns();
+    long r = futex(&never, FUTEX_WAIT, 0, &to);
+    int64_t waited = now_ns() - t0;
+    printf("timeout ret=%ld errno_ok=%d waited_ms=%lld\n", r,
+           r == -1 && errno == ETIMEDOUT, (long long)(waited / 1000000));
+
+    /* 4. value-mismatch fast path: EAGAIN without blocking */
+    uint32_t eleven = 11;
+    r = futex(&eleven, FUTEX_WAIT, 12, NULL);
+    printf("eagain ret=%ld errno_ok=%d\n", r, r == -1 && errno == EAGAIN);
+
+    /* 5. raw fork-style clone (what glibc fork() emits) routes into the
+     * managed fork path: the child must be simulated, not escaped */
+    long child = syscall(SYS_clone, (long)SIGCHLD, 0L, 0L, 0L, 0L);
+    if (child == 0) {
+        printf("clone child pid=%d\n", (int)getpid());
+        fflush(stdout);
+        _exit(42);
+    }
+    int status = 0;
+    waitpid((pid_t)child, &status, 0);
+    printf("clone parent: child=%ld status=%d\n", child > 0 ? 1L : 0L,
+           WIFEXITED(status) ? WEXITSTATUS(status) : -1);
+    return 0;
+}

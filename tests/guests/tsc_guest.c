@@ -1,0 +1,34 @@
+/* rdtsc/rdtscp guest: hardware cycle counters must serve *simulated*
+ * time (1 GHz nominal: cycles == sim ns), so a timed sleep measured with
+ * rdtsc sees the simulated duration, deterministically. */
+#include <stdint.h>
+#include <stdio.h>
+#include <time.h>
+
+static inline uint64_t rdtsc(void) {
+    uint32_t lo, hi;
+    __asm__ volatile("rdtsc" : "=a"(lo), "=d"(hi));
+    return ((uint64_t)hi << 32) | lo;
+}
+
+static inline uint64_t rdtscp(uint32_t *aux) {
+    uint32_t lo, hi, cx;
+    __asm__ volatile("rdtscp" : "=a"(lo), "=d"(hi), "=c"(cx));
+    *aux = cx;
+    return ((uint64_t)hi << 32) | lo;
+}
+
+int main(void) {
+    uint64_t t0 = rdtsc();
+    struct timespec d = {0, 25 * 1000000}; /* 25 ms sim */
+    nanosleep(&d, NULL);
+    uint32_t aux = 77;
+    uint64_t t1 = rdtscp(&aux);
+    printf("tsc_delta_ms=%llu aux=%u\n",
+           (unsigned long long)((t1 - t0) / 1000000), aux);
+
+    /* back-to-back reads are monotone non-decreasing */
+    uint64_t a = rdtsc(), b = rdtsc();
+    printf("monotone=%d\n", b >= a);
+    return 0;
+}
