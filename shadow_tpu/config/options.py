@@ -114,6 +114,8 @@ class ExperimentalOptions:
     max_iters_per_round: int = 1_000_000
     # managed-process options (reference: configuration.rs:298-455)
     strace_logging_mode: str = "standard"  # "off" | "standard" | "deterministic"
+    use_tcp_sack: bool = True  # SACK scoreboard retransmission
+    use_tcp_autotune: bool = True  # receive-window/send-buffer autotuning
     use_pcap: bool = False
     syscall_latency_ns: int = 1_000
     vdso_latency_ns: int = 10
@@ -142,6 +144,8 @@ class ExperimentalOptions:
             "max_iters_per_round",
             "strace_logging_mode",
             "use_pcap",
+            "use_tcp_sack",
+            "use_tcp_autotune",
         ):
             if k in d:
                 setattr(out, k, d.pop(k))

@@ -578,6 +578,8 @@ class NetKernel:
         bw_down_bits: "Optional[list[int]]" = None,
         bootstrap_end_ns: int = 0,
         window_ns: "Optional[int]" = None,
+        tcp_sack: bool = True,
+        tcp_autotune: bool = True,
     ):
         self.tables = tables
         self.lat = np.asarray(tables.lat_ns)
@@ -587,6 +589,12 @@ class NetKernel:
         self.vdso_latency_ns = vdso_latency_ns
         self.max_unapplied_ns = max_unapplied_ns
         self.strace_mode = strace_mode
+        # TCP behavior knobs (reference: experimental socket options,
+        # configuration.rs:298-455; SACK tally tcp_retransmit_tally.cc,
+        # buffer autotuning tcp.c:498-655)
+        self.tcp_sack = tcp_sack
+        self.tcp_autotune = tcp_autotune
+        self.tcp_retransmits = 0  # aggregated loss-recovery resends
         self.data_dir = pathlib.Path(data_dir)
         if self.data_dir.exists():
             shutil.rmtree(self.data_dir)

@@ -69,8 +69,10 @@ FLAG_FIN = 4
 FLAG_RST = 8
 
 MSS = 1460
-RECV_WND = 256 * 1024  # matches transport/tcp.py TcpConfig.rcv_wnd
-SND_BUF = 256 * 1024
+RECV_WND = 256 * 1024  # initial advertised window; autotunes upward
+RECV_WND_MAX = 4 * 1024 * 1024  # autotune ceiling (tcp.c:498-655 rmem cap)
+SND_BUF = 256 * 1024  # initial send buffer; autotunes with cwnd
+SND_BUF_MAX = 4 * 1024 * 1024
 INIT_CWND_SEGS = 10
 RTO_INIT_NS = NS_PER_SEC
 RTO_MIN_NS = 200 * NS_PER_MS
@@ -92,9 +94,14 @@ class Segment:
     ack: int
     wnd: int
     payload: bytes = b""
+    # selective-ACK blocks: up to 4 (start, end) received ranges above the
+    # cumulative ACK (the reference answers retransmission queries from a
+    # C++ range tally, tcp_retransmit_tally.cc)
+    sack: "tuple" = ()
 
     def wire_len(self) -> int:
-        return len(self.payload) + HEADER_BYTES
+        opt = 4 + 8 * len(self.sack) if self.sack else 0
+        return len(self.payload) + HEADER_BYTES + opt
 
     def flag_str(self) -> str:
         s = "".join(
@@ -141,6 +148,19 @@ class TcpSocket(File):
         self.dupacks = 0
         self.in_recovery = False
         self.recovery_point = 0
+        # SACK scoreboard: sorted disjoint (start, end) ranges the peer
+        # holds above snd_una (tcp_retransmit_tally.cc's acked-range set)
+        self.sacked: "list[tuple[int, int]]" = []
+        self._last_rexmit = -1  # first hole retransmitted this recovery
+        self.retransmits = 0  # stats: segments re-sent (loss recovery + RTO)
+        self.snd_max = 0  # highest seq+len ever put on the wire
+        # buffer autotuning (tcp.c:498-655): both caps grow toward 2xBDP
+        self.rcv_wnd_cap = RECV_WND
+        self.snd_buf_cap = SND_BUF
+        self.rtt_est = 0  # receiver-side RTT estimate (handshake-timed)
+        self._conn_t0 = 0
+        self._at_t0 = 0
+        self._at_bytes = 0
 
         # receive side (tcp.c `receive` block)
         self.irs = 0
@@ -208,7 +228,7 @@ class TcpSocket(File):
         if self.error:
             return True
         if self.state in (ESTABLISHED, CLOSE_WAIT):
-            return len(self.snd_buf) < SND_BUF
+            return len(self.snd_buf) < self.snd_buf_cap
         return self.state in (CLOSED,) and self.error != 0
 
     def err(self) -> bool:
@@ -250,6 +270,7 @@ class TcpSocket(File):
         self.snd_una = self.iss
         self.snd_nxt = self.iss
         self._set_state(SYN_SENT)
+        self._conn_t0 = self._k().now
         self._tx(FLAG_SYN, seq=self.snd_nxt)
         self.snd_nxt += 1  # SYN consumes a sequence number
         self._rto_arm()
@@ -270,7 +291,7 @@ class TcpSocket(File):
             if self.state in (SYN_SENT, SYN_RCVD):
                 return -EAGAIN  # not yet connected (blocking layer waits)
             return -EPIPE
-        space = SND_BUF - len(self.snd_buf)
+        space = self.snd_buf_cap - len(self.snd_buf)
         if space <= 0:
             return -EAGAIN
         take = data[:space]
@@ -351,7 +372,7 @@ class TcpSocket(File):
 
     def _adv_wnd(self) -> int:
         ooo_bytes = sum(len(v) for v in self.ooo.values())
-        return max(0, RECV_WND - len(self.rcv_buf) - ooo_bytes)
+        return max(0, self.rcv_wnd_cap - len(self.rcv_buf) - ooo_bytes)
 
     def _flight(self) -> int:
         return self.snd_nxt - self.snd_una - (
@@ -462,6 +483,8 @@ class TcpSocket(File):
         self.cwnd = MSS
         self.in_recovery = False
         self.dupacks = 0
+        self.sacked = []  # conservative: forget SACK state across RTO
+        self._last_rexmit = -1
         self.snd_nxt = self.snd_una  # go-back-N rewind, like the device tier
         self.ts_seq = None  # Karn: no sample across retransmit
         self.rto = min(self.rto * 2, RTO_MAX_NS)
@@ -482,7 +505,14 @@ class TcpSocket(File):
 
     # --- wire -------------------------------------------------------------
 
-    def _tx(self, flags: int, seq: int, payload: bytes = b"") -> None:
+    def _tx(self, flags: int, seq: int, payload: bytes = b"", sack: "tuple" = ()) -> None:
+        if payload or (flags & FLAG_FIN):
+            end = seq + len(payload) + (1 if flags & FLAG_FIN else 0)
+            if seq < self.snd_max:
+                self.retransmits += 1
+                self.host.kernel.tcp_retransmits += 1
+            if end > self.snd_max:
+                self.snd_max = end
         seg = Segment(
             src_ip=self.local_ip or self.host.ip,
             src_port=self.local_port or self.bound_port,
@@ -493,8 +523,38 @@ class TcpSocket(File):
             ack=self.rcv_nxt if (flags & FLAG_ACK) else 0,
             wnd=self._adv_wnd(),
             payload=payload,
+            sack=sack,
         )
         self.host.kernel.send_segment(self.host, seg)
+
+    def _sack_blocks(self) -> "tuple":
+        """Receiver: up to 4 merged out-of-order ranges above rcv_nxt."""
+        if not self.ooo or not getattr(self._k(), "tcp_sack", True):
+            return ()
+        ranges: "list[tuple[int, int]]" = []
+        for sq, pl in sorted(self.ooo.items()):
+            e = sq + len(pl)
+            if ranges and sq <= ranges[-1][1]:
+                if e > ranges[-1][1]:
+                    ranges[-1] = (ranges[-1][0], e)
+            else:
+                ranges.append((sq, e))
+        return tuple(ranges[:4])
+
+    def _sack_update(self, blocks: "tuple") -> None:
+        """Sender: merge the peer's SACK blocks into the scoreboard."""
+        merged = self.sacked + [
+            (max(s, self.snd_una), e) for (s, e) in blocks if e > self.snd_una
+        ]
+        merged.sort()
+        out: "list[tuple[int, int]]" = []
+        for s_, e_ in merged:
+            if out and s_ <= out[-1][1]:
+                if e_ > out[-1][1]:
+                    out[-1] = (out[-1][0], e_)
+            else:
+                out.append((s_, e_))
+        self.sacked = out[:32]
 
     # --- receive engine (tcp.c:2006-2372 _tcp_processPacket) --------------
 
@@ -521,6 +581,8 @@ class TcpSocket(File):
                 self.peer_wnd = seg.wnd
                 self.backoff = 0
                 self._rtt_update(max(k.now - self.ts_time, 1) if self.ts_time else RTO_MIN_NS)
+                if self._conn_t0:
+                    self.rtt_est = max(k.now - self._conn_t0, 1)
                 self._set_state(ESTABLISHED)
                 self._tx(FLAG_ACK, seq=self.snd_nxt)
                 self._rto_cancel()
@@ -535,6 +597,8 @@ class TcpSocket(File):
             if f_ack and seg.ack == self.iss + 1:
                 self.snd_una = seg.ack
                 self.peer_wnd = seg.wnd
+                if self._conn_t0:
+                    self.rtt_est = max(k.now - self._conn_t0, 1)
                 self._rto_cancel()
                 self._set_state(ESTABLISHED)
                 if self.parent is not None:
@@ -553,6 +617,8 @@ class TcpSocket(File):
             self.peer_wnd = seg.wnd
             if self.peer_wnd > 0:
                 self.persist_deadline = None
+            if seg.sack:
+                self._sack_update(seg.sack)
             if seg.ack > self.snd_una:
                 acked = seg.ack - self.snd_una
                 data_acked = acked
@@ -563,6 +629,7 @@ class TcpSocket(File):
                 self.snd_una = seg.ack
                 if self.snd_nxt < self.snd_una:
                     self.snd_nxt = self.snd_una
+                self.sacked = [r for r in self.sacked if r[1] > self.snd_una]
                 self.backoff = 0
                 self.dupacks = 0
                 if self.ts_seq is not None and seg.ack > self.ts_seq:
@@ -571,6 +638,7 @@ class TcpSocket(File):
                 if self.in_recovery:
                     if seg.ack >= self.recovery_point:
                         self.in_recovery = False
+                        self._last_rexmit = -1  # recovery over: marks expire
                         self.cwnd = self.ssthresh
                     else:  # partial ack: retransmit next hole
                         self._retransmit_one()
@@ -578,6 +646,13 @@ class TcpSocket(File):
                     self.cwnd += min(acked, MSS)  # slow start
                 else:
                     self.cwnd += max(MSS * MSS // self.cwnd, 1)  # CA
+                # send-buffer autotune: track 2x the congestion window so
+                # the app can keep the pipe full (tcp.c:498-655 wmem side)
+                if (
+                    getattr(k, "tcp_autotune", True)
+                    and 2 * self.cwnd > self.snd_buf_cap
+                ):
+                    self.snd_buf_cap = min(2 * self.cwnd, SND_BUF_MAX)
                 if self._flight() > 0 or (self.fin_seq is not None and not self.fin_acked):
                     self._rto_arm()
                 else:
@@ -600,6 +675,10 @@ class TcpSocket(File):
                     self._retransmit_one()
                 elif self.in_recovery:
                     self.cwnd += MSS
+                    if self.sacked:
+                        # march one hole per incoming ACK (RFC 6675-style
+                        # pacing; the tally answers "what is lost")
+                        self._retransmit_one()
                     self._flush()
             self._flush()
 
@@ -631,10 +710,26 @@ class TcpSocket(File):
                             self._set_state(CLOSING)
                     elif self.state == FIN_WAIT_2:
                         self._set_state(TIME_WAIT)
+            if advanced and getattr(k, "tcp_autotune", True):
+                # receive-window autotune: measure delivered bytes per RTT
+                # and track 2x that (tcp.c:498-655 rmem side); the RTT is
+                # the sender-side estimate when we have one, else the
+                # handshake-timed estimate
+                self._at_bytes += len(seg.payload)
+                rtt = self.srtt or self.rtt_est
+                if rtt > 0:
+                    if self._at_t0 == 0:
+                        self._at_t0 = k.now
+                    elif k.now - self._at_t0 >= rtt:
+                        target = 2 * self._at_bytes
+                        if target > self.rcv_wnd_cap:
+                            self.rcv_wnd_cap = min(target, RECV_WND_MAX)
+                        self._at_t0 = k.now
+                        self._at_bytes = 0
             if seg.payload or f_fin:
                 # ACK everything that arrived (immediate-ACK policy; the
                 # reference's delayed ACK is a latency optimization only)
-                self._tx(FLAG_ACK, seq=self.snd_nxt)
+                self._tx(FLAG_ACK, seq=self.snd_nxt, sack=self._sack_blocks())
             if advanced:
                 self.notify()
 
@@ -649,11 +744,36 @@ class TcpSocket(File):
             self._tx(FLAG_ACK, seq=self.snd_nxt)  # re-ACK a retransmitted FIN
 
     def _retransmit_one(self) -> None:
-        off = 0
-        n = min(MSS, len(self.snd_buf))
-        if n > 0:
-            payload = bytes(self.snd_buf[off : off + n])
-            self._tx(FLAG_ACK, seq=self.snd_una, payload=payload)
+        """Retransmit the first SACK hole (the scoreboard's answer to
+        "what should be retransmitted", tcp_retransmit_tally.cc); with no
+        SACK information this is plain NewReno resend-from-snd_una."""
+        data_end = self.snd_una + len(self.snd_buf)
+        flight_end = min(self.snd_nxt, data_end)
+        start = self.snd_una
+        hole = None
+        if self.sacked:
+            # a hole is only "lost" when a SACK block sits above it
+            # (RFC 6675; un-SACKed data above the highest block is merely
+            # in flight and must not be re-sent)
+            for s_, e_ in self.sacked:
+                if e_ <= start:
+                    continue
+                if s_ >= flight_end:
+                    break
+                if start < s_:
+                    if start > self._last_rexmit:
+                        hole = (start, min(s_, start + MSS, flight_end))
+                        break
+                    start = s_  # already resent; look past this block
+                start = max(start, e_)
+        elif start < flight_end and start > self._last_rexmit:
+            # no SACK information: classic resend-from-snd_una
+            hole = (start, min(start + MSS, flight_end))
+        if hole is not None:
+            off = hole[0] - self.snd_una
+            payload = bytes(self.snd_buf[off : hole[1] - self.snd_una])
+            self._last_rexmit = hole[0]
+            self._tx(FLAG_ACK, seq=hole[0], payload=payload)
         elif self.fin_seq is not None and not self.fin_acked:
             self._tx(FLAG_ACK | FLAG_FIN, seq=self.fin_seq)
         self._rto_arm()
@@ -689,6 +809,7 @@ class TcpSocket(File):
         child.irs = seg.seq
         child.rcv_nxt = seg.seq + 1
         child.state = SYN_RCVD
+        child._conn_t0 = self.host.kernel.now
         self.syn_children[key] = child
         self.host.add_tcp_conn(child)
         child._tx(FLAG_SYN | FLAG_ACK, seq=child.iss)

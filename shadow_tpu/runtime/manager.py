@@ -336,6 +336,8 @@ class Manager:
             bw_down_bits=[max(h.bw_down_bits, 0) for h in self.hosts],
             bootstrap_end_ns=cfgo.general.bootstrap_end_time_ns,
             window_ns=runahead,
+            tcp_sack=cfgo.experimental.use_tcp_sack,
+            tcp_autotune=cfgo.experimental.use_tcp_autotune,
         )
         for h in self.hosts:
             for p in h.spec.processes:

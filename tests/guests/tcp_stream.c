@@ -1,0 +1,109 @@
+/* One-way TCP streaming guest (no echo lockstep, no echo deadlock):
+ *   tcp_stream serve <port>                — accept one conn, read to EOF,
+ *                                            print bytes + elapsed
+ *   tcp_stream send <host> <port> <nbytes> — stream nbytes as fast as the
+ *                                            socket accepts, half-close,
+ *                                            wait for the peer's EOF
+ * Exercises real window/congestion dynamics: the sender is purely
+ * window/cwnd-limited, which the chunk-lockstep echo client never is. */
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+static int64_t now_ns(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    return (int64_t)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+static int serve(int port) {
+    int ls = socket(AF_INET, SOCK_STREAM, 0);
+    struct sockaddr_in a = {0};
+    a.sin_family = AF_INET;
+    a.sin_port = htons((uint16_t)port);
+    if (bind(ls, (struct sockaddr *)&a, sizeof(a)) != 0 || listen(ls, 4) != 0) {
+        perror("listen");
+        return 1;
+    }
+    int fd = accept(ls, NULL, NULL);
+    if (fd < 0) {
+        perror("accept");
+        return 1;
+    }
+    int64_t t0 = now_ns();
+    char buf[16384];
+    long total = 0, errors = 0;
+    for (;;) {
+        ssize_t r = read(fd, buf, sizeof(buf));
+        if (r < 0) {
+            perror("read");
+            return 1;
+        }
+        if (r == 0)
+            break;
+        for (ssize_t i = 0; i < r; i++)
+            if (buf[i] != (char)((total + i) % 251))
+                errors++;
+        total += r;
+    }
+    int64_t t1 = now_ns();
+    printf("received %ld bytes, %ld errors, %lld us\n", total, errors,
+           (long long)((t1 - t0) / 1000));
+    close(fd);
+    close(ls);
+    return errors == 0 ? 0 : 1;
+}
+
+static int send_stream(const char *host, const char *port, long nbytes) {
+    struct addrinfo hints = {0}, *res;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    if (getaddrinfo(host, port, &hints, &res) != 0) {
+        fprintf(stderr, "getaddrinfo failed\n");
+        return 1;
+    }
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0 || connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+        perror("connect");
+        return 1;
+    }
+    freeaddrinfo(res);
+    int64_t t0 = now_ns();
+    char chunk[16384];
+    long sent = 0;
+    while (sent < nbytes) {
+        long n = nbytes - sent < (long)sizeof(chunk) ? nbytes - sent
+                                                     : (long)sizeof(chunk);
+        for (long i = 0; i < n; i++)
+            chunk[i] = (char)((sent + i) % 251);
+        ssize_t w = write(fd, chunk, n);
+        if (w < 0) {
+            perror("write");
+            return 1;
+        }
+        sent += w;
+    }
+    shutdown(fd, SHUT_WR);
+    char b;
+    while (read(fd, &b, 1) > 0) /* wait for the server's close */
+        ;
+    int64_t t1 = now_ns();
+    printf("streamed %ld bytes, %lld us\n", sent, (long long)((t1 - t0) / 1000));
+    close(fd);
+    return 0;
+}
+
+int main(int argc, char **argv) {
+    if (argc >= 3 && strcmp(argv[1], "serve") == 0)
+        return serve(atoi(argv[2]));
+    if (argc >= 5 && strcmp(argv[1], "send") == 0)
+        return send_stream(argv[2], argv[3], atol(argv[4]));
+    return 2;
+}

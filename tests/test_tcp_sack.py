@@ -1,0 +1,117 @@
+"""SACK scoreboard + buffer autotuning in the managed TCP stack
+(reference: tcp_retransmit_tally.cc lost-range answering; buffer
+autotuning tcp.c:498-655). Paired runs with the features toggled prove
+the claims directly: SACK retransmits measurably less under loss at
+equal goodput, and autotuning closes the window limit on high-BDP paths."""
+
+import pathlib
+import subprocess
+
+import pytest
+
+from shadow_tpu.graph import NetworkGraph, compute_routing
+from shadow_tpu.hostk.kernel import NetKernel, ProcessSpec
+from shadow_tpu.simtime import NS_PER_MS, NS_PER_SEC
+from tests.topo import two_node_graph
+
+GUESTS = pathlib.Path(__file__).parent / "guests"
+
+
+@pytest.fixture(scope="module")
+def bins(tmp_path_factory):
+    out = tmp_path_factory.mktemp("guests")
+    built = {}
+    for name in ("tcp_stream",):
+        dst = out / name
+        subprocess.run(["cc", "-O2", "-o", str(dst), str(GUESTS / f"{name}.c")], check=True)
+        built[name] = str(dst)
+    return built
+
+
+def _run_echo(tmp_path, bins, sub, *, nbytes, graph, sack=True, autotune=True,
+              bw=(0, 0, 0, 0), seed=1, until_s=120):
+    tables = compute_routing(graph).with_hosts([0, 1])
+    k = NetKernel(
+        tables,
+        host_names=["server", "client"],
+        host_nodes=[0, 1],
+        seed=seed,
+        data_dir=tmp_path / sub,
+        tcp_sack=sack,
+        tcp_autotune=autotune,
+        bw_up_bits=[bw[0], bw[1]],
+        bw_down_bits=[bw[2], bw[3]],
+    )
+    srv = k.add_process(
+        ProcessSpec(host="server", args=[bins["tcp_stream"], "serve", "8080"])
+    )
+    cli = k.add_process(
+        ProcessSpec(
+            host="client",
+            args=[bins["tcp_stream"], "send", "server", "8080", str(nbytes)],
+            start_ns=100 * NS_PER_MS,
+        )
+    )
+    try:
+        k.run(until_s * NS_PER_SEC)
+    finally:
+        k.shutdown()
+    return k, srv, cli
+
+
+def srv_out(k) -> bytes:
+    return k.procs[0].stdout()
+
+
+def _done_time_ns(k) -> int:
+    """Sim time of the last TCP segment delivery (transfer completion)."""
+    times = [t for t, line in k.event_log if line.startswith("tcp ")]
+    return max(times) if times else 0
+
+
+def test_sack_fewer_retransmits_equal_goodput(tmp_path, bins):
+    """2% loss each way: SACK answers 'what is lost' precisely, so it
+    re-sends only holes; NewReno re-sends blindly from snd_una."""
+    g = two_node_graph(10, 0.03)
+    k_nr, _, cli_nr = _run_echo(
+        tmp_path, bins, "newreno", nbytes=400_000, graph=g, sack=False, seed=3
+    )
+    k_sk, _, cli_sk = _run_echo(
+        tmp_path, bins, "sack", nbytes=400_000, graph=g, sack=True, seed=3
+    )
+    assert b"received 400000 bytes, 0 errors" in srv_out(k_nr)
+    assert b"received 400000 bytes, 0 errors" in srv_out(k_sk)
+    assert k_sk.tcp_retransmits < k_nr.tcp_retransmits, (
+        f"sack={k_sk.tcp_retransmits} newreno={k_nr.tcp_retransmits}"
+    )
+    # and it recovers faster, not just leaner
+    assert _done_time_ns(k_sk) < _done_time_ns(k_nr)
+
+
+def test_autotune_tracks_bdp(tmp_path, bins):
+    """Long-latency path (100 ms one-way, unshaped): throughput is purely
+    window/RTT, so the 256 KB initial window caps goodput without
+    autotuning; with it, the measured per-RTT delivery doubles the window
+    toward the cap and the transfer finishes much sooner."""
+    g = two_node_graph(100, 0.0)
+    k_off, _, cli_off = _run_echo(
+        tmp_path, bins, "fixed", nbytes=8_000_000, graph=g, autotune=False,
+        until_s=300,
+    )
+    k_on, _, cli_on = _run_echo(
+        tmp_path, bins, "auto", nbytes=8_000_000, graph=g, autotune=True,
+        until_s=300,
+    )
+    assert b"received 8000000 bytes, 0 errors" in srv_out(k_off)
+    assert b"received 8000000 bytes, 0 errors" in srv_out(k_on)
+    t_off, t_on = _done_time_ns(k_off), _done_time_ns(k_on)
+    assert t_on < t_off * 0.7, f"autotune {t_on/1e9:.2f}s vs fixed {t_off/1e9:.2f}s"
+
+
+def test_sack_run_twice_deterministic(tmp_path, bins):
+    g = two_node_graph(10, 0.03)
+    a = _run_echo(tmp_path, bins, "d1", nbytes=200_000, graph=g, seed=5)
+    b = _run_echo(tmp_path, bins, "d2", nbytes=200_000, graph=g, seed=5)
+    assert a[2].stdout() == b[2].stdout()
+    assert a[0].event_log == b[0].event_log
+    assert a[0].tcp_retransmits == b[0].tcp_retransmits
