@@ -113,6 +113,13 @@ static const int TRAPPED[] = {
      * emulated against the signal frame so the change survives sigreturn */
     14 /*rt_sigprocmask*/,
     231 /*exit_group*/, /* raw _exit must record the status in-sim */
+    /* deterministic system-state views + virtual-fd routing */
+    203 /*sched_setaffinity*/, 204 /*sched_getaffinity*/,
+    97 /*getrlimit*/,  160 /*setrlimit*/,  302 /*prlimit64*/,
+    157 /*prctl*/,     17 /*pread64*/,     18 /*pwrite64*/,
+    262 /*newfstatat*/, 332 /*statx*/,     100 /*times*/,
+    98 /*getrusage*/,  309 /*getcpu*/,
+    307 /*sendmmsg*/,  299 /*recvmmsg*/,
 };
 #define NTRAPPED ((int)(sizeof(TRAPPED) / sizeof(TRAPPED[0])))
 

@@ -128,6 +128,8 @@ enum {
     VSYS_FUTEX_WAKE = 63,    /* a[1]=addr a[2]=max -> n woken */
     VSYS_FUTEX_REQUEUE = 64, /* a[1]=addr a[2]=nwake a[3]=nrequeue
                                 a[5]=addr2 -> n woken + requeued */
+    VSYS_SIGMASK = 65,       /* a[1]=new 64-bit blocked mask (kernel-side
+                                delivery honors it; syscall/signal.c) */
 };
 
 typedef struct {

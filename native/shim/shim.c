@@ -75,6 +75,49 @@ static long raw7(long nr, long a1, long a2, long a3, long a4, long a5,
 /* seccomp.c: the interrupted user context of the SIGSYS being handled */
 extern __thread void *shim_sigsys_uctx;
 
+/* Deterministic resource limits: every guest sees the same values
+ * regardless of the operator's shell limits (reference startup checks
+ * normalize rlimits the same way, main.rs:61 run_shadow checks). */
+static struct {
+    uint64_t cur, max;
+} g_rlimits[16];
+static int g_rlimits_init = 0;
+
+static void rlimits_init(void) {
+    for (int i = 0; i < 16; i++) {
+        g_rlimits[i].cur = (uint64_t)-1; /* RLIM_INFINITY */
+        g_rlimits[i].max = (uint64_t)-1;
+    }
+    g_rlimits[7].cur = 1024; /* RLIMIT_NOFILE */
+    g_rlimits[7].max = 1048576;
+    g_rlimits[3].cur = 8u << 20; /* RLIMIT_STACK */
+    g_rlimits_init = 1;
+}
+
+static long shim_rlimit_get(int res, void *out) {
+    if (res < 0 || res >= 16 || !out)
+        return -EINVAL;
+    if (!g_rlimits_init)
+        rlimits_init();
+    uint64_t *o = (uint64_t *)out;
+    o[0] = g_rlimits[res].cur;
+    o[1] = g_rlimits[res].max;
+    return 0;
+}
+
+static long shim_rlimit_set(int res, const void *in) {
+    if (res < 0 || res >= 16 || !in)
+        return -EINVAL;
+    if (!g_rlimits_init)
+        rlimits_init();
+    const uint64_t *i = (const uint64_t *)in;
+    if (i[0] > i[1])
+        return -EINVAL;
+    g_rlimits[res].cur = i[0];
+    g_rlimits[res].max = i[1];
+    return 0;
+}
+
 /* kernel clone_args layout (clone3 ABI) — declared locally to avoid the
  * <linux/sched.h> vs <sched.h> macro collision */
 struct shim_clone_args {
@@ -199,6 +242,11 @@ static __thread int t_native_clone_ok = 0;
  * kernel, so routing them into the simulated futex table would park the
  * guest forever. Guest-application futexes never run under this flag. */
 static __thread int t_native_futex_ok = 0;
+/* set once a thread has told the kernel it is gone (VSYS_THREAD_EXIT):
+ * the kernel no longer listens on its channel, so any further simulated
+ * call from glibc's thread-death cleanup would park forever. Post-exit,
+ * vsys becomes a no-op and trapped syscalls run natively. */
+static __thread int t_detached_from_sim = 0;
 static int g_main_exited = 0; /* main pthread_exit'ed; kernel-side it is gone */
 static int g_exit_sent = 0;  /* VSYS_EXIT already recorded for this process */
 
@@ -238,6 +286,8 @@ static void ipc_call(ShimMsg *m) {
 
 static int64_t vsys_ex(int code, int64_t a1, int64_t a2, int64_t a3, int64_t a5,
                        const void *out_buf, uint32_t out_len, ShimMsg *reply) {
+    if (t_detached_from_sim)
+        return 0; /* thread already exited the simulation */
     ShimMsg m;
     memset(&m, 0, offsetof(ShimMsg, buf));
     m.kind = SHIM_MSG_SYSCALL;
@@ -551,6 +601,7 @@ static void *thread_trampoline(void *p) {
     void *ret = tb.fn(tb.arg);
     vsys(VSYS_THREAD_EXIT, (int64_t)(intptr_t)ret, 0, 0, NULL, 0, NULL);
     t_native_futex_ok = 1; /* glibc thread-death cleanup runs native */
+    t_detached_from_sim = 1; /* the kernel dropped this channel */
     unregister_shm_map((void *)t_shm); /* reclaim the table slot */
     return ret;
 }
@@ -566,6 +617,8 @@ void pthread_exit(void *retval) {
         if (t_tid == 0)
             g_main_exited = 1; /* destructor must not expect a reply */
         vsys(VSYS_THREAD_EXIT, (int64_t)(intptr_t)retval, 0, 0, NULL, 0, NULL);
+        if (t_tid != 0)
+            t_detached_from_sim = 1; /* worker: kernel dropped the channel */
     }
     t_native_futex_ok = 1; /* glibc thread-death cleanup runs native */
     real(retval);
@@ -2232,7 +2285,8 @@ void RAND_add(const void *buf, int num, double entropy) {
 long shim_route_syscall(long nr, long a1, long a2, long a3, long a4, long a5,
                         long a6) {
     (void)a6;
-    if (!g_active) /* trap raced a teardown: execute natively */
+    if (!g_active || t_detached_from_sim)
+        /* teardown race, or a thread past its simulated exit: native */
         return shim_raw_syscall(nr, a1, a2, a3, a4, a5, a6);
     switch (nr) {
     case SYS_read:
@@ -2549,6 +2603,13 @@ long shim_route_syscall(long nr, long a1, long a2, long a3, long a4, long a5,
              * SIGSEGV must not turn rdtsc into a forced kill) */
             nm &= ~((1ULL << (SIGSYS - 1)) | (1ULL << (SIGSEGV - 1)));
             memcpy(&uc->uc_sigmask, &nm, 8);
+            /* tell the kernel so simulated delivery honors the mask — but
+             * only from a thread that owns a channel (a clone child runs
+             * glibc's mask-restore before our trampoline attaches one) */
+            if (t_tid != 0 ||
+                shim_raw_syscall(SYS_gettid, 0L, 0L, 0L, 0L, 0L, 0L) ==
+                    shim_raw_syscall(SYS_getpid, 0L, 0L, 0L, 0L, 0L, 0L))
+                vsys(VSYS_SIGMASK, (int64_t)nm, 0, 0, NULL, 0, NULL);
         }
         return 0;
     }
@@ -2556,6 +2617,138 @@ long shim_route_syscall(long nr, long a1, long a2, long a3, long a4, long a5,
     case SYS_vfork:
         shim_warn("shadow-shim: vfork is not simulated, failing ENOSYS\n");
         return -ENOSYS;
+    case SYS_sched_getaffinity: {
+        /* deterministic topology: every guest sees exactly one CPU
+         * (reference pins managed threads; a stable view keeps
+         * nproc-dependent guest behavior replayable) */
+        size_t len = (size_t)a2;
+        if (len < 8)
+            return -EINVAL;
+        memset((void *)a3, 0, len);
+        *(uint64_t *)a3 = 1; /* CPU 0 */
+        return 8;
+    }
+    case SYS_sched_setaffinity:
+        return 0; /* accepted and ignored: placement is simulated */
+
+    case SYS_getrlimit:
+        return shim_rlimit_get((int)a1, (void *)a2);
+    case SYS_setrlimit:
+        return shim_rlimit_set((int)a1, (const void *)a2);
+    case SYS_prlimit64: {
+        if (a1 != 0 && (pid_t)a1 != getpid())
+            return -EPERM;
+        long r = 0;
+        if (a4)
+            r = shim_rlimit_get((int)a2, (void *)a4);
+        if (r == 0 && a3)
+            r = shim_rlimit_set((int)a2, (const void *)a3);
+        return r;
+    }
+
+    case SYS_prctl:
+        switch ((int)a1) {
+        case 22 /*PR_SET_SECCOMP*/:
+        case 26 /*PR_SET_TSC*/:
+            /* would tear down the interposition tiers */
+            shim_warn("shadow-shim: guest prctl(SET_SECCOMP/SET_TSC) "
+                      "refused\n");
+            return -EPERM;
+        default:
+            return shim_raw_syscall(nr, a1, a2, a3, a4, a5, a6);
+        }
+
+    case SYS_pread64:
+    case SYS_pwrite64:
+        if (is_vfd((int)a1))
+            return -ESPIPE; /* sockets/pipes are not seekable */
+        return shim_raw_syscall(nr, a1, a2, a3, a4, a5, a6);
+
+    case SYS_newfstatat:
+        if ((int)a1 >= VFD_BASE && a2 && ((const char *)a2)[0] == '\0')
+            /* AT_EMPTY_PATH on a virtual fd: our fstat emulation */
+            return KR(fstat((int)a1, (struct stat *)a3));
+        if (is_virtual_path((const char *)a2)) {
+            struct stat *st = (struct stat *)a3;
+            memset(st, 0, sizeof(*st));
+            st->st_mode = S_IFCHR | 0666;
+            st->st_blksize = 4096;
+            return 0;
+        }
+        return shim_raw_syscall(nr, a1, a2, a3, a4, a5, a6);
+
+    case SYS_statx:
+        if (((int)a1 >= VFD_BASE && a2 && ((const char *)a2)[0] == '\0') ||
+            is_virtual_path((const char *)a2)) {
+            /* statx on simulated objects: synthesize from our fstat */
+            struct stat st;
+            int rc = 0;
+            if ((int)a1 >= VFD_BASE)
+                rc = fstat((int)a1, &st);
+            else {
+                memset(&st, 0, sizeof(st));
+                st.st_mode = S_IFCHR | 0666;
+            }
+            if (rc != 0)
+                return -errno;
+            struct statx *sx = (struct statx *)a5;
+            memset(sx, 0, sizeof(*sx));
+            sx->stx_mask = 0x7ff; /* STATX_BASIC_STATS */
+            sx->stx_mode = (uint16_t)st.st_mode;
+            sx->stx_blksize = 4096;
+            return 0;
+        }
+        return shim_raw_syscall(nr, a1, a2, a3, a4, a5, a6);
+
+    case SYS_times: {
+        /* deterministic: process times derived from the sim clock
+         * (100 Hz ticks since the sim epoch) */
+        int64_t ticks = local_now_ns() / 10000000LL;
+        if (a1) {
+            long *t = (long *)a1;
+            t[0] = (long)(ticks / 2); /* utime */
+            t[1] = (long)(ticks / 2); /* stime */
+            t[2] = 0;
+            t[3] = 0;
+        }
+        return (long)ticks;
+    }
+    case SYS_getrusage: {
+        struct rusage *ru = (struct rusage *)a2;
+        memset(ru, 0, sizeof(*ru));
+        int64_t us = local_now_ns() / 1000;
+        ru->ru_utime.tv_sec = us / 2000000;
+        ru->ru_utime.tv_usec = (us / 2) % 1000000;
+        ru->ru_stime = ru->ru_utime;
+        ru->ru_maxrss = 4096; /* deterministic fixed footprint */
+        return 0;
+    }
+    case SYS_getcpu:
+        if (a1)
+            *(unsigned *)a1 = 0;
+        if (a2)
+            *(unsigned *)a2 = 0;
+        return 0;
+
+    case SYS_sendmmsg:
+    case SYS_recvmmsg:
+        if (is_vfd((int)a1)) {
+            /* loop over the single-message emulation */
+            struct mmsghdr *mv = (struct mmsghdr *)a2;
+            unsigned vlen = (unsigned)a3;
+            unsigned done = 0;
+            for (; done < vlen; done++) {
+                ssize_t r = nr == SYS_sendmmsg
+                                ? sendmsg((int)a1, &mv[done].msg_hdr, (int)a4)
+                                : recvmsg((int)a1, &mv[done].msg_hdr, (int)a4);
+                if (r < 0)
+                    return done ? (long)done : -errno;
+                mv[done].msg_len = (unsigned)r;
+            }
+            return (long)done;
+        }
+        return shim_raw_syscall(nr, a1, a2, a3, a4, a5, a6);
+
     case SYS_exit_group:
         /* raw _exit/exit_group: record the status like the libc exit
          * interposer, then die natively (double-send guarded: libc exit

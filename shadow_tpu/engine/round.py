@@ -301,31 +301,89 @@ def handle_one_iteration(
     )
 
 
-def flush_outbox(st: SimState, axis_name: Optional[str]) -> SimState:
+def flush_outbox(
+    st: SimState, axis_name: Optional[str], cfg: "EngineConfig | None" = None
+) -> SimState:
     """Round-boundary exchange: deliver staged packets into destination queues.
 
-    Sharded, this is the cross-chip step: gather every shard's outbox over
-    the mesh, keep entries addressed to local hosts, push. (The reference's
-    analogue is the locked cross-host EventQueue push, worker.rs:619-629.)
+    Sharded, this is the cross-chip step (the analogue of the locked
+    cross-host EventQueue push, worker.rs:619-629), with two modes:
+
+      * all_to_all (default): bucket outbox entries by destination shard,
+        exchange only each peer's bucket over ICI — per-shard traffic is
+        O(devices x bucket) instead of O(devices x whole outbox). Bucket
+        capacity is static (XLA shapes); overflow is counted and fails
+        loudly via check_capacity, like every other fixed-slot resource.
+      * all_gather: every shard receives every shard's whole outbox and
+        filters its own rows (simple, never overflows, more traffic).
+
+    Either way the destination pops by the (time, tie) key, so delivery
+    slot order — which differs between the modes — cannot affect results.
     """
     ob = st.outbox
     h_local, o_cap = ob.valid.shape
+    m = h_local * o_cap
 
     def flat(x):
-        return x.reshape((h_local * o_cap,) + x.shape[2:])
+        return x.reshape((m,) + x.shape[2:])
 
     valid, dst, time, tie = flat(ob.valid), flat(ob.dst), flat(ob.time), flat(ob.tie)
     data, aux = flat(ob.data), flat(ob.aux)
+    overflow_extra = None
 
     base = 0
     if axis_name is not None:
-        valid = jax.lax.all_gather(valid, axis_name, tiled=True)
-        dst = jax.lax.all_gather(dst, axis_name, tiled=True)
-        time = jax.lax.all_gather(time, axis_name, tiled=True)
-        tie = jax.lax.all_gather(tie, axis_name, tiled=True)
-        data = jax.lax.all_gather(data, axis_name, tiled=True)
-        aux = jax.lax.all_gather(aux, axis_name, tiled=True)
+        mode = getattr(cfg, "exchange", "all_to_all") if cfg is not None else "all_gather"
         base = jax.lax.axis_index(axis_name) * h_local
+        if mode == "all_to_all":
+            d = jax.lax.axis_size(axis_name)
+            cap = getattr(cfg, "a2a_capacity", 0) or 0
+            if cap <= 0:
+                cap = max(min(4 * m // max(d, 1), m), 64)
+            # bucket by destination shard; stable sort keeps emission order
+            # within each bucket (determinism is key-driven anyway)
+            pos = jnp.arange(m)
+            shard_of = jnp.where(valid, dst // h_local, d).astype(jnp.int32)
+            order = jnp.argsort(shard_of, stable=True)
+            sh_s = shard_of[order]
+            valid_s = valid[order]
+            seg_start = jnp.concatenate([jnp.ones((1,), bool), sh_s[1:] != sh_s[:-1]])
+            start_pos = jax.lax.cummax(jnp.where(seg_start, pos, -1))
+            rank = (pos - start_pos).astype(jnp.int32)
+            fits = valid_s & (rank < cap)
+            sdst = jnp.where(fits, sh_s, d)
+            sslot = jnp.where(fits, rank, cap)
+            overflow_extra = jnp.sum(valid_s & ~fits).astype(jnp.int32)
+
+            def bucketize(x, fill):
+                buf = jnp.full((d, cap) + x.shape[1:], fill, x.dtype)
+                return buf.at[sdst, sslot].set(x[order], mode="drop")
+
+            valid = jax.lax.all_to_all(
+                bucketize(valid, False), axis_name, 0, 0, tiled=False
+            ).reshape((d * cap,))
+            dst = jax.lax.all_to_all(
+                bucketize(dst, 0), axis_name, 0, 0, tiled=False
+            ).reshape((d * cap,))
+            time = jax.lax.all_to_all(
+                bucketize(time, TIME_MAX), axis_name, 0, 0, tiled=False
+            ).reshape((d * cap,))
+            tie = jax.lax.all_to_all(
+                bucketize(tie, 0), axis_name, 0, 0, tiled=False
+            ).reshape((d * cap,))
+            data = jax.lax.all_to_all(
+                bucketize(data, 0), axis_name, 0, 0, tiled=False
+            ).reshape((d * cap, data.shape[1]))
+            aux = jax.lax.all_to_all(
+                bucketize(aux, 0), axis_name, 0, 0, tiled=False
+            ).reshape((d * cap,))
+        else:
+            valid = jax.lax.all_gather(valid, axis_name, tiled=True)
+            dst = jax.lax.all_gather(dst, axis_name, tiled=True)
+            time = jax.lax.all_gather(time, axis_name, tiled=True)
+            tie = jax.lax.all_gather(tie, axis_name, tiled=True)
+            data = jax.lax.all_gather(data, axis_name, tiled=True)
+            aux = jax.lax.all_gather(aux, axis_name, tiled=True)
 
     local_dst = dst - base
     mine = valid & (local_dst >= 0) & (local_dst < h_local)
@@ -345,6 +403,8 @@ def flush_outbox(st: SimState, axis_name: Optional[str]) -> SimState:
         time=jnp.full_like(ob.time, TIME_MAX),
         fill=jnp.zeros_like(ob.fill),
     )
+    if overflow_extra is not None:
+        fresh = fresh.replace(overflow=fresh.overflow.at[0].add(overflow_extra))
     return st.replace(queue=queue, outbox=fresh)
 
 
@@ -369,7 +429,7 @@ def run_round(
         return handle_one_iteration(s, window_end, model, tables, cfg), iters + 1
 
     st, _ = jax.lax.while_loop(cond, body, (st, jnp.asarray(0, jnp.int32)))
-    st = flush_outbox(st, axis_name)
+    st = flush_outbox(st, axis_name, cfg)
     return st.replace(now=jnp.maximum(st.now, window_end))
 
 

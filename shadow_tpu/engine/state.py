@@ -45,6 +45,13 @@ class EngineConfig:
     # graph minimum; correctness is preserved by the deliver-time clamp to
     # round end (worker.rs:399-402), identical to the reference's semantics.
     use_dynamic_runahead: bool = False
+    # Sharded round-boundary exchange (the cross-chip seam, the analogue of
+    # worker.rs:619-629): "all_to_all" buckets outbox entries by destination
+    # shard and exchanges only each peer's bucket over ICI; "all_gather"
+    # replicates every shard's whole outbox (more traffic, never overflows).
+    exchange: str = "all_to_all"
+    # per-peer bucket capacity for all_to_all; 0 = auto (4x outbox/devices)
+    a2a_capacity: int = 0
     # draws consumed per handled event = model.DRAWS_PER_EVENT + PACKET_EMITS
     # (one loss draw per packet lane), fixed-stride for determinism.
 

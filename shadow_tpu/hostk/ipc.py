@@ -90,6 +90,7 @@ VSYS_WAITPID = 61
 VSYS_FUTEX_WAIT = 62
 VSYS_FUTEX_WAKE = 63
 VSYS_FUTEX_REQUEUE = 64
+VSYS_SIGMASK = 65
 
 # message kind for a new thread announcing itself on its own channel
 MSG_THREAD_START = 6
@@ -161,6 +162,7 @@ VSYS_NAMES = {
     VSYS_FUTEX_WAIT: "futex",
     VSYS_FUTEX_WAKE: "futex",
     VSYS_FUTEX_REQUEUE: "futex",
+    VSYS_SIGMASK: "rt_sigprocmask",
 }
 
 

@@ -217,6 +217,7 @@ class GuestThread:
         self.ipc = ipc
         self.now = 0
         self.state = "pending"  # pending -> running -> blocked -> exited
+        self.sig_mask = 0  # blocked-signal bits (rt_sigprocmask, kernel view)
         self.waiter: Optional[Waiter] = None
         self._pending: Optional[tuple[str, str]] = None  # strace line await reply
         self.pending_sigs: "deque[int]" = deque()
@@ -328,6 +329,10 @@ class ManagedProcess:
         # down; the shim interposes at the pthread layer instead)
         self.mutexes: dict[int, "KMutex"] = {}
         self.conds: dict[int, "KCond"] = {}
+        # signals every live thread currently blocks, pending delivery
+        # (real semantics: blocked signals — even default-fatal ones —
+        # stay pending until some thread unblocks them)
+        self.blocked_pending: "list[int]" = []
         self.child_evt = File()  # notified whenever any of our children exits
         # raw-futex wait queues (reference: per-host futex table,
         # futex_table.c; here per address space, which is what private
@@ -729,8 +734,16 @@ class NetKernel:
         if proc.exited:
             return
         kind = proc.sig_handlers.get(sig, 0)
-        if sig == 9:  # SIGKILL cannot be caught or ignored
+        if sig == 9:  # SIGKILL cannot be caught, ignored, or blocked
             kind = 0
+        bit = 1 << (sig - 1)
+        if sig != 9 and all(
+            t.sig_mask & bit for t in proc.threads if t.state != "exited"
+        ):
+            # every live thread blocks it: stays pending until an unblock
+            # (rt_sigprocmask reports mask changes via VSYS_SIGMASK)
+            proc.blocked_pending.append(sig)
+            return
         if kind == 1:
             return
         if kind == 0:
@@ -740,8 +753,16 @@ class NetKernel:
             return
         restart = bool(kind & 0x10)
         # the main thread may have pthread_exit'ed while workers run; pick
-        # the first live thread deterministically (lowest tid)
-        thread = next((t for t in proc.threads if t.state != "exited"), None)
+        # the first live thread with the signal unblocked (lowest tid, the
+        # deterministic POSIX-allowed choice)
+        thread = next(
+            (
+                t
+                for t in proc.threads
+                if t.state != "exited" and (sig == 9 or not (t.sig_mask & bit))
+            ),
+            None,
+        )
         if thread is None:
             return
         thread.pending_sigs.append(sig)
@@ -1175,6 +1196,22 @@ class NetKernel:
         if n:
             process.futex_hub.notify()
         proc._reply(n + moved)
+        return True
+
+    def _sys_sigmask(self, proc, msg):
+        """rt_sigprocmask, kernel view (reference syscall/signal.c +
+        shim_shmem blocked-mask handoff): record the thread's new blocked
+        mask, then deliver any process-pending signals it just unblocked."""
+        proc.sig_mask = int(msg.a[1]) & ((1 << 64) - 1)
+        proc._reply(0)
+        process = proc.process
+        if process.blocked_pending:
+            deliverable = [
+                s for s in process.blocked_pending if not (proc.sig_mask >> (s - 1)) & 1
+            ]
+            for s in deliverable:
+                process.blocked_pending.remove(s)
+                self.deliver_signal(process, s)
         return True
 
     # --- fork/wait (reference: process.rs spawn/fork + waitpid) ----------
@@ -2996,6 +3033,7 @@ _DISPATCH = {
     I.VSYS_FUTEX_WAIT: NetKernel._sys_futex_wait,
     I.VSYS_FUTEX_WAKE: NetKernel._sys_futex_wake,
     I.VSYS_FUTEX_REQUEUE: NetKernel._sys_futex_requeue,
+    I.VSYS_SIGMASK: NetKernel._sys_sigmask,
     I.VSYS_FORK: NetKernel._sys_fork,
     I.VSYS_WAITPID: NetKernel._sys_waitpid,
     I.VSYS_PAUSE: NetKernel._sys_pause,

@@ -1,0 +1,154 @@
+/* Round-2 syscall-breadth guest: asserts native-Linux semantics for the
+ * newly trapped deterministic-view syscalls (affinity, rlimits, prctl,
+ * statx/newfstatat, getdents64 via readdir, pread/pwrite, times/rusage,
+ * sendmmsg, blocked-signal pending delivery). Prints "ok <name>" lines
+ * the paired test checks, exactly like breadth_guest.c. */
+#define _GNU_SOURCE
+#include <arpa/inet.h>
+#include <dirent.h>
+#include <netinet/in.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <sched.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/prctl.h>
+#include <sys/resource.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <sys/time.h>
+#include <sys/times.h>
+#include <time.h>
+#include <unistd.h>
+
+static int failures = 0;
+#define CHECK(name, cond)                                                     \
+    do {                                                                      \
+        if (!(cond)) {                                                        \
+            printf("FAIL %s (errno=%d)\n", name, errno);                      \
+            failures++;                                                       \
+        } else                                                                \
+            printf("ok %s\n", name);                                          \
+    } while (0)
+
+static volatile sig_atomic_t got_usr1 = 0;
+static void on_usr1(int s) { (void)s; got_usr1 = 1; }
+
+int main(void) {
+    /* deterministic 1-CPU topology */
+    cpu_set_t set;
+    CPU_ZERO(&set);
+    CHECK("sched_getaffinity", sched_getaffinity(0, sizeof(set), &set) == 0 &&
+                                   CPU_COUNT(&set) == 1 && CPU_ISSET(0, &set));
+    CHECK("sched_setaffinity", sched_setaffinity(0, sizeof(set), &set) == 0);
+    CHECK("nprocs", sysconf(_SC_NPROCESSORS_ONLN) >= 1);
+
+    /* deterministic rlimits, settable */
+    struct rlimit rl;
+    CHECK("getrlimit_nofile",
+          getrlimit(RLIMIT_NOFILE, &rl) == 0 && rl.rlim_cur == 1024);
+    rl.rlim_cur = 512;
+    CHECK("setrlimit_nofile", setrlimit(RLIMIT_NOFILE, &rl) == 0);
+    CHECK("getrlimit_round_trip",
+          getrlimit(RLIMIT_NOFILE, &rl) == 0 && rl.rlim_cur == 512);
+
+    /* prctl: benign native, dangerous refused */
+    CHECK("prctl_name", prctl(PR_SET_NAME, "breadth2", 0, 0, 0) == 0);
+    CHECK("prctl_seccomp_refused",
+          prctl(22 /*PR_SET_SECCOMP*/, 1, 0, 0, 0) == -1 && errno == EPERM);
+
+    /* file breadth in the sandbox cwd: statx/newfstatat/getdents/pread */
+    int fd = open("breadth2.dat", O_CREAT | O_RDWR | O_TRUNC, 0644);
+    CHECK("open_rel", fd >= 0);
+    CHECK("pwrite", pwrite(fd, "hello-breadth", 13, 7) == 13);
+    char pb[16] = {0};
+    CHECK("pread", pread(fd, pb, 13, 7) == 13 && memcmp(pb, "hello-breadth", 13) == 0);
+    struct stat st;
+    CHECK("newfstatat",
+          fstatat(AT_FDCWD, "breadth2.dat", &st, 0) == 0 && st.st_size == 20);
+    struct statx sx;
+    CHECK("statx",
+          syscall(SYS_statx, AT_FDCWD, "breadth2.dat", 0, 0x7ff, &sx) == 0 &&
+              S_ISREG(sx.stx_mode));
+    close(fd);
+
+    int found = 0;
+    DIR *d = opendir(".");
+    if (d) {
+        struct dirent *e;
+        while ((e = readdir(d)) != NULL)
+            if (strcmp(e->d_name, "breadth2.dat") == 0)
+                found = 1;
+        closedir(d);
+    }
+    CHECK("getdents64", found);
+
+    /* statx on a virtual fd (socket) */
+    int s = socket(AF_INET, SOCK_DGRAM, 0);
+    CHECK("newfstatat_vfd",
+          fstatat(s, "", &st, AT_EMPTY_PATH) == 0 && S_ISSOCK(st.st_mode));
+    close(s);
+
+    /* deterministic process clocks */
+    struct tms t1, t2;
+    clock_t a = times(&t1);
+    struct timespec dly = {0, 40 * 1000000};
+    nanosleep(&dly, NULL);
+    clock_t b = times(&t2);
+    CHECK("times_advances_sim", b > a && (b - a) >= 3 && (b - a) <= 6);
+    struct rusage ru;
+    CHECK("getrusage", getrusage(RUSAGE_SELF, &ru) == 0 && ru.ru_maxrss == 4096);
+
+    /* blocked signals stay pending; delivery on unblock */
+    struct sigaction sa = {0};
+    sa.sa_handler = on_usr1;
+    sigaction(SIGUSR1, &sa, NULL);
+    sigset_t blk, old;
+    sigemptyset(&blk);
+    sigaddset(&blk, SIGUSR1);
+    sigprocmask(SIG_BLOCK, &blk, &old);
+    kill(getpid(), SIGUSR1);
+    struct timespec d2 = {0, 10 * 1000000};
+    nanosleep(&d2, NULL);
+    CHECK("blocked_signal_pending", got_usr1 == 0);
+    sigprocmask(SIG_UNBLOCK, &blk, NULL);
+    nanosleep(&d2, NULL);
+    CHECK("unblock_delivers", got_usr1 == 1);
+
+    /* sendmmsg over a simulated UDP socket pair */
+    int tx = socket(AF_INET, SOCK_DGRAM, 0);
+    int rx = socket(AF_INET, SOCK_DGRAM, 0);
+    struct sockaddr_in addr = {0};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(9099);
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    bind(rx, (struct sockaddr *)&addr, sizeof(addr));
+    addr.sin_addr.s_addr = htonl(0x7f000001);
+    struct mmsghdr mv[2] = {0};
+    struct iovec iov[2];
+    iov[0].iov_base = "aa";
+    iov[0].iov_len = 2;
+    iov[1].iov_base = "bbb";
+    iov[1].iov_len = 3;
+    for (int i = 0; i < 2; i++) {
+        mv[i].msg_hdr.msg_iov = &iov[i];
+        mv[i].msg_hdr.msg_iovlen = 1;
+        mv[i].msg_hdr.msg_name = &addr;
+        mv[i].msg_hdr.msg_namelen = sizeof(addr);
+    }
+    int nm = (int)syscall(SYS_sendmmsg, tx, mv, 2, 0);
+    char rb[8];
+    long r1 = recv(rx, rb, sizeof(rb), 0);
+    long r2 = recv(rx, rb, sizeof(rb), 0);
+    CHECK("sendmmsg", nm == 2 && mv[0].msg_len == 2 && mv[1].msg_len == 3 &&
+                          r1 == 2 && r2 == 3);
+    close(tx);
+    close(rx);
+
+    if (failures == 0)
+        printf("breadth2 all ok\n");
+    return failures == 0 ? 0 : 1;
+}

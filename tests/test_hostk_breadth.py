@@ -57,6 +57,35 @@ def test_breadth_deterministic(tmp_path, breadth_bin):
     assert a == b
 
 
+@pytest.fixture(scope="module")
+def breadth2_bin(tmp_path_factory):
+    out = tmp_path_factory.mktemp("guests") / "breadth2_guest"
+    subprocess.run(
+        ["cc", "-O2", "-o", str(out), str(GUESTS / "breadth2_guest.c")], check=True
+    )
+    return str(out)
+
+
+def test_breadth2_deterministic_views(tmp_path, breadth2_bin):
+    """Round-2 surface: affinity, rlimits, prctl filtering, statx and
+    newfstatat (incl. AT_EMPTY_PATH on virtual fds), getdents64 in the
+    sandbox, pread/pwrite, sim-time process clocks, blocked-signal
+    pending delivery, sendmmsg over simulated UDP."""
+    k, p = _run(tmp_path, breadth2_bin)
+    out = p.stdout().decode()
+    assert p.exit_code == 0, out + p.stderr().decode()
+    assert "breadth2 all ok" in out
+    assert "FAIL" not in out
+    # kernel saw the mask changes (VSYS_SIGMASK trips)
+    assert k.syscall_counts.get("rt_sigprocmask", 0) >= 2
+
+
+def test_breadth2_run_twice(tmp_path, breadth2_bin):
+    a = _run(tmp_path, breadth2_bin, "b1")[1].stdout()
+    b = _run(tmp_path, breadth2_bin, "b2")[1].stdout()
+    assert a == b
+
+
 def test_msg_waitall(tmp_path):
     import subprocess
 

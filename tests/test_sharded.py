@@ -76,6 +76,82 @@ def test_sharded_matches_single_device():
     assert int(st_sharded.outbox.overflow.sum()) == 0
 
 
+def _setup_bulk(num_hosts, seed=17, exchange="all_to_all"):
+    """Bulk-TCP world (handshake/Reno/retransmits + shaping) for the
+    scaled sharded-equality check (the exchange seam that matters at 10k
+    hosts, reference worker.rs:619-629)."""
+    from shadow_tpu.models.bulk import BulkTcpModel
+    from shadow_tpu.netstack import bw_bits_per_sec_to_refill
+
+    rng_py = random.Random(seed)
+    n_nodes = 8
+    lines = ["graph [", "  directed 0"]
+    for i in range(n_nodes):
+        lines.append(f"  node [ id {i} ]")
+        lines.append(f'  edge [ source {i} target {i} latency "1 ms" ]')
+    for i in range(n_nodes):
+        for j in range(i + 1, n_nodes):
+            lines.append(
+                f'  edge [ source {i} target {j} latency "{rng_py.randrange(2, 7)} ms" packet_loss 0.01 ]'
+            )
+    lines.append("]")
+    graph = NetworkGraph.from_gml("\n".join(lines))
+    host_node = [i % n_nodes for i in range(num_hosts)]
+    tables = compute_routing(graph, block=8).with_hosts(host_node)
+    cfg = EngineConfig(
+        num_hosts=num_hosts,
+        queue_capacity=128,
+        outbox_capacity=32,
+        runahead_ns=graph.min_latency_ns(),
+        seed=seed,
+        use_netstack=True,
+        exchange=exchange,
+    )
+    model = BulkTcpModel(
+        num_hosts=num_hosts, num_pairs=num_hosts // 4, total_bytes=40_000
+    )
+    bw = bw_bits_per_sec_to_refill(50_000_000)
+    st = bootstrap(
+        init_state(cfg, model.init(), tx_bytes_per_interval=bw, rx_bytes_per_interval=bw),
+        model,
+        cfg,
+    )
+    return cfg, model, tables, st
+
+
+@pytest.mark.parametrize("exchange", ["all_to_all", "all_gather"])
+def test_sharded_bulk_tcp_1k_hosts_matches_single(exchange):
+    """1024-host bulk-TCP (full simulated stack) sharded over 8 devices
+    with the destination-bucketed all-to-all exchange must equal the
+    single-device run bit for bit."""
+    assert jax.device_count() == 8
+    cfg, model, tables, st0 = _setup_bulk(num_hosts=1024, exchange=exchange)
+    end = 40 * NS_PER_MS
+
+    st_single = run_until(st0, end, model, tables, cfg, rounds_per_chunk=8)
+
+    mesh = Mesh(np.array(jax.devices()), (AXIS,))
+    runner = ShardedRunner(mesh, model, tables, cfg, rounds_per_chunk=8)
+    st_sharded = runner.run_until(st0, end)
+
+    for name in ["seq", "rng_counter", "packets_sent", "packets_dropped", "events_handled"]:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(st_single, name)),
+            np.asarray(getattr(st_sharded, name)),
+            err_msg=name,
+        )
+    np.testing.assert_array_equal(
+        np.asarray(st_single.model.tcp.delivered), np.asarray(st_sharded.model.tcp.delivered)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(st_single.model.conns_established),
+        np.asarray(st_sharded.model.conns_established),
+    )
+    assert int(np.asarray(st_sharded.model.tcp.delivered).sum()) > 0
+    assert int(st_sharded.queue.overflow.sum()) == 0
+    assert int(st_sharded.outbox.overflow.sum()) == 0
+
+
 def test_sharded_rejects_uneven_split():
     cfg, model, tables, st0 = _setup(num_hosts=12)  # 12 % 8 != 0
     mesh = Mesh(np.array(jax.devices()), (AXIS,))
