@@ -114,6 +114,7 @@ class ExperimentalOptions:
     max_iters_per_round: int = 1_000_000
     # managed-process options (reference: configuration.rs:298-455)
     strace_logging_mode: str = "standard"  # "off" | "standard" | "deterministic"
+    interface_qdisc: str = "fifo"  # "fifo" | "rr" (reference QDiscMode)
     use_tcp_sack: bool = True  # SACK scoreboard retransmission
     use_tcp_autotune: bool = True  # receive-window/send-buffer autotuning
     use_pcap: bool = False
@@ -146,6 +147,7 @@ class ExperimentalOptions:
             "use_pcap",
             "use_tcp_sack",
             "use_tcp_autotune",
+            "interface_qdisc",
         ):
             if k in d:
                 setattr(out, k, d.pop(k))
@@ -155,6 +157,11 @@ class ExperimentalOptions:
             raise ValueError(
                 f"unknown strace_logging_mode {out.strace_logging_mode!r} "
                 "(expected 'off', 'standard', or 'deterministic')"
+            )
+        if out.interface_qdisc not in ("fifo", "rr"):
+            raise ValueError(
+                f"unknown interface_qdisc {out.interface_qdisc!r} "
+                "(expected 'fifo' or 'rr')"
             )
         if out.scheduler not in ("tpu", "cpu-ref", "managed"):
             raise ValueError(

@@ -9,8 +9,9 @@ the conservative window (no collectives in the inner loop), and the only
 cross-device traffic per round is
 
   * one pmin over ICI to agree on the next window, and
-  * one all_gather of the per-host packet outboxes (the exchange step —
-    the analogue of the locked cross-host queue push, worker.rs:619-629).
+  * one destination-bucketed all_to_all of the per-host packet outboxes
+    (the exchange step — the analogue of the locked cross-host queue
+    push, worker.rs:619-629; cfg.exchange selects all_to_all/all_gather).
 
 Chips in lockstep at round granularity, exactly like the reference's
 round barrier (manager.rs:459-478), but with the barrier being an XLA

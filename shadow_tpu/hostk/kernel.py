@@ -478,6 +478,69 @@ class ManagedProcess:
                 t.ipc = None
 
 
+class NicQueue:
+    """Modeled egress NIC with a round-robin qdisc across sockets
+    (reference: network_queuing_disciplines.h:15-25 — FIFO by packet
+    priority vs round-robin across sockets; network_interface.c:171,332).
+
+    Engaged when interface_qdisc=rr: instead of charging the token bucket
+    eagerly at send time (FIFO by construction), packets wait in
+    per-socket queues and the NIC pumps one packet per line departure,
+    picking the next non-empty socket round-robin. Charging order is the
+    only thing the discipline changes, so FIFO mode needs no queue at all
+    and bucket math stays the shared closed form."""
+
+    def __init__(self, kernel: "NetKernel", host: "HostKernel"):
+        self.kernel = kernel
+        self.host = host
+        self.queues: "dict[object, object]" = {}
+        self.order: "list[object]" = []  # first-seen socket order
+        self.rr_idx = 0
+        self.pumping = False
+
+    def submit(self, sock_key, size: int, emit) -> None:
+        import collections
+
+        q = self.queues.get(sock_key)
+        if q is None:
+            q = self.queues[sock_key] = collections.deque()
+            self.order.append(sock_key)
+        q.append((size, emit))
+        if not self.pumping:
+            self._pump()
+
+    def _next(self):
+        n = len(self.order)
+        for i in range(n):
+            j = (self.rr_idx + i) % n
+            q = self.queues[self.order[j]]
+            if q:
+                self.rr_idx = (j + 1) % n
+                return q.popleft()
+        return None
+
+    def _pump(self) -> None:
+        k = self.kernel
+        while True:
+            item = self._next()
+            if item is None:
+                self.pumping = False
+                return
+            size, emit = item
+            if self.host.tx_tb is not None and k.now >= k.bootstrap_end_ns:
+                dep = self.host.tx_tb.depart(k.now, size)
+            else:
+                dep = k.now
+            emit(dep)
+            if dep > k.now:
+                # the line is busy until `dep`: packets submitted before
+                # then join the rotation, and the next pick happens when
+                # the line frees (that is the whole point of RR)
+                self.pumping = True
+                k._push(dep, self._pump)
+                return
+
+
 class KMutex(File):
     """Kernel-side pthread mutex: lock state lives here so strictly
     serialized guest threads can never deadlock on a native futex
@@ -531,6 +594,7 @@ class HostKernel:
         # host.rs:285-296; loopback is unlimited so it has no bucket)
         self.tx_tb: "Optional[netstack.TokenBucketRef]" = None
         self.rx_tb: "Optional[netstack.TokenBucketRef]" = None
+        self.nic = NicQueue(kernel, self)  # engaged only under qdisc=rr
         self.rx_codel = netstack.CoDelRef()
         self.rx_backlog_bytes = 0
         self.codel_dropped = 0
@@ -585,6 +649,7 @@ class NetKernel:
         window_ns: "Optional[int]" = None,
         tcp_sack: bool = True,
         tcp_autotune: bool = True,
+        qdisc: str = "fifo",
     ):
         self.tables = tables
         self.lat = np.asarray(tables.lat_ns)
@@ -600,6 +665,12 @@ class NetKernel:
         self.tcp_sack = tcp_sack
         self.tcp_autotune = tcp_autotune
         self.tcp_retransmits = 0  # aggregated loss-recovery resends
+        if qdisc not in ("fifo", "rr"):
+            raise ValueError(f"unknown qdisc {qdisc!r} (expected 'fifo' or 'rr')")
+        # egress queuing discipline (reference QDiscMode,
+        # configuration.rs:930): fifo = charge order is send order (no
+        # queue needed); rr = NicQueue round-robins across sockets
+        self.qdisc = qdisc
         self.data_dir = pathlib.Path(data_dir)
         if self.data_dir.exists():
             shutil.rmtree(self.data_dir)
@@ -2769,6 +2840,28 @@ class NetKernel:
             src.bytes_sent += size
             self.pending_sends.append((t, src.host_id, seq, ctr, dst.host_id, size))
             return
+        if self.qdisc == "rr" and src.tx_tb is not None:
+
+            def emit(dep):
+                if not (u < relv):
+                    src.packets_dropped += 1
+                    self.event_log.append((t, f"drop {src.name}->{dst.name}:{dst_port}"))
+                    return
+                src.packets_sent += 1
+                src.bytes_sent += size
+                if self.pcap:
+                    self.pcap.udp(src.name, t, src_ip, src_port, dst_ip, dst_port, data)
+                self._push_packet(
+                    self._clamp(dep + lat, t), src.host_id, seq,
+                    lambda: self._arrive(
+                        dst, size, False,
+                        lambda: self._deliver(dst, dst_port, data, src_ip, src_port),
+                        src.host_id, seq,
+                    ),
+                )
+
+            src.nic.submit(("udp", src_port), size, emit)
+            return
         dep = self._egress_depart(src, t, size)
         if not (u < relv):
             src.packets_dropped += 1
@@ -2845,6 +2938,31 @@ class NetKernel:
             src.packets_sent += 1  # tentative; reverted by a loss record
             src.bytes_sent += size
             self.pending_sends.append((t, src.host_id, seq, ctr, dst.host_id, size))
+            return
+        if self.qdisc == "rr" and src.tx_tb is not None:
+
+            def emit(dep):
+                if not (u < relv):
+                    src.packets_dropped += 1
+                    self.event_log.append(
+                        (t, f"drop-tcp {src.name}->{dst.name} {seg.flag_str()} seq={seg.seq}")
+                    )
+                    return
+                src.packets_sent += 1
+                src.bytes_sent += size
+                if self.pcap:
+                    self.pcap.tcp(src.name, t, seg)
+                self._push_packet(
+                    self._clamp(dep + lat, t), src.host_id, seq,
+                    lambda: self._arrive(
+                        dst, size, False, lambda: self._deliver_segment(dst, seg),
+                        src.host_id, seq,
+                    ),
+                )
+
+            src.nic.submit(
+                ("tcp", seg.src_port, seg.dst_ip, seg.dst_port), size, emit
+            )
             return
         dep = self._egress_depart(src, t, size)
         if not (u < relv):

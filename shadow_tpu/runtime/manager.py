@@ -338,6 +338,7 @@ class Manager:
             window_ns=runahead,
             tcp_sack=cfgo.experimental.use_tcp_sack,
             tcp_autotune=cfgo.experimental.use_tcp_autotune,
+            qdisc=cfgo.experimental.interface_qdisc,
         )
         for h in self.hosts:
             for p in h.spec.processes:
@@ -353,6 +354,12 @@ class Manager:
                 )
 
         sched_name = cfgo.experimental.scheduler
+        if sched_name == "tpu" and cfgo.experimental.interface_qdisc == "rr":
+            raise ValueError(
+                "interface_qdisc: rr requires the serial kernel "
+                "(experimental.scheduler: managed); the device engine's "
+                "egress is FIFO in lane order"
+            )
         if sched_name == "tpu":
             from shadow_tpu.netstack import bw_bits_per_sec_to_refill
             from shadow_tpu.runtime.hybrid import HybridScheduler

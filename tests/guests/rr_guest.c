@@ -1,0 +1,61 @@
+/* RR-qdisc guest: two UDP sockets on one host each blast a tagged burst
+ * back to back over a shaped uplink. Under fifo the whole A-burst
+ * precedes the B-burst on the wire; under rr the NIC round-robins the
+ * two sockets' queues. The sink prints the arrival tag order.
+ *   rr_guest sink <port> <count>
+ *   rr_guest send <ip> <port> <per_sock> */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+    if (argc >= 4 && strcmp(argv[1], "sink") == 0) {
+        int port = atoi(argv[2]), count = atoi(argv[3]);
+        int fd = socket(AF_INET, SOCK_DGRAM, 0);
+        struct sockaddr_in a = {0};
+        a.sin_family = AF_INET;
+        a.sin_port = htons((uint16_t)port);
+        a.sin_addr.s_addr = htonl(INADDR_ANY);
+        if (bind(fd, (struct sockaddr *)&a, sizeof(a)) != 0) {
+            perror("bind");
+            return 1;
+        }
+        char order[256] = {0};
+        char buf[64];
+        for (int i = 0; i < count && i < 250; i++) {
+            ssize_t r = recv(fd, buf, sizeof(buf) - 1, 0);
+            if (r <= 0)
+                break;
+            order[i] = buf[0];
+        }
+        printf("order=%s\n", order);
+        return 0;
+    }
+    if (argc >= 5 && strcmp(argv[1], "send") == 0) {
+        int port = atoi(argv[3]), per = atoi(argv[4]);
+        struct sockaddr_in a = {0};
+        a.sin_family = AF_INET;
+        a.sin_port = htons((uint16_t)port);
+        a.sin_addr.s_addr = inet_addr(argv[2]);
+        int sa = socket(AF_INET, SOCK_DGRAM, 0);
+        int sb = socket(AF_INET, SOCK_DGRAM, 0);
+        char pkt[1000];
+        memset(pkt, 'x', sizeof(pkt));
+        for (int i = 0; i < per; i++) {
+            pkt[0] = 'A';
+            sendto(sa, pkt, sizeof(pkt), 0, (struct sockaddr *)&a, sizeof(a));
+        }
+        for (int i = 0; i < per; i++) {
+            pkt[0] = 'B';
+            sendto(sb, pkt, sizeof(pkt), 0, (struct sockaddr *)&a, sizeof(a));
+        }
+        close(sa);
+        close(sb);
+        return 0;
+    }
+    return 2;
+}
