@@ -26,7 +26,7 @@ the furthest partial progress instead of nothing.
 Env knobs: SHADOW_TPU_BENCH_HOSTS (default 8192; 10240 runs but the
 tunneled TPU worker dies on multi-minute sustained dispatch sessions at
 that size, so the default stays at the largest reliably-surviving world),
-SHADOW_TPU_BENCH_SIMSEC (default 0.75 — the tunneled worker also dies
+SHADOW_TPU_BENCH_SIMSEC (default 0.5 — the tunneled worker also dies
 after a few minutes of sustained dispatch, so the horizon stays inside
 that envelope; the rate metric is horizon-independent past one tgen
 request/pause cycle), SHADOW_TPU_BENCH_CPU_SIMSEC (default 0.1),

@@ -339,7 +339,12 @@ def flush_outbox(
             d = jax.lax.axis_size(axis_name)
             cap = getattr(cfg, "a2a_capacity", 0) or 0
             if cap <= 0:
-                cap = max(min(4 * m // max(d, 1), m), 64)
+                # safe default: each peer bucket can hold the whole local
+                # outbox (PDES traffic is often pair-skewed — e.g. client i
+                # -> server i+H/2 lands a shard's entire outbox on one
+                # peer). Tuning a2a_capacity below m is where the ICI
+                # traffic saving comes from.
+                cap = m
             # bucket by destination shard; stable sort keeps emission order
             # within each bucket (determinism is key-driven anyway)
             pos = jnp.arange(m)

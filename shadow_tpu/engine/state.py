@@ -50,7 +50,9 @@ class EngineConfig:
     # shard and exchanges only each peer's bucket over ICI; "all_gather"
     # replicates every shard's whole outbox (more traffic, never overflows).
     exchange: str = "all_to_all"
-    # per-peer bucket capacity for all_to_all; 0 = auto (4x outbox/devices)
+    # per-peer bucket capacity for all_to_all; 0 = the whole local outbox
+    # (never overflows; set lower to cut ICI traffic when destinations are
+    # known to spread across shards)
     a2a_capacity: int = 0
     # draws consumed per handled event = model.DRAWS_PER_EVENT + PACKET_EMITS
     # (one loss draw per packet lane), fixed-stride for determinism.

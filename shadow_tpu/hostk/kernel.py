@@ -499,11 +499,9 @@ class NicQueue:
         self.pumping = False
 
     def submit(self, sock_key, size: int, emit) -> None:
-        import collections
-
         q = self.queues.get(sock_key)
         if q is None:
-            q = self.queues[sock_key] = collections.deque()
+            q = self.queues[sock_key] = deque()
             self.order.append(sock_key)
         q.append((size, emit))
         if not self.pumping:
@@ -516,7 +514,19 @@ class NicQueue:
             q = self.queues[self.order[j]]
             if q:
                 self.rr_idx = (j + 1) % n
-                return q.popleft()
+                item = q.popleft()
+                if not q:
+                    # retire drained sockets: ephemeral TCP connections
+                    # would otherwise grow the rotation without bound
+                    key = self.order.pop(j)
+                    del self.queues[key]
+                    if self.rr_idx > j:
+                        self.rr_idx -= 1
+                    if self.order:
+                        self.rr_idx %= len(self.order)
+                    else:
+                        self.rr_idx = 0
+                return item
         return None
 
     def _pump(self) -> None:
