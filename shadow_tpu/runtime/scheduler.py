@@ -81,11 +81,20 @@ class CpuRefScheduler:
 
     def __init__(self, model, tables: RoutingTables, cfg: EngineConfig, host_node,
                  tx_bytes_per_interval=None, rx_bytes_per_interval=None, **_):
-        if not isinstance(model, PholdModel):
-            raise ValueError("cpu-ref scheduler currently supports only the phold model")
-        self.ref = CpuRefPhold(cfg, model, tables, host_node,
-                               tx_bytes_per_interval=tx_bytes_per_interval,
-                               rx_bytes_per_interval=rx_bytes_per_interval)
+        from shadow_tpu.cpu_ref.bulk_ref import CpuRefBulk
+        from shadow_tpu.models.bulk import BulkTcpModel
+
+        if isinstance(model, PholdModel):
+            ref_cls = CpuRefPhold
+        elif isinstance(model, BulkTcpModel):
+            ref_cls = CpuRefBulk
+        else:
+            raise ValueError(
+                "cpu-ref scheduler supports the phold and bulk-tcp models"
+            )
+        self.ref = ref_cls(cfg, model, tables, host_node,
+                           tx_bytes_per_interval=tx_bytes_per_interval,
+                           rx_bytes_per_interval=rx_bytes_per_interval)
 
     def run(self, end_time_ns: int, on_chunk=None, max_chunks: int = 100_000):
         self.ref.bootstrap()

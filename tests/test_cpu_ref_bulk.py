@@ -1,0 +1,102 @@
+"""The scalar TCP oracle vs the device engine: the flagship bulk-TCP
+workload (handshake, Reno/NewReno, retransmission under loss, shaping +
+CoDel, FIN teardown) run through two independent implementations of the
+same specification must agree bit-for-bit — every TCP state field, every
+counter, every leftover queue entry (the independent-oracle role the
+reference's determinism suite plays, determinism/CMakeLists.txt:1-40)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from shadow_tpu import equeue
+from shadow_tpu.cpu_ref.bulk_ref import CpuRefBulk
+from shadow_tpu.engine import EngineConfig, init_state
+from shadow_tpu.engine.round import bootstrap, run_until
+from shadow_tpu.graph import NetworkGraph, compute_routing
+from shadow_tpu.models.bulk import BulkTcpModel
+from shadow_tpu.netstack import bw_bits_per_sec_to_refill
+from shadow_tpu.simtime import NS_PER_MS
+
+TCP_FIELDS = [
+    "st", "lport", "rport", "rhost", "snd_una", "snd_nxt", "snd_max",
+    "snd_end", "fin_pending", "fin_sent", "peer_wnd", "rcv_nxt", "rcv_fin",
+    "delivered", "ooo", "cwnd", "ssthresh", "dupacks", "recover", "in_rec",
+    "srtt", "rttvar", "rto", "rtt_pending", "rtt_seq", "rtt_ts",
+    "rto_expire", "backoff", "tev_time", "retransmits", "segs_in", "segs_out",
+]
+
+
+def _world(num_hosts, loss, shaped, seed):
+    rng_py = random.Random(seed)
+    n_nodes = 4
+    lines = ["graph [", "  directed 0"]
+    for i in range(n_nodes):
+        lines.append(f"  node [ id {i} ]")
+        lines.append(f'  edge [ source {i} target {i} latency "1 ms" ]')
+    for i in range(n_nodes):
+        for j in range(i + 1, n_nodes):
+            lines.append(
+                f'  edge [ source {i} target {j} latency "{rng_py.randrange(2, 6)} ms" packet_loss {loss} ]'
+            )
+    lines.append("]")
+    graph = NetworkGraph.from_gml("\n".join(lines))
+    host_node = [i % n_nodes for i in range(num_hosts)]
+    tables = compute_routing(graph, block=4).with_hosts(host_node)
+    cfg = EngineConfig(
+        num_hosts=num_hosts,
+        queue_capacity=96,
+        outbox_capacity=16,
+        runahead_ns=graph.min_latency_ns(),
+        seed=seed,
+        use_netstack=shaped,
+    )
+    model = BulkTcpModel(num_hosts=num_hosts, num_pairs=num_hosts // 2, total_bytes=30_000)
+    bw = bw_bits_per_sec_to_refill(20_000_000) if shaped else None
+    return cfg, model, tables, host_node, bw
+
+
+@pytest.mark.parametrize(
+    "loss,shaped,end_ms",
+    [(0.0, False, 60), (0.05, False, 200), (0.02, True, 200)],
+    ids=["clean", "lossy", "lossy-shaped"],
+)
+def test_device_tcp_matches_scalar_oracle(loss, shaped, end_ms):
+    cfg, model, tables, host_node, bw = _world(8, loss, shaped, seed=11)
+    end = end_ms * NS_PER_MS
+
+    st = init_state(cfg, model.init(), tx_bytes_per_interval=bw, rx_bytes_per_interval=bw)
+    st = bootstrap(st, model, cfg)
+    st = run_until(st, end, model, tables, cfg, rounds_per_chunk=16)
+
+    ref = CpuRefBulk(cfg, model, tables, host_node,
+                     tx_bytes_per_interval=bw, rx_bytes_per_interval=bw)
+    ref.bootstrap()
+    ref.run_until(end)
+
+    # every TCP state field, bit for bit
+    for f in TCP_FIELDS:
+        dev = np.asarray(getattr(st.model.tcp, f))
+        np.testing.assert_array_equal(dev, ref.tcp_field(f).astype(dev.dtype), err_msg=f)
+
+    # model + engine counters
+    np.testing.assert_array_equal(np.asarray(st.model.conns_established), ref.conns_established)
+    np.testing.assert_array_equal(np.asarray(st.model.conns_closed), ref.conns_closed)
+    np.testing.assert_array_equal(np.asarray(st.model.resets), ref.resets)
+    np.testing.assert_array_equal(np.asarray(st.seq), np.array(ref.seq, np.uint32))
+    np.testing.assert_array_equal(np.asarray(st.rng_counter), np.array(ref.ctr, np.uint32))
+    np.testing.assert_array_equal(np.asarray(st.packets_sent), ref.packets_sent)
+    np.testing.assert_array_equal(np.asarray(st.packets_dropped), ref.packets_dropped)
+    np.testing.assert_array_equal(np.asarray(st.events_handled), ref.events_handled)
+    if shaped:
+        np.testing.assert_array_equal(np.asarray(st.net.codel_dropped), ref.codel_dropped)
+        np.testing.assert_array_equal(np.asarray(st.net.bytes_sent), ref.bytes_sent)
+        np.testing.assert_array_equal(np.asarray(st.net.bytes_recv), ref.bytes_recv)
+
+    # leftover queue contents in canonical order
+    for h in range(cfg.num_hosts):
+        assert equeue.debug_sorted_events(st.queue, h) == ref.queue_contents(h), f"host {h}"
+
+    # the run actually transferred data (oracle self-check)
+    assert sum(int(x) for x in np.asarray(st.model.tcp.delivered).flatten()) > 0
