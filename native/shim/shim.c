@@ -1087,7 +1087,24 @@ ssize_t readv(int fd, const struct iovec *iov, int iovcnt) {
 /* gather an iovec array into the shared scratch buffer; returns the byte
  * count, or (size_t)-1 if the total exceeds the buffer (caller decides
  * between short-write and EMSGSIZE semantics) */
-static char g_iov_tmp[SHIM_BUF_SIZE]; /* single-threaded shim */
+/* One shared gather buffer is safe because guest threads run strictly
+ * one at a time (the kernel's ping-pong discipline). The owner flag
+ * makes that invariant fail loudly rather than silently corrupt if a
+ * future change ever lets two threads gather concurrently. */
+static char g_iov_tmp[SHIM_BUF_SIZE];
+static volatile int g_iov_busy = 0;
+
+static void iov_acquire(void) {
+    if (__atomic_exchange_n(&g_iov_busy, 1, __ATOMIC_ACQUIRE)) {
+        shim_warn("shadow-shim: iov buffer used concurrently — the "
+                  "one-thread-at-a-time invariant is broken\n");
+        shim_raw_syscall(SYS_exit_group, 121L, 0L, 0L, 0L, 0L, 0L);
+    }
+}
+
+static void iov_release(void) {
+    __atomic_store_n(&g_iov_busy, 0, __ATOMIC_RELEASE);
+}
 
 static size_t gather_iov(const struct iovec *iov, size_t cnt) {
     size_t total = 0;
@@ -1103,6 +1120,7 @@ static size_t gather_iov(const struct iovec *iov, size_t cnt) {
 ssize_t writev(int fd, const struct iovec *iov, int iovcnt) {
     if (!g_active || !is_vfd(fd))
         return rsyscall(SYS_writev, fd, iov, iovcnt);
+    iov_acquire();
     size_t total = gather_iov(iov, (size_t)(iovcnt < 0 ? 0 : iovcnt));
     if (total == (size_t)-1) {
         /* stream short-write semantics: send what fits in one message */
@@ -1116,22 +1134,28 @@ ssize_t writev(int fd, const struct iovec *iov, int iovcnt) {
         }
         total = n;
     }
-    return write(fd, g_iov_tmp, total);
+    ssize_t r = write(fd, g_iov_tmp, total);
+    iov_release();
+    return r;
 }
 
 ssize_t sendmsg(int fd, const struct msghdr *msg, int flags) {
     if (!g_active || !is_vfd(fd))
         return rsyscall(SYS_sendmsg, fd, msg, flags);
+    iov_acquire();
     size_t total = gather_iov(msg->msg_iov, msg->msg_iovlen);
     if (total == (size_t)-1) {
         /* the socket type is kernel-side; oversized gathers fail rather
          * than silently truncating a datagram (streams should writev) */
+        iov_release();
         errno = EMSGSIZE;
         return -1;
     }
     /* control messages are not simulated; they are silently dropped */
-    return sendto(fd, g_iov_tmp, total, flags,
-                  (struct sockaddr *)msg->msg_name, msg->msg_namelen);
+    ssize_t r = sendto(fd, g_iov_tmp, total, flags,
+                       (struct sockaddr *)msg->msg_name, msg->msg_namelen);
+    iov_release();
+    return r;
 }
 
 ssize_t recvmsg(int fd, struct msghdr *msg, int flags) {
