@@ -301,6 +301,65 @@ def handle_one_iteration(
     )
 
 
+def handle_one_iteration_compact(
+    st: SimState,
+    window_end: jax.Array,
+    model,
+    tables: RoutingTables,
+    cfg: EngineConfig,
+    lanes: int,
+) -> SimState:
+    """Active-set compaction around handle_one_iteration.
+
+    At scale most hosts are idle in any given pop-iteration (long app
+    pauses, shaping backlogs concentrated on few hosts), yet the
+    full-width iteration pays O(H) work regardless. Here we compact: find
+    the <= `lanes` hosts whose next event is inside the window (O(H)
+    cumsum + scatter), gather their rows of the *entire* SimState into a
+    [lanes]-row sub-state, run the unchanged full iteration there, and
+    scatter the rows back.
+
+    Correctness: hosts are independent within a conservative window (the
+    PDES invariant — packets land next round, local emits stay on-row), so
+    handling any subset per iteration yields bit-identical per-host
+    sequences; eligible hosts beyond `lanes` are simply handled on a later
+    iteration of the same round. Sentinel lanes (when fewer than `lanes`
+    hosts are active) gather row H-1 but are neutralized by forcing their
+    head_time to TIME_MAX (the handler is identity on rows with no popped
+    event) and their write-back is dropped.
+    """
+    h = st.seq.shape[0]
+    elig = equeue.next_time(st.queue) < window_end  # [H]
+    pos = jnp.where(elig, jnp.cumsum(elig.astype(jnp.int32)) - 1, lanes)
+    rows = (
+        jnp.full((lanes,), h, jnp.int32)
+        .at[pos]
+        .set(jnp.arange(h, dtype=jnp.int32), mode="drop")
+    )
+    live = rows < h
+    rows_c = jnp.minimum(rows, h - 1)
+
+    def take(a):
+        return a if jnp.ndim(a) == 0 else a[rows_c]
+
+    sub = jax.tree.map(take, st)
+    sub = sub.replace(
+        queue=sub.queue.replace(
+            head_time=jnp.where(live, sub.queue.head_time, TIME_MAX)
+        )
+    )
+    sub = handle_one_iteration(sub, window_end, model, tables, cfg)
+
+    back = jnp.where(live, rows, h)  # sentinel writes dropped
+
+    def put(full, g):
+        if jnp.ndim(full) == 0:
+            return g  # scalars (min_used_lat) already fold the old value in
+        return full.at[back].set(g, mode="drop")
+
+    return jax.tree.map(put, st, sub)
+
+
 def flush_outbox(
     st: SimState, axis_name: Optional[str], cfg: "EngineConfig | None" = None
 ) -> SimState:
@@ -423,19 +482,37 @@ def run_round(
 ) -> SimState:
     """Drain all events < window_end on every host, then exchange packets."""
 
+    lanes = cfg.active_lanes
+    h_local = st.seq.shape[0]
+    compact = 0 < lanes < h_local
+    # max_iters_per_round bounds *work* per round (one full-width pop wave
+    # per iteration). A compact iteration handles at most `lanes` hosts, so
+    # scale the cap by the wave split factor — otherwise a compact run
+    # could truncate a round a full-width run completes.
+    max_iters = cfg.max_iters_per_round
+    if compact:
+        max_iters *= -(-h_local // lanes)
+
     def cond(carry):
         s, iters = carry
         return jnp.any(equeue.next_time(s.queue) < window_end) & (
-            iters < cfg.max_iters_per_round
+            iters < max_iters
         )
 
     def body(carry):
         s, iters = carry
-        return handle_one_iteration(s, window_end, model, tables, cfg), iters + 1
+        if compact:
+            s = handle_one_iteration_compact(s, window_end, model, tables, cfg, lanes)
+        else:
+            s = handle_one_iteration(s, window_end, model, tables, cfg)
+        return s, iters + 1
 
-    st, _ = jax.lax.while_loop(cond, body, (st, jnp.asarray(0, jnp.int32)))
+    st, iters = jax.lax.while_loop(cond, body, (st, jnp.asarray(0, jnp.int32)))
     st = flush_outbox(st, axis_name, cfg)
-    return st.replace(now=jnp.maximum(st.now, window_end))
+    return st.replace(
+        now=jnp.maximum(st.now, window_end),
+        iters_done=st.iters_done.at[0].add(iters),
+    )
 
 
 def _next_window_end(st: SimState, end_time, cfg: EngineConfig, axis_name):

@@ -54,6 +54,15 @@ class EngineConfig:
     # (never overflows; set lower to cut ICI traffic when destinations are
     # known to spread across shards)
     a2a_capacity: int = 0
+    # Active-set compaction (engine/round.py handle_one_iteration_compact):
+    # per pop-iteration, gather only the <= active_lanes hosts that actually
+    # have an eligible event into a compact sub-state, run the handler
+    # there, and scatter back — per-iteration cost tracks the *active* host
+    # count instead of the world size. 0 = off (full-width iterations).
+    # Results are bit-identical either way; hosts are independent within a
+    # conservative window, so subset scheduling cannot reorder any host's
+    # event sequence.
+    active_lanes: int = 0
     # draws consumed per handled event = model.DRAWS_PER_EVENT + PACKET_EMITS
     # (one loss draw per packet lane), fixed-stride for determinism.
 
@@ -114,6 +123,9 @@ class SimState:
     packets_sent: jax.Array  # [H] i64
     packets_dropped: jax.Array  # [H] i64  (path packet_loss)
     packets_unroutable: jax.Array  # [H] i64  (no path; reference errors hard)
+    # diagnostic: pop-iterations executed, accumulated on each shard's row 0
+    # (sum over the axis = total device iterations; feeds the perf probes)
+    iters_done: jax.Array  # [H] i32
 
     @property
     def num_hosts(self) -> int:
@@ -185,4 +197,5 @@ def init_state(
         packets_sent=jnp.zeros((h,), jnp.int64),
         packets_dropped=jnp.zeros((h,), jnp.int64),
         packets_unroutable=jnp.zeros((h,), jnp.int64),
+        iters_done=jnp.zeros((h,), jnp.int32),
     )

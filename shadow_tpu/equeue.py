@@ -45,6 +45,11 @@ class EventQueue:
     aux: jax.Array  # [H, Q] i32 engine channel (packet size | shaped flag)
     count: jax.Array  # [H] i32 number of valid slots
     overflow: jax.Array  # [H] i32 number of events dropped for lack of slots
+    # Cached exact per-host minimum of `time` (TIME_MAX when empty). Every
+    # mutator maintains it (pushes: running min; pops: row rescan), so the
+    # round loop's eligibility/window math is O(H) instead of an O(H*Q)
+    # scan per check — load-bearing for per-iteration cost at 10k hosts.
+    head_time: jax.Array  # [H] i64
 
     @property
     def num_hosts(self) -> int:
@@ -65,12 +70,13 @@ def create(num_hosts: int, capacity: int) -> EventQueue:
         aux=jnp.zeros((h, q), dtype=jnp.int32),
         count=jnp.zeros((h,), dtype=jnp.int32),
         overflow=jnp.zeros((h,), dtype=jnp.int32),
+        head_time=jnp.full((h,), TIME_MAX, dtype=jnp.int64),
     )
 
 
 def next_time(q: EventQueue) -> jax.Array:
     """[H] i64: each host's earliest pending event time (TIME_MAX if none)."""
-    return jnp.min(q.time, axis=1)
+    return q.head_time
 
 
 @flax.struct.dataclass
@@ -97,9 +103,8 @@ def pop_min(q: EventQueue, want: jax.Array) -> tuple[Popped, EventQueue]:
     is back-filled from slot count-1 to keep rows compact.
     """
     h_idx = jnp.arange(q.num_hosts)
-    slot_idx = jnp.arange(q.capacity)[None, :]
 
-    tmin = jnp.min(q.time, axis=1)  # [H]
+    tmin = q.head_time  # [H]
     at_min = q.time == tmin[:, None]
     tie_masked = jnp.where(at_min, q.tie, _I64_MAX)
     slot = jnp.argmin(tie_masked, axis=1)  # [H]
@@ -116,25 +121,29 @@ def pop_min(q: EventQueue, want: jax.Array) -> tuple[Popped, EventQueue]:
     )
 
     # Back-fill the popped slot with the last valid slot, then clear the last.
-    last = q.count - 1
-    take_last = (slot_idx == slot[:, None]) & valid[:, None]
-    clear = (slot_idx == last[:, None]) & valid[:, None]
+    # Both are O(H) scatters (out-of-bounds column = dropped write), not
+    # full-width where-passes over the [H, Q] slot arrays.
+    last = jnp.maximum(q.count - 1, 0)
+    at_slot = jnp.where(valid, slot, q.capacity)
+    at_last = jnp.where(valid, last, q.capacity)
 
     def fill(arr, empty_val):
         from_last = arr[h_idx, last]
-        if arr.ndim == 3:
-            out = jnp.where(take_last[:, :, None], from_last[:, None, :], arr)
-            return jnp.where(clear[:, :, None], empty_val, out)
-        out = jnp.where(take_last, from_last[:, None], arr)
-        return jnp.where(clear, empty_val, out)
+        out = arr.at[h_idx, at_slot].set(from_last, mode="drop")
+        empty = jnp.broadcast_to(
+            jnp.asarray(empty_val, arr.dtype), from_last.shape
+        )
+        return out.at[h_idx, at_last].set(empty, mode="drop")
 
+    new_time = fill(q.time, TIME_MAX)
     return ev, q.replace(
-        time=fill(q.time, TIME_MAX),
+        time=new_time,
         tie=fill(q.tie, _I64_MAX),
         kind=fill(q.kind, KIND_INVALID),
         data=fill(q.data, 0),
         aux=fill(q.aux, 0),
         count=q.count - valid.astype(jnp.int32),
+        head_time=jnp.min(new_time, axis=1),
     )
 
 
@@ -150,18 +159,19 @@ def push_self(
     """Each host pushes at most one event into its *own* queue (conflict-free)."""
     if aux is None:
         aux = jnp.zeros_like(kind)
-    slot_idx = jnp.arange(q.capacity)[None, :]
+    h_idx = jnp.arange(q.num_hosts)
     has_room = q.count < q.capacity
     write = valid & has_room
-    at = (slot_idx == q.count[:, None]) & write[:, None]
+    col = jnp.where(write, q.count, q.capacity)  # out of bounds -> dropped
     return q.replace(
-        time=jnp.where(at, time[:, None], q.time),
-        tie=jnp.where(at, tie[:, None], q.tie),
-        kind=jnp.where(at, kind[:, None], q.kind),
-        data=jnp.where(at[:, :, None], data[:, None, :], q.data),
-        aux=jnp.where(at, aux[:, None], q.aux),
+        time=q.time.at[h_idx, col].set(time, mode="drop"),
+        tie=q.tie.at[h_idx, col].set(tie, mode="drop"),
+        kind=q.kind.at[h_idx, col].set(kind, mode="drop"),
+        data=q.data.at[h_idx, col].set(data, mode="drop"),
+        aux=q.aux.at[h_idx, col].set(aux, mode="drop"),
         count=q.count + write.astype(jnp.int32),
         overflow=q.overflow + (valid & ~has_room).astype(jnp.int32),
+        head_time=jnp.minimum(q.head_time, jnp.where(write, time, TIME_MAX)),
     )
 
 
@@ -215,6 +225,7 @@ def push_many(
         overflow=q.overflow.at[jnp.where(valid_s & ~fits, key_s, num_hosts)].add(
             (valid_s & ~fits).astype(jnp.int32), mode="drop"
         ),
+        head_time=q.head_time.at[sdst].min(time[order], mode="drop"),
     )
 
 

@@ -1,0 +1,139 @@
+"""Active-set compaction (engine/round.py handle_one_iteration_compact):
+per-iteration gather of only the hosts with an eligible event must be
+bit-identical to the full-width iteration — hosts are independent inside a
+conservative window, so subset scheduling cannot reorder any host's event
+sequence (the compaction analogue of the reference's work-stealing
+scheduler being order-free within a round, thread_per_core.rs:188-206)."""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shadow_tpu.engine import EngineConfig, init_state
+from shadow_tpu.engine.round import bootstrap, run_until
+from shadow_tpu.engine.sharded import ShardedRunner
+from shadow_tpu.graph import NetworkGraph, compute_routing
+from shadow_tpu.models.phold import PholdModel
+from shadow_tpu.models.tgen import TgenModel
+from shadow_tpu.netstack import bw_bits_per_sec_to_refill
+from shadow_tpu.simtime import NS_PER_MS
+
+
+def _lossy_graph(n_nodes=8, seed=7):
+    rng_py = random.Random(seed)
+    lines = ["graph [", "  directed 0"]
+    for i in range(n_nodes):
+        lines.append(f"  node [ id {i} ]")
+        lines.append(f'  edge [ source {i} target {i} latency "2 ms" ]')
+    for i in range(n_nodes):
+        for j in rng_py.sample(range(n_nodes), 3) + [(i + 1) % n_nodes]:
+            if j != i:
+                lat = rng_py.randrange(2, 12)
+                lines.append(
+                    f'  edge [ source {i} target {j} latency "{lat} ms" packet_loss 0.01 ]'
+                )
+    lines.append("]")
+    return NetworkGraph.from_gml("\n".join(lines))
+
+
+def _build_tgen(num_hosts, active_lanes, shaped=True):
+    graph = _lossy_graph()
+    host_node = [i % 8 for i in range(num_hosts)]
+    tables = compute_routing(graph, block=16).with_hosts(host_node)
+    clients = num_hosts // 2
+    cfg = EngineConfig(
+        num_hosts=num_hosts,
+        queue_capacity=128,
+        outbox_capacity=16,
+        runahead_ns=graph.min_latency_ns(),
+        seed=5,
+        use_netstack=shaped,
+        max_iters_per_round=100_000,
+        active_lanes=active_lanes,
+    )
+    model = TgenModel(
+        num_hosts=num_hosts,
+        num_clients=clients,
+        num_servers=num_hosts - clients,
+        resp_bytes=30_000,
+        pause_ns=50 * NS_PER_MS,
+    )
+    bw = bw_bits_per_sec_to_refill(100_000_000) if shaped else None
+    st = init_state(cfg, model.init(), tx_bytes_per_interval=bw, rx_bytes_per_interval=bw)
+    return cfg, model, tables, bootstrap(st, model, cfg)
+
+
+def _assert_states_equal(a, b):
+    fa = jax.tree_util.tree_flatten_with_path(a)[0]
+    fb = jax.tree.flatten(b)[0]
+    assert len(fa) == len(fb)
+    for (path, x), y in zip(fa, fb):
+        name = jax.tree_util.keystr(path)
+        if "iters_done" in name:
+            continue  # diagnostic: compaction legitimately splits waves
+        if jnp.issubdtype(getattr(x, "dtype", None), jax.dtypes.prng_key):
+            x, y = jax.random.key_data(x), jax.random.key_data(y)
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y), err_msg=name)
+
+
+@pytest.mark.parametrize("lanes", [8])
+def test_tgen_compact_bit_identical(lanes):
+    """lanes=8 forces heavy splitting (64 hosts, ~32 clients active at
+    bootstrap, so most iterations handle a strict subset)."""
+    end = 150_000_000
+    cfg0, model, tables, st0 = _build_tgen(64, 0)
+    ref = run_until(st0, end, model, tables, cfg0, rounds_per_chunk=32)
+    cfgc, model, tables, st0c = _build_tgen(64, lanes)
+    got = run_until(st0c, end, model, tables, cfgc, rounds_per_chunk=32)
+    assert int(np.asarray(ref.events_handled).sum()) > 0
+    _assert_states_equal(ref, got)
+
+
+def test_phold_compact_bit_identical():
+    num_hosts = 32
+    graph = _lossy_graph()
+    tables = compute_routing(graph, block=16).with_hosts([i % 8 for i in range(num_hosts)])
+
+    def run(lanes):
+        cfg = EngineConfig(
+            num_hosts=num_hosts,
+            queue_capacity=64,
+            runahead_ns=graph.min_latency_ns(),
+            seed=3,
+            max_iters_per_round=100_000,
+            active_lanes=lanes,
+        )
+        model = PholdModel(num_hosts=num_hosts)
+        st = bootstrap(init_state(cfg, model.init()), model, cfg)
+        return run_until(st, 300_000_000, model, tables, cfg, rounds_per_chunk=32)
+
+    ref, got = run(0), run(6)
+    assert int(np.asarray(ref.events_handled).sum()) > 0
+    _assert_states_equal(ref, got)
+
+
+def test_sharded_compact_matches_single_device():
+    """Compaction under shard_map (per-shard active sets) must still match
+    the unsharded full-width run."""
+    num_hosts = 64
+    end = 150_000_000
+    cfg0, model, tables, st0 = _build_tgen(num_hosts, 0)
+    ref = run_until(st0, end, model, tables, cfg0, rounds_per_chunk=16)
+
+    cfgc, model, tables, stc = _build_tgen(num_hosts, 4)
+    mesh = jax.make_mesh((jax.device_count(),), ("hosts",))
+    runner = ShardedRunner(mesh, model, tables, cfgc, rounds_per_chunk=16)
+    got = runner.run_until(stc, end)
+    for name in ("events_handled", "packets_sent", "packets_dropped"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref, name)), np.asarray(getattr(got, name)), err_msg=name
+        )
+    for name in ("streams_done", "bytes_down", "resets"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref.model, name)),
+            np.asarray(getattr(got.model, name)),
+            err_msg=name,
+        )

@@ -1,0 +1,102 @@
+"""Per-iteration cost breakdown of the round engine on the live backend.
+
+Times one jitted pop-iteration (full-width and compacted), the round
+boundary flush, and isolated stages, at bench shapes. Drives the
+throughput work: if T(compact-128) ~= T(full-8192), the iteration is
+op-dispatch-bound, not memory-bound, and the lever is fewer iterations /
+fewer fused kernels, not smaller tensors.
+
+  python tools/profile_iter.py [hosts] [reps]
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def bench_fn(fn, *args, reps=50):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    hosts = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+    reps = int(sys.argv[2]) if len(sys.argv) > 2 else 30
+
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from bench import _build
+    from shadow_tpu.engine.round import (
+        flush_outbox,
+        handle_one_iteration,
+        handle_one_iteration_compact,
+        run_round,
+    )
+
+    cfg, model, tables, st0 = _build(hosts)
+    we = jnp.asarray(40_000_000, jnp.int64)
+
+    # run a few real rounds first so queues hold a realistic backlog
+    warm = jax.jit(lambda s: run_round(s, we, model, tables, cfg))
+    st = warm(st0)
+    jax.block_until_ready(st.events_handled)
+
+    results = {"backend": jax.default_backend(), "hosts": hosts}
+
+    it_full = jax.jit(lambda s: handle_one_iteration(s, we, model, tables, cfg))
+    results["iter_full_ms"] = round(bench_fn(it_full, st, reps=reps) * 1e3, 3)
+
+    for lanes in (1024, 128):
+        itc = jax.jit(
+            lambda s, L=lanes: handle_one_iteration_compact(s, we, model, tables, cfg, L)
+        )
+        results[f"iter_compact{lanes}_ms"] = round(bench_fn(itc, st, reps=reps) * 1e3, 3)
+
+    fl = jax.jit(lambda s: flush_outbox(s, None, cfg))
+    results["flush_ms"] = round(bench_fn(fl, st, reps=reps) * 1e3, 3)
+
+    # isolated: queue pop only
+    from shadow_tpu import equeue
+
+    pop = jax.jit(lambda s: equeue.pop_min(s.queue, equeue.next_time(s.queue) < we)[1].count)
+    results["pop_only_ms"] = round(bench_fn(pop, st, reps=reps) * 1e3, 3)
+
+    # model handler only (with a fake popped event)
+    def handler_only(s):
+        ev, q = equeue.pop_min(s.queue, equeue.next_time(s.queue) < we)
+        from shadow_tpu.engine.round import Draw
+
+        d = Draw(s.rng_key, s.rng_counter)
+        mstate, lemits, pemits = model.handle(s.model, ev, d, cfg, s.host_id)
+        return jax.tree.map(lambda a: a.sum() if hasattr(a, "sum") else a, (lemits.valid, pemits.valid, mstate.streams_done))
+
+    h = jax.jit(handler_only)
+    results["pop_plus_handler_ms"] = round(bench_fn(h, st, reps=reps) * 1e3, 3)
+
+    # one full round (many iterations) for iteration-count estimation
+    t0 = time.perf_counter()
+    st2 = warm(st)
+    jax.block_until_ready(st2.events_handled)
+    results["one_round_s"] = round(time.perf_counter() - t0, 3)
+    results["events_round2"] = int(
+        jax.device_get(st2.events_handled.sum() - st.events_handled.sum())
+    )
+
+    import json
+
+    print(json.dumps(results), flush=True)
+
+
+if __name__ == "__main__":
+    main()
