@@ -1,0 +1,98 @@
+/* Minimal HTTP/1.0 client guest: resolves the server via the simulated
+ * DNS, fetches `count` documents sequentially (new connection each, like
+ * tgen request/response streams), verifies Content-Length, prints totals.
+ * Usage: http_client <server-hostname> <port> <count> */
+#include <netdb.h>
+#include <netinet/in.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+static int64_t now_ns(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    return (int64_t)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+int main(int argc, char **argv) {
+    if (argc < 4)
+        return 2;
+    const char *host = argv[1];
+    const char *port = argv[2];
+    int count = atoi(argv[3]);
+
+    struct addrinfo hints = {0}, *res;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    if (getaddrinfo(host, port, &hints, &res) != 0) {
+        fprintf(stderr, "getaddrinfo failed\n");
+        return 1;
+    }
+
+    int64_t t0 = now_ns();
+    long total = 0;
+    int ok = 0;
+    char buf[8192];
+    for (int i = 0; i < count; i++) {
+        int fd = socket(AF_INET, SOCK_STREAM, 0);
+        if (fd < 0) {
+            perror("socket");
+            return 1;
+        }
+        if (connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+            perror("connect");
+            return 1;
+        }
+        const char *req = "GET / HTTP/1.0\r\nHost: x\r\n\r\n";
+        ssize_t off = 0, rl = (ssize_t)strlen(req);
+        while (off < rl) {
+            ssize_t w = write(fd, req + off, rl - off);
+            if (w < 0) {
+                perror("write");
+                return 1;
+            }
+            off += w;
+        }
+        long got = 0, body = -1, header_end = -1;
+        char head[1024];
+        size_t hgot = 0;
+        for (;;) {
+            ssize_t r = read(fd, buf, sizeof(buf));
+            if (r < 0) {
+                perror("read");
+                return 1;
+            }
+            if (r == 0)
+                break;
+            if (header_end < 0 && hgot < sizeof(head) - 1) {
+                size_t c = (size_t)r < sizeof(head) - 1 - hgot ? (size_t)r
+                                                               : sizeof(head) - 1 - hgot;
+                memcpy(head + hgot, buf, c);
+                hgot += c;
+                head[hgot] = 0;
+                char *p = strstr(head, "\r\n\r\n");
+                if (p) {
+                    header_end = (long)(p - head) + 4;
+                    char *cl = strstr(head, "Content-Length:");
+                    if (cl)
+                        body = atol(cl + 15);
+                }
+            }
+            got += r;
+        }
+        close(fd);
+        long body_got = header_end >= 0 ? got - header_end : -1;
+        if (header_end >= 0 && body >= 0 && body_got == body)
+            ok++;
+        total += got;
+    }
+    freeaddrinfo(res);
+    int64_t t1 = now_ns();
+    printf("fetched %d/%d docs, %ld bytes, %lld us\n", ok, count, total,
+           (long long)((t1 - t0) / 1000));
+    return ok == count ? 0 : 1;
+}

@@ -1,0 +1,87 @@
+/* Minimal HTTP/1.0 server guest: accepts `nconns` connections; for each,
+ * reads the request until the blank line, then writes a 200 response with
+ * `nbytes` of body and closes (server is the first closer, HTTP/1.0
+ * style). The managed-tier analogue of the reference's http-server
+ * example (examples/http-server/shadow.yaml).
+ * Usage: http_server <port> <nbytes> <nconns> */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+    if (argc < 4)
+        return 2;
+    int port = atoi(argv[1]);
+    long nbytes = atol(argv[2]);
+    int want = atoi(argv[3]);
+
+    int lfd = socket(AF_INET, SOCK_STREAM, 0);
+    if (lfd < 0) {
+        perror("socket");
+        return 1;
+    }
+    int one = 1;
+    setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    struct sockaddr_in sa = {0};
+    sa.sin_family = AF_INET;
+    sa.sin_addr.s_addr = htonl(INADDR_ANY);
+    sa.sin_port = htons(port);
+    if (bind(lfd, (struct sockaddr *)&sa, sizeof(sa)) != 0) {
+        perror("bind");
+        return 1;
+    }
+    if (listen(lfd, 64) != 0) {
+        perror("listen");
+        return 1;
+    }
+
+    char body[4096];
+    for (size_t i = 0; i < sizeof(body); i++)
+        body[i] = (char)('a' + i % 26);
+    char req[4096];
+    int served = 0;
+    while (served < want) {
+        int cfd = accept(lfd, NULL, NULL);
+        if (cfd < 0) {
+            perror("accept");
+            return 1;
+        }
+        size_t got = 0;
+        while (got < sizeof(req) - 1) {
+            ssize_t r = read(cfd, req + got, sizeof(req) - 1 - got);
+            if (r <= 0)
+                break;
+            got += (size_t)r;
+            req[got] = 0;
+            if (strstr(req, "\r\n\r\n"))
+                break;
+        }
+        char hdr[128];
+        int hl = snprintf(hdr, sizeof(hdr),
+                          "HTTP/1.0 200 OK\r\nContent-Length: %ld\r\n\r\n", nbytes);
+        ssize_t off = 0;
+        while (off < hl) {
+            ssize_t w = write(cfd, hdr + off, hl - off);
+            if (w < 0)
+                break;
+            off += w;
+        }
+        long sent = 0;
+        while (sent < nbytes) {
+            long n = nbytes - sent < (long)sizeof(body) ? nbytes - sent
+                                                        : (long)sizeof(body);
+            ssize_t w = write(cfd, body, n);
+            if (w < 0)
+                break;
+            sent += w;
+        }
+        close(cfd);
+        served++;
+    }
+    printf("served %d requests\n", served);
+    return 0;
+}

@@ -233,6 +233,11 @@ class HostOptions:
     ip_addr: Optional[str] = None
     bandwidth_up_bits: Optional[int] = None
     bandwidth_down_bits: Optional[int] = None
+    # Simulated CPU frequency in Hz (reference host.rs:60 cpu_frequency +
+    # cpu.rs:8-50): syscall/vdso time charges scale by native/simulated, so
+    # a half-speed host pays double the kernel-crossing latency. None =
+    # native speed (ratio 1).
+    cpu_frequency_hz: Optional[int] = None
     processes: list = dataclasses.field(default_factory=list)
 
     @classmethod
@@ -249,6 +254,11 @@ class HostOptions:
         if "bandwidth_down" in merged:
             bw = merged.pop("bandwidth_down")
             out.bandwidth_down_bits = None if bw is None else parse_bandwidth_bits_per_sec(bw)
+        if "cpu_frequency" in merged:
+            v = merged.pop("cpu_frequency")
+            out.cpu_frequency_hz = None if v is None else int(v)
+            if out.cpu_frequency_hz is not None and out.cpu_frequency_hz <= 0:
+                raise ValueError(f"hosts.{name}.cpu_frequency must be > 0 Hz")
         out.processes = [ProcessOptions.from_dict(dict(p)) for p in merged.pop("processes", [])]
         _reject_unknown(f"hosts.{name}", merged)
         if out.quantity < 1:

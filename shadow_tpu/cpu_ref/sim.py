@@ -23,7 +23,8 @@ from shadow_tpu.engine.state import EngineConfig
 from shadow_tpu.equeue import PAYLOAD_LANES
 from shadow_tpu.events import KIND_PACKET, pack_tie, tie_src_host
 from shadow_tpu.models.phold import KIND_SEND, PholdModel
-from shadow_tpu.netstack import AUX_SHAPED_BIT, AUX_SIZE_MASK, CoDelRef, TokenBucketRef
+from shadow_tpu.cpu_ref.netstack_ref import CoDelRef, TokenBucketRef
+from shadow_tpu.netstack import AUX_SHAPED_BIT, AUX_SIZE_MASK
 from shadow_tpu.simtime import TIME_MAX
 
 

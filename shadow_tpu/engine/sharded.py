@@ -41,6 +41,22 @@ from shadow_tpu.graph.routing import RoutingTables
 AXIS = "hosts"
 
 
+def auto_a2a_capacity(cfg: "EngineConfig", num_devices: int, safety: int = 4) -> int:
+    """Size the per-peer all_to_all bucket from the topology of the
+    exchange rather than the never-overflow default (= the whole local
+    outbox). With destinations spread over the mesh, each peer sees about
+    1/num_devices of a shard's outbox; `safety` covers skew. Overflow is
+    counted on device and fails loudly via check_capacity, so a too-small
+    bucket is an error, never silent corruption (the exchange seam the
+    reference locks a mutex for, worker.rs:619-629).
+
+    Returns a capacity strictly below the local outbox size once
+    num_devices > safety — that gap is the ICI traffic saving.
+    """
+    local_m = max(1, (cfg.num_hosts // num_devices) * cfg.outbox_capacity)
+    return min(local_m, max(1, -(-safety * local_m // num_devices)))
+
+
 def state_specs(st: SimState):
     """PartitionSpec pytree: host-axis leaves sharded, scalars replicated."""
     return jax.tree.map(

@@ -57,6 +57,31 @@ class NetworkGraph:
         return cls.from_parsed(parse_gml(text))
 
     @classmethod
+    def from_file(cls, path) -> "NetworkGraph":
+        """Load a GML topology file, transparently decompressing
+        .gz/.xz/.bz2 (the reference accepts compressed graphs — its
+        compressed-graph suite, src/test/compressed-graph/; xz there)."""
+        import pathlib
+
+        p = pathlib.Path(path)
+        suffix = p.suffix.lower()
+        if suffix == ".gz":
+            import gzip
+
+            data = gzip.open(p, "rb").read()
+        elif suffix == ".xz":
+            import lzma
+
+            data = lzma.open(p, "rb").read()
+        elif suffix == ".bz2":
+            import bz2
+
+            data = bz2.open(p, "rb").read()
+        else:
+            data = p.read_bytes()
+        return cls.from_gml(data.decode())
+
+    @classmethod
     def one_gbit_switch(cls) -> "NetworkGraph":
         return cls.from_gml(ONE_GBIT_SWITCH_GML)
 

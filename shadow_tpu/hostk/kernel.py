@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from shadow_tpu import netstack, rng
+from shadow_tpu.hostk import shaping
 from shadow_tpu.graph.routing import RoutingTables
 from shadow_tpu.hostk import ipc as I
 from shadow_tpu.hostk import tcp as T
@@ -401,8 +402,8 @@ class ManagedProcess:
             self.vpid,
             I.IpcBlock(
                 tag=f"h{self.host.host_id}p{self.vpid}",
-                vdso_latency_ns=self.kernel.vdso_latency_ns,
-                syscall_latency_ns=self.kernel.syscall_latency_ns,
+                vdso_latency_ns=self.host.vdso_latency_ns,
+                syscall_latency_ns=self.host.syscall_latency_ns,
                 max_unapplied_ns=self.kernel.max_unapplied_ns,
             ),
         )
@@ -602,12 +603,24 @@ class HostKernel:
         self.bytes_recv = 0
         # bandwidth shaping (reference: three relays per host,
         # host.rs:285-296; loopback is unlimited so it has no bucket)
-        self.tx_tb: "Optional[netstack.TokenBucketRef]" = None
-        self.rx_tb: "Optional[netstack.TokenBucketRef]" = None
+        self.tx_tb: "Optional[shaping.TokenBucketRef]" = None
+        self.rx_tb: "Optional[shaping.TokenBucketRef]" = None
         self.nic = NicQueue(kernel, self)  # engaged only under qdisc=rr
-        self.rx_codel = netstack.CoDelRef()
+        self.rx_codel = shaping.CoDelRef()
+        # forked-child pid allocator (see NetKernel._sys_fork): 100k pids
+        # per host keeps ranges disjoint for up to ~21k hosts within pid_t
+        self._fork_vpid_next = 1_000_000 + self.host_id * 100_000
         self.rx_backlog_bytes = 0
         self.codel_dropped = 0
+
+    def alloc_fork_vpid(self) -> int:
+        v = self._fork_vpid_next
+        if v >= 1_000_000 + (self.host_id + 1) * 100_000:
+            raise RuntimeError(
+                f"host {self.name}: >100000 forked children; vpid range exhausted"
+            )
+        self._fork_vpid_next += 1
+        return v
 
     def alloc_port(self, proto: int) -> int:
         while (proto, self.next_port) in self.ports:
@@ -660,6 +673,12 @@ class NetKernel:
         tcp_sack: bool = True,
         tcp_autotune: bool = True,
         qdisc: str = "fifo",
+        owned_hosts: "Optional[list[int]]" = None,
+        data_dir_prepared: bool = False,
+        manager_heartbeat: bool = True,
+        write_hosts_file: bool = True,
+        cpu_freq_hz: "Optional[list[int]]" = None,
+        native_cpu_freq_hz: int = 3_000_000_000,
     ):
         self.tables = tables
         self.lat = np.asarray(tables.lat_ns)
@@ -681,10 +700,20 @@ class NetKernel:
         # configuration.rs:930): fifo = charge order is send order (no
         # queue needed); rr = NicQueue round-robins across sockets
         self.qdisc = qdisc
+        # Host sharding (the parallel managed tier, runtime/hybrid.py
+        # ParallelHybridScheduler): this kernel knows the *whole* world
+        # (names, ips, routing — guests resolve any host) but executes
+        # guests only for `owned_hosts`; None = own everything (serial).
+        # Plays the role of one work-stealing worker thread in the
+        # reference's scheduler (thread_per_core.rs:188-206), with the
+        # host partition static instead of stolen.
+        self.owned = None if owned_hosts is None else set(owned_hosts)
+        self.manager_heartbeat = manager_heartbeat
         self.data_dir = pathlib.Path(data_dir)
-        if self.data_dir.exists():
-            shutil.rmtree(self.data_dir)
-        self.data_dir.mkdir(parents=True)
+        if not data_dir_prepared:
+            if self.data_dir.exists():
+                shutil.rmtree(self.data_dir)
+            self.data_dir.mkdir(parents=True)
 
         self.dns = Dns()
         self.hosts: list[HostKernel] = []
@@ -699,7 +728,8 @@ class NetKernel:
             self.host_by_name[name] = hk
             self.dns.register(name, hk.ip)
         self.hosts_file = self.data_dir / "hosts"
-        self.dns.write_hosts_file(self.hosts_file)
+        if write_hosts_file:
+            self.dns.write_hosts_file(self.hosts_file)
         self._keys = rng.host_keys(seed, len(self.hosts))
         self._draw_cache: "dict[int, tuple[int, np.ndarray]]" = {}
         self.bootstrap_end_ns = bootstrap_end_ns
@@ -707,9 +737,23 @@ class NetKernel:
             up = bw_up_bits[i] if bw_up_bits else 0
             down = bw_down_bits[i] if bw_down_bits else 0
             if up and up > 0:
-                hk.tx_tb = netstack.TokenBucketRef(netstack.bw_bits_per_sec_to_refill(up))
+                hk.tx_tb = shaping.TokenBucketRef(netstack.bw_bits_per_sec_to_refill(up))
             if down and down > 0:
-                hk.rx_tb = netstack.TokenBucketRef(netstack.bw_bits_per_sec_to_refill(down))
+                hk.rx_tb = shaping.TokenBucketRef(netstack.bw_bits_per_sec_to_refill(down))
+            # CPU frequency-ratio delay model (reference cpu.rs:8-50): a
+            # host simulated at half the native frequency pays double the
+            # kernel-crossing time. Deterministic by construction — the
+            # scaled charge replaces the reference's native-wall-clock
+            # measurement, which its own determinism mode must disable.
+            freq = cpu_freq_hz[i] if cpu_freq_hz else 0
+            if freq and freq > 0:
+                hk.syscall_latency_ns = max(
+                    1, syscall_latency_ns * native_cpu_freq_hz // freq
+                )
+                hk.vdso_latency_ns = max(1, vdso_latency_ns * native_cpu_freq_hz // freq)
+            else:
+                hk.syscall_latency_ns = syscall_latency_ns
+                hk.vdso_latency_ns = vdso_latency_ns
 
         self.now = 0
         self._seq = 0
@@ -750,7 +794,13 @@ class NetKernel:
         if pcap:
             from shadow_tpu.utils.pcap import PcapDir
 
-            self.pcap = PcapDir(self.data_dir, [h.name for h in self.hosts])
+            self.pcap = PcapDir(
+                self.data_dir,
+                [h.name for h in self.hosts if self.owns(h.host_id)],
+            )
+
+    def owns(self, host_id: int) -> bool:
+        return self.owned is None or host_id in self.owned
 
     # --- deterministic draws (same threefry streams as the engine) -------
 
@@ -781,9 +831,15 @@ class NetKernel:
 
     # --- config ----------------------------------------------------------
 
-    def add_process(self, spec: ProcessSpec) -> ManagedProcess:
+    def add_process(self, spec: ProcessSpec, vpid: "Optional[int]" = None) -> ManagedProcess:
         host = self.host_by_name[spec.host]
-        proc = ManagedProcess(self, spec, host, vpid=1000 + len(self.procs))
+        if not self.owns(host.host_id):
+            raise ValueError(
+                f"host {spec.host!r} (id {host.host_id}) is not owned by this kernel shard"
+            )
+        # explicit vpid: the parallel scheduler numbers processes globally
+        # so sharded runs match the serial kernel's pids exactly
+        proc = ManagedProcess(self, spec, host, vpid=vpid if vpid is not None else 1000 + len(self.procs))
         self.procs.append(proc)
         host.procs.append(proc)
         self._push(spec.start_ns, lambda p=proc: self._start_proc(p))
@@ -993,8 +1049,8 @@ class NetKernel:
         self._next_tid += 1
         ipc = I.IpcBlock(
             tag=f"h{process.host.host_id}p{process.vpid}t{tid}",
-            vdso_latency_ns=self.vdso_latency_ns,
-            syscall_latency_ns=self.syscall_latency_ns,
+            vdso_latency_ns=process.host.vdso_latency_ns,
+            syscall_latency_ns=process.host.syscall_latency_ns,
             max_unapplied_ns=self.max_unapplied_ns,
         )
         t = GuestThread(process, tid, ipc)
@@ -1299,7 +1355,10 @@ class NetKernel:
 
     def _sys_fork(self, proc, msg):
         parent = proc.process
-        vpid = 1000 + len(self.procs)
+        # per-host deterministic pid range: forked children get pids that
+        # do not depend on global event interleaving, so serial and
+        # host-sharded parallel runs assign identical pids
+        vpid = parent.host.alloc_fork_vpid()
         child = ManagedProcess(self, parent.spec, parent.host, vpid)
         child.parent = parent
         child._stdout_path = parent._stdout_path
@@ -1311,8 +1370,8 @@ class NetKernel:
             f.refcount += 1
         ipc = I.IpcBlock(
             tag=f"h{parent.host.host_id}p{vpid}",
-            vdso_latency_ns=self.vdso_latency_ns,
-            syscall_latency_ns=self.syscall_latency_ns,
+            vdso_latency_ns=parent.host.vdso_latency_ns,
+            syscall_latency_ns=parent.host.syscall_latency_ns,
             max_unapplied_ns=self.max_unapplied_ns,
         )
         main = GuestThread(child, vpid, ipc)
@@ -1478,14 +1537,15 @@ class NetKernel:
         from shadow_tpu.utils.shadow_log import slog
 
         self.progress.clear()  # don't interleave with the \r status line
-        total_sc = sum(self.syscall_counts.values())
-        slog(
-            "info",
-            self.now,
-            "manager",
-            f"heartbeat: {total_sc} syscalls, "
-            f"{sum(h.packets_sent for h in self.hosts)} packets",
-        )
+        if self.manager_heartbeat:
+            total_sc = sum(self.syscall_counts.values())
+            slog(
+                "info",
+                self.now,
+                "manager",
+                f"heartbeat: {total_sc} syscalls, "
+                f"{sum(h.packets_sent for h in self.hosts)} packets",
+            )
         for h in self.hosts:
             if not h.procs:
                 continue
@@ -1605,7 +1665,8 @@ class NetKernel:
         (blocking)."""
         code = int(msg.a[0])
         # fold shim-accumulated local latency, then charge the syscall cost
-        proc.now += int(msg.a[4]) + self.syscall_latency_ns
+        # (per-host: scaled by the CPU frequency ratio, cpu.rs:8-50 role)
+        proc.now += int(msg.a[4]) + proc.process.host.syscall_latency_ns
         name = I.VSYS_NAMES.get(code, str(code))
         self.syscall_counts[name] += 1
         args = tuple(int(x) for x in msg.a[1:4])
@@ -3048,12 +3109,23 @@ class NetKernel:
         including the horizon: an AQM drop timed past horizon_ns is an
         arrival event the serial kernel would never pop, so it must not be
         counted (deliveries past the horizon equivalently land in the heap
-        and never fire)."""
-        from shadow_tpu.models.managed_net import REC_CODEL_DROP, REC_LOSS_DROP
+        and never fire).
 
+        Split into a src-side half (loss revert + send-side pcap) and a
+        dst-side half (delivery push / AQM counter) so the parallel
+        scheduler can route each half to the worker owning that host; the
+        serial path simply applies both."""
         pl = self.payloads.pop((src_host, seq))
+        self.hybrid_record_src_side(flag, t, src_host, seq, pl, horizon_ns)
+        self.hybrid_record_dst_side(flag, t, src_host, seq, pl, horizon_ns)
+
+    def hybrid_record_src_side(
+        self, flag: int, t: int, src_host: int, seq: int, pl: tuple,
+        horizon_ns: "Optional[int]" = None,
+    ) -> None:
+        from shadow_tpu.models.managed_net import REC_LOSS_DROP
+
         src = self.hosts[src_host]
-        past_horizon = horizon_ns is not None and t > horizon_ns
         if pl[0] == "udp":
             _, t_send, dst_id, dst_port, data, src_ip, src_port = pl
             dst = self.hosts[dst_id]
@@ -3066,15 +3138,6 @@ class NetKernel:
                 return
             if self.pcap:
                 self.pcap.udp(src.name, t_send, src_ip, src_port, dst.ip, dst_port, data)
-            if flag == REC_CODEL_DROP:
-                if not past_horizon:
-                    dst.codel_dropped += 1
-                    self.event_log.append((t, f"codel-drop {dst.name} {size}B"))
-                return
-            self._push_packet(
-                t, src_host, seq,
-                lambda: self._deliver(dst, dst_port, data, src_ip, src_port),
-            )
         else:
             _, t_send, dst_id, seg = pl
             dst = self.hosts[dst_id]
@@ -3089,6 +3152,33 @@ class NetKernel:
                 return
             if self.pcap:
                 self.pcap.tcp(src.name, t_send, seg)
+
+    def hybrid_record_dst_side(
+        self, flag: int, t: int, src_host: int, seq: int, pl: tuple,
+        horizon_ns: "Optional[int]" = None,
+    ) -> None:
+        from shadow_tpu.models.managed_net import REC_CODEL_DROP, REC_LOSS_DROP
+
+        if flag == REC_LOSS_DROP:
+            return  # loss is entirely a src-side outcome
+        past_horizon = horizon_ns is not None and t > horizon_ns
+        if pl[0] == "udp":
+            _, t_send, dst_id, dst_port, data, src_ip, src_port = pl
+            dst = self.hosts[dst_id]
+            size = len(data)
+            if flag == REC_CODEL_DROP:
+                if not past_horizon:
+                    dst.codel_dropped += 1
+                    self.event_log.append((t, f"codel-drop {dst.name} {size}B"))
+                return
+            self._push_packet(
+                t, src_host, seq,
+                lambda: self._deliver(dst, dst_port, data, src_ip, src_port),
+            )
+        else:
+            _, t_send, dst_id, seg = pl
+            dst = self.hosts[dst_id]
+            size = seg.wire_len()
             if flag == REC_CODEL_DROP:
                 if not past_horizon:
                     dst.codel_dropped += 1

@@ -34,6 +34,8 @@ transfers, delivery times, and logs.
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -74,6 +76,67 @@ class _SortingPcap:
         for host, t, _i, kind, args in sorted(self._buf, key=lambda r: (r[0], r[1], r[2])):
             getattr(self.inner, kind)(host, t, *args)
         self.inner.close()
+
+
+def _pack_sends(sends: "list[tuple]"):
+    """Pack buffered sends into KIND_MSEND upload arrays (padded to powers
+    of two to bound the jit cache). Shared by the serial and parallel
+    schedulers — the lane layout and tie packing must stay bit-identical
+    between them."""
+    m = len(sends)
+    cap = 8
+    while cap < m:
+        cap *= 2
+    time = np.zeros(cap, np.int64)
+    src = np.zeros(cap, np.int32)
+    data = np.zeros((cap, equeue.PAYLOAD_LANES), np.int32)
+    valid = np.zeros(cap, bool)
+    tie = np.zeros(cap, np.int64)
+    for i, (t, s, seq, ctr, dst, size) in enumerate(sends):
+        time[i] = t
+        src[i] = s
+        valid[i] = True
+        data[i, LANE_DST] = dst
+        data[i, LANE_SRC] = s
+        data[i, LANE_SIZE] = size
+        data[i, LANE_CTR] = np.uint32(ctr).astype(np.int32)
+        data[i, LANE_SEQ] = np.uint32(seq).astype(np.int32)
+        tie[i] = pack_tie(KIND_MSEND, s, seq & 0xFFFFFFFF)
+    return valid, src, time, tie, data
+
+
+def _fetch_records(st):
+    """Pull outcome records off the device in the serial application order
+    (time, src, seq). Returns (t, srcs, seqs, flags, order) or None when
+    empty; raises CapacityError on any device-side overflow."""
+    m = st.model
+    rec = jax.device_get(
+        (
+            m.rec_time,
+            m.rec_data,
+            m.rec_flag,
+            m.rec_overflow,
+            st.queue.overflow,
+            st.outbox.overflow,
+        )
+    )
+    r_time, r_data, r_flag, r_ov, q_ov, o_ov = rec
+    if int(r_ov.sum()) or int(q_ov.sum()) or int(o_ov.sum()):
+        raise CapacityError(
+            f"hybrid device capacity exhausted (records={int(r_ov.sum())}, "
+            f"queue={int(q_ov.sum())}, outbox={int(o_ov.sum())}); raise "
+            f"record_capacity/queue_capacity/outbox_capacity"
+        )
+    hh, aa = np.nonzero(r_flag > 0)
+    if hh.size == 0:
+        return None
+    t = r_time[hh, aa]
+    d = r_data[hh, aa]
+    seqs = d[:, LANE_SEQ].astype(np.uint32)
+    srcs = d[:, LANE_SRC]
+    flags = r_flag[hh, aa]
+    order = np.lexsort((seqs, srcs, t))
+    return t, srcs, seqs, flags, order
 
 
 class HybridScheduler:
@@ -143,68 +206,26 @@ class HybridScheduler:
 
     def _upload_sends(self, sends: "list[tuple]") -> None:
         """Stage buffered sends as KIND_MSEND events on their source hosts'
-        device queues. Shapes are padded to powers of two to bound the jit
-        cache."""
-        m = len(sends)
-        cap = 8
-        while cap < m:
-            cap *= 2
-        time = np.zeros(cap, np.int64)
-        src = np.zeros(cap, np.int32)
-        data = np.zeros((cap, equeue.PAYLOAD_LANES), np.int32)
-        valid = np.zeros(cap, bool)
-        tie = np.zeros(cap, np.int64)
-        for i, (t, s, seq, ctr, dst, size) in enumerate(sends):
-            time[i] = t
-            src[i] = s
-            valid[i] = True
-            data[i, LANE_DST] = dst
-            data[i, LANE_SRC] = s
-            data[i, LANE_SIZE] = size
-            data[i, LANE_CTR] = np.uint32(ctr).astype(np.int32)
-            data[i, LANE_SEQ] = np.uint32(seq).astype(np.int32)
-            tie[i] = pack_tie(KIND_MSEND, s, seq & 0xFFFFFFFF)
+        device queues."""
+        valid, src, time, tie, data = _pack_sends(sends)
         self.st = self._upload_jit(self.st, valid, src, time, tie, data)
-        self.inflight += m
+        self.inflight += len(sends)
 
     def _run_pass(self, window_end: int) -> None:
         self.st = self._pass_jit(self.st, jnp.asarray(window_end, jnp.int64))
         self.device_passes += 1
 
     def _drain_records(self) -> None:
-        m = self.st.model
-        rec = jax.device_get(
-            (
-                m.rec_time,
-                m.rec_data,
-                m.rec_flag,
-                m.rec_overflow,
-                self.st.queue.overflow,
-                self.st.outbox.overflow,
-            )
-        )
-        r_time, r_data, r_flag, r_ov, q_ov, o_ov = rec
-        if int(r_ov.sum()) or int(q_ov.sum()) or int(o_ov.sum()):
-            raise CapacityError(
-                f"hybrid device capacity exhausted (records={int(r_ov.sum())}, "
-                f"queue={int(q_ov.sum())}, outbox={int(o_ov.sum())}); raise "
-                f"record_capacity/queue_capacity/outbox_capacity"
-            )
-        hh, aa = np.nonzero(r_flag > 0)
-        if hh.size == 0:
+        recs = _fetch_records(self.st)
+        if recs is None:
             return
-        t = r_time[hh, aa]
-        d = r_data[hh, aa]
-        seqs = d[:, LANE_SEQ].astype(np.uint32)
-        srcs = d[:, LANE_SRC]
-        flags = r_flag[hh, aa]
-        order = np.lexsort((seqs, srcs, t))
+        t, srcs, seqs, flags, order = recs
         for i in order:
             self.k.hybrid_apply_record(
                 int(flags[i]), int(t[i]), int(srcs[i]), int(seqs[i]),
                 horizon_ns=self._horizon,
             )
-        self.inflight -= hh.size
+        self.inflight -= len(order)
 
     # --- the lockstep loop -------------------------------------------------
 
@@ -240,3 +261,345 @@ class HybridScheduler:
             k.finish(until_ns)
         finally:
             k.shutdown_check()
+
+
+class ParallelHybridScheduler:
+    """Managed guests sharded across worker processes, packets on device.
+
+    The parallel analogue of HybridScheduler (and of the reference's
+    thread_per_core host scheduling, thread_per_core.rs:188-206): hosts
+    are statically partitioned over K kernel-shard worker processes
+    (runtime/hybrid_worker.py); each round window the workers execute
+    their guests concurrently while the parent owns the device engine and
+    routes outcome records back to the worker owning each affected host.
+    Cross-worker packet payloads ride along with the sends and records.
+
+    Determinism: identical to the serial hybrid — per-host event order is
+    fixed by the same heap keys inside each worker, records are applied in
+    the same global (time, src, seq) sort order, and hosts interact only
+    through the device plane, so the partition (and K) cannot change any
+    host's timeline. The parallel-vs-serial equality test pins this.
+    """
+
+    name = "tpu-hybrid-par"
+
+    def __init__(
+        self,
+        tables: RoutingTables,
+        cfg: EngineConfig,
+        *,
+        host_names: "list[str]",
+        host_nodes: "list[int]",
+        specs: "list",
+        num_workers: int = 2,
+        worker_of: "list[int] | None" = None,
+        seed: int = 1,
+        data_dir="shadow-tpu-data",
+        bw_up_bits=None,
+        bw_down_bits=None,
+        host_ips=None,
+        tx_bytes_per_interval=None,
+        rx_bytes_per_interval=None,
+        record_capacity: int = 128,
+        strace_mode: str = "standard",
+        pcap: bool = False,
+        heartbeat_ns: int = 0,
+        bootstrap_end_ns: int = 0,
+        tcp_sack: bool = True,
+        tcp_autotune: bool = True,
+        qdisc: str = "fifo",
+        syscall_latency_ns: int = 1_000,
+        vdso_latency_ns: int = 10,
+        max_unapplied_ns: int = 1_000_000,
+        cpu_freq_hz=None,
+    ):
+        import multiprocessing as mp
+        import pathlib
+        import shutil
+
+        from shadow_tpu.engine.round import validate_runahead
+        from shadow_tpu.runtime.hybrid_worker import worker_main
+
+        validate_runahead(cfg, tables)
+        h = cfg.num_hosts
+        if len(host_names) != h or len(host_nodes) != h:
+            raise ValueError("host_names/host_nodes must cover all cfg.num_hosts")
+        self.tables = tables
+        self.cfg = cfg
+        self.W = cfg.runahead_ns
+        self.model = ManagedNetModel(h, record_capacity=record_capacity)
+        self.st = init_state(
+            cfg,
+            self.model.init(),
+            tx_bytes_per_interval=tx_bytes_per_interval,
+            rx_bytes_per_interval=rx_bytes_per_interval,
+        )
+        self.inflight = 0
+        self.device_passes = 0
+        self._horizon: "int | None" = None
+        # (src, seq) -> (dst, payload-or-None) for records in flight
+        self._send_meta: "dict[tuple[int, int], tuple]" = {}
+
+        model, cfgs, tabs = self.model, self.cfg, self.tables
+
+        def _pass(st, window_end):
+            st = st.replace(model=model.reset_records(st.model))
+            return run_round(st, window_end, model, tabs, cfgs)
+
+        self._pass_jit = jax.jit(_pass)
+
+        def _upload(st, valid, src, time, tie, data):
+            q = equeue.push_many(
+                st.queue,
+                dst=src,
+                valid=valid,
+                time=time,
+                tie=tie,
+                kind=jnp.full(valid.shape, KIND_MSEND, jnp.int32),
+                data=data,
+                aux=jnp.zeros(valid.shape, jnp.int32),
+            )
+            return st.replace(queue=q)
+
+        self._upload_jit = jax.jit(_upload)
+
+        # --- partition + workers -----------------------------------------
+        k = max(1, min(num_workers, h))
+        self.worker_of = (
+            list(worker_of) if worker_of is not None else [i % k for i in range(h)]
+        )
+        if len(self.worker_of) != h or any(not 0 <= w < k for w in self.worker_of):
+            raise ValueError("worker_of must map every host to a worker index")
+        self.num_workers = k
+
+        data_dir = pathlib.Path(data_dir)
+        if data_dir.exists():
+            shutil.rmtree(data_dir)
+        data_dir.mkdir(parents=True)
+
+        self._host_names = list(host_names)
+        name_to_id = {n: i for i, n in enumerate(host_names)}
+        specs_of = [[] for _ in range(k)]
+        for gi, s in enumerate(specs):
+            d = dataclasses.asdict(s) if dataclasses.is_dataclass(s) else dict(s)
+            d["_vpid"] = 1000 + gi  # global numbering, identical to serial
+            specs_of[self.worker_of[name_to_id[d["host"]]]].append(d)
+
+        lat = np.asarray(tables.lat_ns)
+        rel = np.asarray(tables.rel)
+        ctx = mp.get_context("spawn")
+        self._workers = []
+        for w in range(k):
+            init = dict(
+                worker_index=w,
+                lat=lat,
+                rel=rel,
+                host_names=list(host_names),
+                host_nodes=list(host_nodes),
+                seed=seed,
+                data_dir=str(data_dir),
+                window_ns=self.W,
+                bw_up_bits=list(bw_up_bits) if bw_up_bits else None,
+                bw_down_bits=list(bw_down_bits) if bw_down_bits else None,
+                host_ips=list(host_ips) if host_ips else None,
+                strace_mode=strace_mode,
+                pcap=pcap,
+                heartbeat_ns=heartbeat_ns,
+                bootstrap_end_ns=bootstrap_end_ns,
+                tcp_sack=tcp_sack,
+                tcp_autotune=tcp_autotune,
+                qdisc=qdisc,
+                syscall_latency_ns=syscall_latency_ns,
+                vdso_latency_ns=vdso_latency_ns,
+                max_unapplied_ns=max_unapplied_ns,
+                cpu_freq_hz=list(cpu_freq_hz) if cpu_freq_hz else None,
+                owned=[i for i in range(h) if self.worker_of[i] == w],
+                specs=specs_of[w],
+            )
+            parent_conn, child_conn = ctx.Pipe()
+            proc = ctx.Process(target=worker_main, args=(child_conn, init), daemon=True)
+            proc.start()
+            child_conn.close()
+            self._workers.append((proc, parent_conn))
+        for _proc, conn in self._workers:
+            self._expect(conn.recv(), "ready")
+
+    # --- worker plumbing --------------------------------------------------
+
+    @staticmethod
+    def _expect(reply, tag):
+        if reply[0] == "error":
+            raise RuntimeError(f"hybrid worker failed:\n{reply[1]}")
+        if reply[0] != tag:
+            raise RuntimeError(f"unexpected worker reply {reply[0]!r} (wanted {tag!r})")
+        return reply[1:]
+
+    def _broadcast(self, msg, tag):
+        for _p, conn in self._workers:
+            conn.send(msg)
+        return [self._expect(conn.recv(), tag) for _p, conn in self._workers]
+
+    def _grid_end(self, t: int) -> int:
+        return (t // self.W + 1) * self.W
+
+    # --- device interaction (same math as HybridScheduler) ---------------
+
+    def _upload_sends(self, sends: "list[tuple]") -> None:
+        valid, src, time, tie, data = _pack_sends(sends)
+        self.st = self._upload_jit(self.st, valid, src, time, tie, data)
+        self.inflight += len(sends)
+
+    def _run_pass(self, window_end: int) -> None:
+        self.st = self._pass_jit(self.st, jnp.asarray(window_end, jnp.int64))
+        self.device_passes += 1
+
+    def _drain_records(self) -> None:
+        """Fetch outcome records from the device, route each half to the
+        worker(s) owning the src / dst host, preserving the serial global
+        application order within every worker."""
+        recs = _fetch_records(self.st)
+        if recs is None:
+            return
+        t, srcs, seqs, flags, order = recs
+        batches = [[] for _ in self._workers]
+        for i in order:
+            src, seq = int(srcs[i]), int(seqs[i])
+            dst, payload = self._send_meta.pop((src, seq))
+            w_src = self.worker_of[src]
+            w_dst = self.worker_of[dst]
+            rec_t, flag = int(t[i]), int(flags[i])
+            if w_src == w_dst:
+                batches[w_src].append(("both", flag, rec_t, src, seq, None, self._horizon))
+            else:
+                batches[w_src].append(("src", flag, rec_t, src, seq, None, self._horizon))
+                batches[w_dst].append(("dst", flag, rec_t, src, seq, payload, self._horizon))
+        for (_p, conn), batch in zip(self._workers, batches):
+            conn.send(("apply_records", batch))
+        for (_p, conn), _b in zip(self._workers, batches):
+            self._expect(conn.recv(), "ok")
+        self.inflight -= len(order)
+
+    def _run_windows(self, end_ns: int, inclusive: bool) -> "list[tuple]":
+        """All workers execute [.., end_ns) concurrently; returns the
+        merged send list (metadata only; payloads cached for routing)."""
+        replies = self._broadcast(
+            ("run_window", end_ns, inclusive, self._horizon), "sends"
+        )
+        sends = []
+        for (worker_sends,) in replies:
+            for (t, src, seq, ctr, dst, size, payload) in worker_sends:
+                self._send_meta[(src, seq)] = (dst, payload)
+                sends.append((t, src, seq, ctr, dst, size))
+        return sends
+
+    # --- the lockstep loop -------------------------------------------------
+
+    def run(self, until_ns: int) -> None:
+        W = self.W
+        self._horizon = until_ns
+        try:
+            E = W
+            while True:
+                if self.inflight == 0:
+                    # free-run: jump to the window containing the earliest
+                    # pending event anywhere (grid-fixed, so skipping idle
+                    # windows changes nothing)
+                    nts = [
+                        r[0]
+                        for r in self._broadcast(("next_time",), "t")
+                        if r[0] is not None
+                    ]
+                    if not nts:
+                        break
+                    nt = min(nts)
+                    if nt > until_ns:
+                        break
+                    E = self._grid_end(nt)
+                    if E > until_ns:
+                        sends = self._run_windows(until_ns, inclusive=True)
+                    else:
+                        sends = self._run_windows(E, inclusive=False)
+                else:
+                    self._run_pass(E)  # pass A: arrivals < E
+                    self._drain_records()
+                    if E > until_ns:
+                        sends = self._run_windows(until_ns, inclusive=True)
+                    else:
+                        sends = self._run_windows(E, inclusive=False)
+                if sends:
+                    self._upload_sends(sends)
+                    self._run_pass(E)  # pass B: sends < E, arrivals >= E
+                    self._drain_records()
+                if E > until_ns and self.inflight == 0 and not sends:
+                    break
+                E += W
+            self._broadcast(("finish", until_ns), "ok")
+        finally:
+            self._broadcast(("shutdown_check",), "ok")
+
+    # --- inspection / teardown --------------------------------------------
+
+    def stats(self) -> dict:
+        """Aggregate of the worker shards' stats (same shape as
+        NetKernel.stats(), summed; per-host entries come from the owner)."""
+        replies = self._broadcast(("stats",), "stats")
+        merged = None
+        self._event_log = []
+        import collections
+
+        counts: "collections.Counter[str]" = collections.Counter()
+        for (stats, owned, event_log) in replies:
+            self._event_log.extend(event_log)
+            counts.update(stats["syscall_counts"])
+            if merged is None:
+                merged = dict(stats)
+                merged["hosts"] = {}
+                for key in (
+                    "syscalls_handled", "packets_sent", "packets_dropped",
+                    "codel_dropped", "bytes_sent", "bytes_recv", "processes",
+                ):
+                    merged[key] = 0
+            for key in (
+                "syscalls_handled", "packets_sent", "packets_dropped",
+                "codel_dropped", "bytes_sent", "bytes_recv", "processes",
+            ):
+                merged[key] += stats[key]
+            owned_names = {self._host_names[i] for i in owned}
+            for name, entry in stats["hosts"].items():
+                if name in owned_names:
+                    merged["hosts"][name] = entry
+        merged["syscall_counts"] = dict(sorted(counts.items()))
+        merged["hosts"] = dict(sorted(merged["hosts"].items()))
+        return merged
+
+    def event_log(self) -> list:
+        if not hasattr(self, "_event_log"):
+            self.stats()
+        return self._event_log
+
+    def proc_info(self) -> list:
+        out = []
+        for (procs,) in self._broadcast(("proc_info",), "procs"):
+            out.extend(procs)
+        return out
+
+    def unexpected_final_states(self) -> list:
+        out = []
+        for (u,) in self._broadcast(("unexpected",), "u"):
+            out.extend(u)
+        return out
+
+    def shutdown(self) -> None:
+        self._broadcast(("shutdown",), "ok")
+
+    def close(self) -> None:
+        for _p, conn in self._workers:
+            try:
+                conn.send(("exit",))
+                conn.recv()
+            except Exception:
+                pass
+        for p, _conn in self._workers:
+            p.join(timeout=10)
+            if p.is_alive():
+                p.terminate()

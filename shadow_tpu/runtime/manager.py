@@ -43,6 +43,7 @@ class HostInstance:
     # (reference: sim_config.rs Bandwidth resolution)
     bw_up_bits: int = -1
     bw_down_bits: int = -1
+    cpu_freq_hz: int = 0  # 0 = native speed (no CPU delay scaling)
     spec: object = None  # the HostOptions this instance was expanded from
 
 
@@ -126,8 +127,7 @@ class Manager:
             return NetworkGraph.from_gml(ONE_GBIT_SWITCH_GML)
         if g.inline is not None:
             return NetworkGraph.from_gml(g.inline)
-        with open(g.path) as f:
-            return NetworkGraph.from_gml(f.read())
+        return NetworkGraph.from_file(g.path)  # handles .gz/.xz/.bz2 too
 
     def _expand_hosts(self) -> "list[HostInstance]":
         import ipaddress
@@ -163,6 +163,7 @@ class Manager:
                         model_name=spec.processes[0].path,
                         bw_up_bits=bw_up,
                         bw_down_bits=bw_down,
+                        cpu_freq_hz=spec.cpu_frequency_hz or 0,
                         spec=spec,
                     )
                 )
@@ -318,6 +319,28 @@ class Manager:
 
         runahead = self._resolve_runahead(tables)
 
+        specs = [
+            ProcessSpec(
+                host=h.name,
+                args=[p.path] + list(p.args),
+                start_ns=p.start_time_ns,
+                expected_final_state=p.expected_final_state,
+                environment=p.environment,
+                shutdown_ns=p.shutdown_time_ns,
+            )
+            for h in self.hosts
+            for p in h.spec.processes
+        ]
+        sched_name = cfgo.experimental.scheduler
+        if sched_name == "tpu" and cfgo.experimental.interface_qdisc == "rr":
+            raise ValueError(
+                "interface_qdisc: rr requires the serial kernel "
+                "(experimental.scheduler: managed); the device engine's "
+                "egress is FIFO in lane order"
+            )
+        if sched_name == "tpu" and cfgo.general.parallelism > 1:
+            return self._run_managed_parallel(tables, runahead, specs)
+
         k = NetKernel(
             tables,
             host_names=[h.name for h in self.hosts],
@@ -339,27 +362,11 @@ class Manager:
             tcp_sack=cfgo.experimental.use_tcp_sack,
             tcp_autotune=cfgo.experimental.use_tcp_autotune,
             qdisc=cfgo.experimental.interface_qdisc,
+            cpu_freq_hz=[h.cpu_freq_hz for h in self.hosts],
         )
-        for h in self.hosts:
-            for p in h.spec.processes:
-                k.add_process(
-                    ProcessSpec(
-                        host=h.name,
-                        args=[p.path] + list(p.args),
-                        start_ns=p.start_time_ns,
-                        expected_final_state=p.expected_final_state,
-                        environment=p.environment,
-                        shutdown_ns=p.shutdown_time_ns,
-                    )
-                )
+        for s in specs:
+            k.add_process(s)
 
-        sched_name = cfgo.experimental.scheduler
-        if sched_name == "tpu" and cfgo.experimental.interface_qdisc == "rr":
-            raise ValueError(
-                "interface_qdisc: rr requires the serial kernel "
-                "(experimental.scheduler: managed); the device engine's "
-                "egress is FIFO in lane order"
-            )
         if sched_name == "tpu":
             from shadow_tpu.netstack import bw_bits_per_sec_to_refill
             from shadow_tpu.runtime.hybrid import HybridScheduler
@@ -417,6 +424,94 @@ class Manager:
             wall_seconds=wall,
             sim_seconds=end / NS_PER_SEC,
             scheduler=sched_label,
+            unexpected_final_states=unexpected,
+            extra_stats=stats,
+        )
+        slog("info", end, "manager",
+             f"finished: {stats['syscalls_handled']} syscalls, "
+             f"{stats['packets_sent']} packets in {wall:.2f}s wall")
+        self._write_outputs(results)
+        return results
+
+    def _run_managed_parallel(self, tables, runahead: int, specs) -> SimResults:
+        """Managed run with hosts sharded over worker kernel processes
+        (general.parallelism workers) and packets on the device engine —
+        the role of the reference's thread_per_core scheduler
+        (thread_per_core.rs:188-206) with processes instead of threads."""
+        from shadow_tpu.netstack import bw_bits_per_sec_to_refill
+        from shadow_tpu.runtime.hybrid import ParallelHybridScheduler
+
+        cfgo = self.config
+        bw_up = np.array([max(h.bw_up_bits, 0) for h in self.hosts], dtype=np.int64)
+        bw_down = np.array([max(h.bw_down_bits, 0) for h in self.hosts], dtype=np.int64)
+        use_netstack = bool((bw_up > 0).any() or (bw_down > 0).any())
+        ecfg = EngineConfig(
+            num_hosts=len(self.hosts),
+            queue_capacity=cfgo.experimental.queue_capacity,
+            outbox_capacity=cfgo.experimental.outbox_capacity,
+            runahead_ns=runahead,
+            seed=cfgo.general.seed,
+            max_iters_per_round=cfgo.experimental.max_iters_per_round,
+            use_netstack=use_netstack,
+            bootstrap_end_ns=cfgo.general.bootstrap_end_time_ns,
+        )
+        sched = ParallelHybridScheduler(
+            tables,
+            ecfg,
+            host_names=[h.name for h in self.hosts],
+            host_nodes=[h.node_index for h in self.hosts],
+            specs=specs,
+            num_workers=cfgo.general.parallelism,
+            seed=cfgo.general.seed,
+            data_dir=cfgo.general.data_directory,
+            bw_up_bits=[max(h.bw_up_bits, 0) for h in self.hosts],
+            bw_down_bits=[max(h.bw_down_bits, 0) for h in self.hosts],
+            host_ips=[h.ip for h in self.hosts],
+            tx_bytes_per_interval=(
+                np.asarray(bw_bits_per_sec_to_refill(bw_up)) if use_netstack else None
+            ),
+            rx_bytes_per_interval=(
+                np.asarray(bw_bits_per_sec_to_refill(bw_down)) if use_netstack else None
+            ),
+            record_capacity=cfgo.experimental.record_capacity,
+            strace_mode=cfgo.experimental.strace_logging_mode,
+            pcap=cfgo.experimental.use_pcap,
+            heartbeat_ns=cfgo.general.heartbeat_interval_ns,
+            bootstrap_end_ns=cfgo.general.bootstrap_end_time_ns,
+            tcp_sack=cfgo.experimental.use_tcp_sack,
+            tcp_autotune=cfgo.experimental.use_tcp_autotune,
+            syscall_latency_ns=cfgo.experimental.syscall_latency_ns,
+            vdso_latency_ns=cfgo.experimental.vdso_latency_ns,
+            max_unapplied_ns=cfgo.experimental.max_unapplied_cpu_latency_ns,
+            cpu_freq_hz=[h.cpu_freq_hz for h in self.hosts],
+        )
+        end = cfgo.general.stop_time_ns
+        slog("info", 0, "manager",
+             f"starting: {len(self.hosts)} hosts, scheduler={sched.name} "
+             f"({sched.num_workers} workers), {len(specs)} managed processes, "
+             f"stop={fmt_time_ns(end)}")
+        t0 = time.perf_counter()
+        try:
+            try:
+                sched.run(end)
+            finally:
+                sched.shutdown()
+            wall = time.perf_counter() - t0
+            stats = sched.stats()
+            unexpected = sched.unexpected_final_states()
+        finally:
+            sched.close()
+        for u in unexpected:
+            slog("warning", end, "manager", f"unexpected final state: {u}")
+        results = SimResults(
+            hosts=self.hosts,
+            events_handled=stats["syscalls_handled"],
+            packets_sent=stats["packets_sent"],
+            packets_dropped=stats["packets_dropped"],
+            packets_unroutable=0,
+            wall_seconds=wall,
+            sim_seconds=end / NS_PER_SEC,
+            scheduler=sched.name,
             unexpected_final_states=unexpected,
             extra_stats=stats,
         )

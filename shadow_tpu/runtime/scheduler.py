@@ -82,15 +82,19 @@ class CpuRefScheduler:
     def __init__(self, model, tables: RoutingTables, cfg: EngineConfig, host_node,
                  tx_bytes_per_interval=None, rx_bytes_per_interval=None, **_):
         from shadow_tpu.cpu_ref.bulk_ref import CpuRefBulk
+        from shadow_tpu.cpu_ref.tgen_ref import CpuRefTgen
         from shadow_tpu.models.bulk import BulkTcpModel
+        from shadow_tpu.models.tgen import TgenModel
 
         if isinstance(model, PholdModel):
             ref_cls = CpuRefPhold
         elif isinstance(model, BulkTcpModel):
             ref_cls = CpuRefBulk
+        elif isinstance(model, TgenModel):
+            ref_cls = CpuRefTgen
         else:
             raise ValueError(
-                "cpu-ref scheduler supports the phold and bulk-tcp models"
+                "cpu-ref scheduler supports the phold, bulk-tcp, and tgen models"
             )
         self.ref = ref_cls(cfg, model, tables, host_node,
                            tx_bytes_per_interval=tx_bytes_per_interval,

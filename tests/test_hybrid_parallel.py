@@ -1,0 +1,158 @@
+"""Parallel managed tier: hosts sharded across kernel worker processes
+with packets on the device engine must reproduce the serial hybrid
+scheduler's transfers, guest timelines, and stats exactly — the partition
+is an execution detail, never a semantic one (the parallel analogue of
+the reference's thread_per_core host scheduling being order-free within a
+round, thread_per_core.rs:188-206)."""
+
+import pathlib
+import subprocess
+
+import pytest
+
+from shadow_tpu.engine import EngineConfig
+from shadow_tpu.graph import compute_routing
+from shadow_tpu.hostk.kernel import NetKernel, ProcessSpec
+from shadow_tpu.runtime.hybrid import HybridScheduler, ParallelHybridScheduler
+from shadow_tpu.simtime import NS_PER_MS, NS_PER_SEC
+from tests.topo import two_node_graph
+
+GUESTS = pathlib.Path(__file__).parent / "guests"
+W = 1 * NS_PER_MS
+
+
+@pytest.fixture(scope="module")
+def bins(tmp_path_factory):
+    out = tmp_path_factory.mktemp("guests")
+    built = {}
+    for name in ("tcp_echo_server", "tcp_client"):
+        dst = out / name
+        subprocess.run(["cc", "-O2", "-o", str(dst), str(GUESTS / f"{name}.c")], check=True)
+        built[name] = str(dst)
+    return built
+
+
+def _specs(bins, n_pairs, nbytes):
+    specs = []
+    for i in range(n_pairs):
+        specs.append(
+            ProcessSpec(host=f"server{i}", args=[bins["tcp_echo_server"], "8080", "1"])
+        )
+        specs.append(
+            ProcessSpec(
+                host=f"client{i}",
+                args=[bins["tcp_client"], f"server{i}", "8080", str(nbytes)],
+                start_ns=(100 + 10 * i) * NS_PER_MS,
+            )
+        )
+    return specs
+
+
+def _world(n_pairs, loss):
+    graph = two_node_graph(10, loss)
+    host_names = [f"server{i}" for i in range(n_pairs)] + [
+        f"client{i}" for i in range(n_pairs)
+    ]
+    host_nodes = [0] * n_pairs + [1] * n_pairs
+    tables = compute_routing(graph).with_hosts(host_nodes)
+    cfg = EngineConfig(
+        num_hosts=2 * n_pairs,
+        queue_capacity=256,
+        outbox_capacity=64,
+        runahead_ns=W,
+        seed=5,
+    )
+    return tables, cfg, host_names, host_nodes
+
+
+def _run_serial(tmp_path, bins, n_pairs, loss, nbytes, until_s):
+    tables, cfg, host_names, host_nodes = _world(n_pairs, loss)
+    k = NetKernel(
+        tables,
+        host_names=host_names,
+        host_nodes=host_nodes,
+        seed=5,
+        data_dir=tmp_path / "serial",
+        window_ns=W,
+    )
+    runner = HybridScheduler(k, tables, cfg)
+    procs = [k.add_process(s) for s in _specs(bins, n_pairs, nbytes)]
+    try:
+        runner.run(until_s * NS_PER_SEC)
+    finally:
+        k.shutdown()
+    info = [
+        {
+            "host": p.host.name,
+            "stdout": p.stdout(),
+            "exit_code": p.exit_code,
+            "syscalls": [s for _, s, _ in p.syscall_log],
+        }
+        for p in procs
+    ]
+    return k.stats(), sorted(k.event_log), info
+
+
+def _run_parallel(tmp_path, bins, n_pairs, loss, nbytes, until_s, num_workers):
+    tables, cfg, host_names, host_nodes = _world(n_pairs, loss)
+    sched = ParallelHybridScheduler(
+        tables,
+        cfg,
+        host_names=host_names,
+        host_nodes=host_nodes,
+        specs=_specs(bins, n_pairs, nbytes),
+        num_workers=num_workers,
+        seed=5,
+        data_dir=tmp_path / f"par{num_workers}",
+    )
+    try:
+        try:
+            sched.run(until_s * NS_PER_SEC)
+        finally:
+            sched.shutdown()
+        stats = sched.stats()
+        log = sorted(sched.event_log())
+        info = [
+            {
+                "host": p["host"],
+                "stdout": p["stdout"],
+                "exit_code": p["exit_code"],
+                "syscalls": p["syscalls"],
+            }
+            for p in sched.proc_info()
+        ]
+        assert sched.device_passes > 0
+        return stats, log, info
+    finally:
+        sched.close()
+
+
+@pytest.mark.parametrize("loss", [0.0, 0.03])
+def test_parallel_matches_serial(tmp_path, bins, loss):
+    n_pairs, nbytes, until_s = 3, 30_000, 90
+    s_stats, s_log, s_info = _run_serial(tmp_path, bins, n_pairs, loss, nbytes, until_s)
+    p_stats, p_log, p_info = _run_parallel(
+        tmp_path, bins, n_pairs, loss, nbytes, until_s, num_workers=3
+    )
+    by_host_s = {i["host"]: i for i in s_info}
+    by_host_p = {i["host"]: i for i in p_info}
+    assert by_host_s.keys() == by_host_p.keys()
+    for h in by_host_s:
+        assert by_host_s[h]["stdout"] == by_host_p[h]["stdout"], h
+        assert by_host_s[h]["exit_code"] == by_host_p[h]["exit_code"], h
+        assert by_host_s[h]["syscalls"] == by_host_p[h]["syscalls"], h
+    assert s_log == p_log
+    assert s_stats == p_stats
+    # every client actually echoed its payload
+    for h, i in by_host_p.items():
+        if h.startswith("client"):
+            assert f"echoed {nbytes}/{nbytes} bytes".encode() in i["stdout"], h
+
+
+def test_parallel_worker_count_invariant(tmp_path, bins):
+    """K must not change any outcome (partition is execution detail)."""
+    a = _run_parallel(tmp_path, bins, 2, 0.02, 20_000, 90, num_workers=2)
+    b = _run_parallel(tmp_path, bins, 2, 0.02, 20_000, 90, num_workers=4)
+    assert a[0] == b[0]
+    assert a[1] == b[1]
+    assert {i["host"]: i["stdout"] for i in a[2]} == {i["host"]: i["stdout"] for i in b[2]}

@@ -16,13 +16,12 @@ from shadow_tpu.engine import EngineConfig, init_state
 from shadow_tpu.engine.round import bootstrap, round_body_debug, run_until
 from shadow_tpu.graph import NetworkGraph, compute_routing
 from shadow_tpu.models import PholdModel
+from shadow_tpu.cpu_ref.netstack_ref import CoDelRef, TokenBucketRef
 from shadow_tpu.netstack import (
     CODEL_INTERVAL_NS,
     CODEL_TARGET_NS,
     MTU_BYTES,
     REFILL_INTERVAL_NS,
-    CoDelRef,
-    TokenBucketRef,
 )
 from shadow_tpu.simtime import NS_PER_MS
 

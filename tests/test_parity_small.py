@@ -1,0 +1,78 @@
+"""Small parity features: compressed GML topologies (reference
+src/test/compressed-graph/) and the per-host CPU frequency-ratio delay
+model (reference src/main/host/cpu.rs:8-50)."""
+
+import bz2
+import gzip
+import lzma
+
+import numpy as np
+
+from shadow_tpu.config.options import load_config_str
+from shadow_tpu.graph import NetworkGraph, compute_routing
+from shadow_tpu.hostk.kernel import NetKernel
+from tests.topo import two_node_graph
+
+GML = """graph [
+  directed 0
+  node [ id 0 ]
+  node [ id 1 ]
+  edge [ source 0 target 0 latency "1 ms" ]
+  edge [ source 1 target 1 latency "1 ms" ]
+  edge [ source 0 target 1 latency "10 ms" packet_loss 0.01 ]
+]"""
+
+
+def test_compressed_gml_loads_identically(tmp_path):
+    plain = NetworkGraph.from_gml(GML)
+    for suffix, opener in ((".gz", gzip.open), (".xz", lzma.open), (".bz2", bz2.open)):
+        p = tmp_path / f"g.gml{suffix}"
+        with opener(p, "wb") as f:
+            f.write(GML.encode())
+        g = NetworkGraph.from_file(p)
+        np.testing.assert_array_equal(g.lat_ns, plain.lat_ns)
+        np.testing.assert_array_equal(g.rel, plain.rel)
+    # plain files keep working through the same entry point
+    p = tmp_path / "g.gml"
+    p.write_text(GML)
+    g = NetworkGraph.from_file(p)
+    np.testing.assert_array_equal(g.lat_ns, plain.lat_ns)
+
+
+def test_cpu_frequency_config_parses():
+    cfg = load_config_str(
+        """
+general: { stop_time: 1 s }
+hosts:
+  slow:
+    network_node_id: 0
+    cpu_frequency: 1500000000
+    processes: [ { path: /bin/true } ]
+  fast:
+    network_node_id: 0
+    processes: [ { path: /bin/true } ]
+"""
+    )
+    by_name = {h.name: h for h in cfg.hosts}
+    assert by_name["slow"].cpu_frequency_hz == 1_500_000_000
+    assert by_name["fast"].cpu_frequency_hz is None
+
+
+def test_cpu_frequency_scales_syscall_charge(tmp_path):
+    graph = two_node_graph(10, 0.0)
+    tables = compute_routing(graph).with_hosts([0, 1])
+    k = NetKernel(
+        tables,
+        host_names=["half", "native"],
+        host_nodes=[0, 1],
+        data_dir=tmp_path / "d",
+        syscall_latency_ns=1_000,
+        vdso_latency_ns=10,
+        cpu_freq_hz=[1_500_000_000, 0],
+        native_cpu_freq_hz=3_000_000_000,
+    )
+    half, native = k.hosts
+    assert half.syscall_latency_ns == 2_000  # half the clock, double the charge
+    assert half.vdso_latency_ns == 20
+    assert native.syscall_latency_ns == 1_000
+    assert native.vdso_latency_ns == 10
