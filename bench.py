@@ -23,12 +23,10 @@ chunk. The orchestrator walks a retry ladder of smaller configurations
 on crash/hang, and if nothing completes it still reports a rate from
 the furthest partial progress instead of nothing.
 
-Env knobs: SHADOW_TPU_BENCH_HOSTS (default 8192; 10240 runs but the
-tunneled TPU worker dies on multi-minute sustained dispatch sessions at
-that size, so the default stays at the largest reliably-surviving world),
-SHADOW_TPU_BENCH_SIMSEC (default 0.5 — the tunneled worker also dies
-after a few minutes of sustained dispatch, so the horizon stays inside
-that envelope; the rate metric is horizon-independent past one tgen
+Env knobs: SHADOW_TPU_BENCH_HOSTS (default 10240 — the BASELINE.md target
+scale; the round-3 fusion work cut the active phase to a few seconds, so
+the tunneled worker now survives it comfortably), SHADOW_TPU_BENCH_SIMSEC
+(default 0.5; the rate metric is horizon-independent past one tgen
 request/pause cycle), SHADOW_TPU_BENCH_CPU_SIMSEC (default 0.1),
 SHADOW_TPU_FORCE_CPU=1 (run the main measurement on the CPU backend).
 """
@@ -228,7 +226,7 @@ def _run_attempt(env: dict, timeout_s: float) -> dict:
 
 def main():
     role = os.environ.get("SHADOW_TPU_BENCH_ROLE", "main")
-    num_hosts = int(os.environ.get("SHADOW_TPU_BENCH_HOSTS", 8192))
+    num_hosts = int(os.environ.get("SHADOW_TPU_BENCH_HOSTS", 10240))
     sim_sec = float(os.environ.get("SHADOW_TPU_BENCH_SIMSEC", 0.5))
     cpu_sim_sec = float(os.environ.get("SHADOW_TPU_BENCH_CPU_SIMSEC", 0.1))
     rpc = int(os.environ.get("SHADOW_TPU_BENCH_RPC", 16))

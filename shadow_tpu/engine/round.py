@@ -77,16 +77,16 @@ def bootstrap(st: SimState, model, cfg: EngineConfig) -> SimState:
     draw = Draw(st.rng_key, st.rng_counter)
     lemits = model.bootstrap(draw, host_ids)
     lseq, seq_final = _lane_seqs(lemits.valid, st.seq)
-    queue = st.queue
-    for l in range(lemits.valid.shape[1]):
-        queue = equeue.push_self(
-            queue,
-            valid=lemits.valid[:, l],
-            time=lemits.time[:, l],
-            tie=pack_tie(lemits.kind[:, l], host_ids, lseq[:, l]),
-            kind=lemits.kind[:, l],
-            data=lemits.data[:, l, :],
-        )
+    queue = equeue.push_self_lanes(
+        st.queue,
+        valid=lemits.valid,
+        time=lemits.time,
+        tie=pack_tie(
+            lemits.kind, jnp.broadcast_to(host_ids[:, None], lemits.valid.shape), lseq
+        ),
+        kind=lemits.kind,
+        data=lemits.data,
+    )
     return st.replace(
         queue=queue,
         seq=seq_final,
@@ -175,20 +175,21 @@ def handle_one_iteration(
     unroutable = pvalid & (lat >= TIME_MAX)
     loss_lane = getattr(model, "LOSS_COUNTER_LANE", None)
     if loss_lane is None:
-        loss_u = jnp.stack(
-            [draw.uniform(model.DRAWS_PER_EVENT + p) for p in range(ep)], axis=1
-        )  # [H, EP]; one loss draw per packet lane, drawn in lane order
+        # one loss draw per packet lane, drawn in lane order; batched into
+        # a single threefry call (identical per-counter values)
+        ctrs = (
+            draw.counter[:, None]
+            + jnp.uint32(model.DRAWS_PER_EVENT)
+            + jnp.arange(ep, dtype=jnp.uint32)[None, :]
+        )
+        loss_u = rng.uniform_f32_grid(draw.key, ctrs)  # [H, EP]
     else:
         # hybrid managed traffic: the loss counter was allocated from the
         # host's stream at send time on the CPU and rides the payload, so
         # the uniform is bit-identical to the serial kernel's _loss_draw
         # no matter when the event pops here
-        loss_u = jnp.stack(
-            [
-                rng.uniform_f32(st.rng_key, pemits.data[:, p, loss_lane].astype(jnp.uint32))
-                for p in range(ep)
-            ],
-            axis=1,
+        loss_u = rng.uniform_f32_grid(
+            st.rng_key, pemits.data[:, :, loss_lane].astype(jnp.uint32)
         )
     kept = pvalid & ~unroutable & (loss_u < rel)
     dropped = pvalid & ~unroutable & ~(loss_u < rel)
@@ -230,28 +231,31 @@ def handle_one_iteration(
     pseq, seq_final = _lane_seqs(kept, seq_after_locals)
 
     # --- push local events into own queues (row-wise, conflict-free) ---
-    queue = st.queue
+    # One batched multi-lane push: the relay-deferred re-enqueue (same tie,
+    # ordering at `ready` still follows the original total-order key) rides
+    # as lane 0, the model's local lanes follow in lane order — identical
+    # slot assignment to sequential push_self calls, one fused pass.
+    el = lvalid.shape[1]
+    lane_tie = pack_tie(lemits.kind, jnp.broadcast_to(host_ids[:, None], lvalid.shape), lseq)
     if cfg.use_netstack:
-        # re-enqueue relay-deferred arrivals at their dequeue time, same tie
-        # (ordering at `ready` still follows the original total-order key)
-        queue = equeue.push_self(
-            queue,
-            valid=defer,
-            time=ready,
-            tie=ev.tie,
-            kind=ev.kind,
-            data=ev.data,
-            aux=(size_in.astype(jnp.int32) | jnp.int32(AUX_SHAPED_BIT)),
+        p_valid = jnp.concatenate([defer[:, None], lvalid], axis=1)
+        p_time = jnp.concatenate([ready[:, None], lemits.time], axis=1)
+        p_tie = jnp.concatenate([ev.tie[:, None], lane_tie], axis=1)
+        p_kind = jnp.concatenate([ev.kind[:, None], lemits.kind], axis=1)
+        p_data = jnp.concatenate([ev.data[:, None, :], lemits.data], axis=1)
+        p_aux = jnp.concatenate(
+            [(size_in.astype(jnp.int32) | jnp.int32(AUX_SHAPED_BIT))[:, None],
+             jnp.zeros((host_ids.shape[0], el), jnp.int32)],
+            axis=1,
         )
-    for l in range(lvalid.shape[1]):
-        queue = equeue.push_self(
-            queue,
-            valid=lvalid[:, l],
-            time=lemits.time[:, l],
-            tie=pack_tie(lemits.kind[:, l], host_ids, lseq[:, l]),
-            kind=lemits.kind[:, l],
-            data=lemits.data[:, l, :],
-        )
+    else:
+        p_valid, p_time, p_tie = lvalid, lemits.time, lane_tie
+        p_kind, p_data = lemits.kind, lemits.data
+        p_aux = jnp.zeros((host_ids.shape[0], el), jnp.int32)
+    queue = equeue.push_self_lanes(
+        st.queue, valid=p_valid, time=p_time, tie=p_tie, kind=p_kind,
+        data=p_data, aux=p_aux,
+    )
 
     # --- stage surviving packets into own outbox rows ---
     ob = st.outbox

@@ -39,6 +39,18 @@ def uniform_f32(keys: jax.Array, counters: jax.Array) -> jax.Array:
     return jax.vmap(lambda k: random.uniform(k, dtype=jnp.float32))(_draw_keys(keys, counters))
 
 
+def uniform_f32_grid(keys: jax.Array, counters: jax.Array) -> jax.Array:
+    """[H, L] uniforms: draw #counters[h, l] of host h — per-counter values
+    identical to uniform_f32, but one batched threefry computation instead
+    of L separate dispatches (the engine draws one loss uniform per packet
+    lane; on TPU the per-call dispatch floor dominates at L calls)."""
+    return jax.vmap(
+        lambda k, cs: jax.vmap(
+            lambda c: random.uniform(random.fold_in(k, c), dtype=jnp.float32)
+        )(cs)
+    )(keys, counters.astype(jnp.uint32))
+
+
 def bernoulli(keys: jax.Array, counters: jax.Array, p: jax.Array) -> jax.Array:
     """[H] bools, True with probability p (one draw per host)."""
     return uniform_f32(keys, counters) < p

@@ -213,10 +213,18 @@ def create(num_hosts: int, p: TcpParams) -> TcpState:
 
 
 def _g(a: jax.Array, slot: jax.Array) -> jax.Array:
-    """a[h, slot[h], ...] for every host h."""
-    idx = slot.reshape(slot.shape[0], *([1] * (a.ndim - 1)))
-    idx = jnp.broadcast_to(idx, (a.shape[0], 1) + a.shape[2:])
-    return jnp.take_along_axis(a, idx, axis=1)[:, 0]
+    """a[h, slot[h], ...] for every host h.
+
+    One-hot masked reduction rather than take_along_axis: gather HLOs do
+    not fuse on TPU (each costs a fixed dispatch, and gather_slot touches
+    every TcpState field), while the mask+select+sum chain fuses across
+    all fields into one pass. S is tiny, so the redundant reads are free.
+    """
+    onehot = jnp.arange(a.shape[1])[None, :] == slot[:, None]  # [H, S]
+    oh = onehot.reshape(onehot.shape + (1,) * (a.ndim - 2))
+    if a.dtype == jnp.bool_:
+        return jnp.any(oh & a, axis=1)
+    return jnp.sum(jnp.where(oh, a, 0), axis=1).astype(a.dtype)
 
 
 def _s(a: jax.Array, slot: jax.Array, mask: jax.Array, new: jax.Array) -> jax.Array:
