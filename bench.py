@@ -86,7 +86,9 @@ def _build(num_hosts: int, seed: int = 7):
     clients = num_hosts // 2
     cfg = EngineConfig(
         num_hosts=num_hosts,
-        queue_capacity=256,
+        # 384 slots: SACK-paced recovery keeps more retransmissions in
+        # flight during loss bursts than NewReno did; 256 overflowed at 10k
+        queue_capacity=384,
         outbox_capacity=32,
         runahead_ns=graph.min_latency_ns(),
         seed=seed,

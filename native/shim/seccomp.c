@@ -120,6 +120,13 @@ static const int TRAPPED[] = {
     262 /*newfstatat*/, 332 /*statx*/,     100 /*times*/,
     98 /*getrusage*/,  309 /*getcpu*/,
     307 /*sendmmsg*/,  299 /*recvmmsg*/,
+    /* NOTE: SYS_mmap/munmap/brk are deliberately NOT trapped. glibc
+     * issues them inside thread-lifecycle windows (stack setup before a
+     * new thread's IPC channel exists, teardown after it is gone) where
+     * a ledger notification would desync the syscall channel; the
+     * address-space ledger therefore covers libc-level calls (shim.c
+     * mmap/munmap/mremap/brk/sbrk interposers), not raw glibc-internal
+     * mappings. */
 };
 #define NTRAPPED ((int)(sizeof(TRAPPED) / sizeof(TRAPPED[0])))
 

@@ -128,6 +128,9 @@ enum {
     VSYS_FUTEX_WAKE = 63,    /* a[1]=addr a[2]=max -> n woken */
     VSYS_FUTEX_REQUEUE = 64, /* a[1]=addr a[2]=nwake a[3]=nrequeue
                                 a[5]=addr2 -> n woken + requeued */
+    VSYS_MM_NOTE = 66,       /* a[1]=op(1 mmap,2 munmap,3 brk,4 mremap)
+                              * a[2]=addr a[3]=len, buf = 4 x i64
+                              * (prot, flags, fd, offset-or-old-addr) */
     VSYS_SIGMASK = 65,       /* a[1]=new 64-bit blocked mask (kernel-side
                                 delivery honors it; syscall/signal.c) */
 };

@@ -222,6 +222,14 @@ static void shim_warn(const char *msg) {
 
 static ShimShmem *g_shm = NULL;
 static int g_active = 0;
+
+static void *raw_mmap(void *addr, size_t len, int prot, int flags, int fd,
+                      long off) {
+    long r = shim_raw_syscall(SYS_mmap, (long)addr, (long)len, (long)prot,
+                              (long)flags, (long)fd, off);
+    return (r < 0 && r > -4096) ? MAP_FAILED : (void *)r;
+}
+
 static int64_t g_vpid = 0;
 static int64_t g_ppid = 0; /* parent's vpid for forked children */
 static uint32_t g_host_ip = 0; /* simulated address, host byte order */
@@ -325,6 +333,9 @@ static int64_t vsys_ex(int code, int64_t a1, int64_t a2, int64_t a3, int64_t a5,
     return m.ret;
 }
 
+static ssize_t vfd_write_chunked(int code, int fd, int64_t a2, int64_t a3,
+                                 int64_t a4, const void *buf, size_t n);
+
 static int64_t vsys(int code, int64_t a1, int64_t a2, int64_t a3,
                     const void *out_buf, uint32_t out_len, ShimMsg *reply) {
     return vsys_ex(code, a1, a2, a3, 0, out_buf, out_len, reply);
@@ -356,8 +367,8 @@ __attribute__((constructor)) static void shim_attach(void) {
     int fd = open(path, O_RDWR);
     if (fd < 0)
         return;
-    void *p = mmap(NULL, SHIM_SHMEM_SIZE, PROT_READ | PROT_WRITE, MAP_SHARED,
-                   fd, 0);
+    void *p = raw_mmap(NULL, SHIM_SHMEM_SIZE, PROT_READ | PROT_WRITE,
+                       MAP_SHARED, fd, 0);
     close(fd);
     if (p == MAP_FAILED)
         return;
@@ -582,8 +593,8 @@ static void *thread_trampoline(void *p) {
     int fd = open(tb.path, O_RDWR);
     if (fd < 0)
         return NULL;
-    void *m = mmap(NULL, SHIM_SHMEM_SIZE, PROT_READ | PROT_WRITE, MAP_SHARED,
-                   fd, 0);
+    void *m = raw_mmap(NULL, SHIM_SHMEM_SIZE, PROT_READ | PROT_WRITE,
+                       MAP_SHARED, fd, 0);
     close(fd);
     if (m == MAP_FAILED)
         return NULL;
@@ -742,8 +753,8 @@ pid_t fork(void) {
         /* child: leave the parent's (shared) block alone and adopt our own.
          * Only the forking thread survives; reset all per-thread state. */
         int fd = open(path, O_RDWR);
-        void *m = fd >= 0 ? mmap(NULL, SHIM_SHMEM_SIZE, PROT_READ | PROT_WRITE,
-                                 MAP_SHARED, fd, 0)
+        void *m = fd >= 0 ? raw_mmap(NULL, SHIM_SHMEM_SIZE,
+                                     PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0)
                           : MAP_FAILED;
         if (fd >= 0)
             close(fd);
@@ -1121,22 +1132,43 @@ ssize_t writev(int fd, const struct iovec *iov, int iovcnt) {
     if (!g_active || !is_vfd(fd))
         return rsyscall(SYS_writev, fd, iov, iovcnt);
     iov_acquire();
-    size_t total = gather_iov(iov, (size_t)(iovcnt < 0 ? 0 : iovcnt));
-    if (total == (size_t)-1) {
-        /* stream short-write semantics: send what fits in one message */
+    /* walk the iovec array in <= SHIM_BUF_SIZE gathers so a writev of any
+     * total size completes fully on blocking fds (mirrors write()'s
+     * chunking); a short kernel round ends the loop with the POSIX short
+     * count. */
+    size_t done = 0;
+    int i = 0;
+    size_t off = 0;
+    ssize_t ret = 0;
+    while (i < iovcnt) {
         size_t n = 0;
-        for (int i = 0; i < iovcnt && n < sizeof(g_iov_tmp); i++) {
-            size_t take = iov[i].iov_len;
+        while (i < iovcnt && n < sizeof(g_iov_tmp)) {
+            size_t avail = iov[i].iov_len - off;
+            size_t take = avail;
             if (take > sizeof(g_iov_tmp) - n)
                 take = sizeof(g_iov_tmp) - n;
-            memcpy(g_iov_tmp + n, iov[i].iov_base, take);
+            memcpy(g_iov_tmp + n, (const char *)iov[i].iov_base + off, take);
             n += take;
+            off += take;
+            if (off == iov[i].iov_len) {
+                i++;
+                off = 0;
+            }
         }
-        total = n;
+        if (n == 0)
+            break;
+        ssize_t r = write(fd, g_iov_tmp, n);
+        if (r < 0) {
+            ret = done ? (ssize_t)done : -1;
+            iov_release();
+            return ret;
+        }
+        done += (size_t)r;
+        if ((size_t)r < n)
+            break;
     }
-    ssize_t r = write(fd, g_iov_tmp, total);
     iov_release();
-    return r;
+    return (ssize_t)done;
 }
 
 ssize_t sendmsg(int fd, const struct msghdr *msg, int flags) {
@@ -1145,9 +1177,12 @@ ssize_t sendmsg(int fd, const struct msghdr *msg, int flags) {
     iov_acquire();
     size_t total = gather_iov(msg->msg_iov, msg->msg_iovlen);
     if (total == (size_t)-1) {
-        /* the socket type is kernel-side; oversized gathers fail rather
-         * than silently truncating a datagram (streams should writev) */
         iov_release();
+        if (msg->msg_name == NULL)
+            /* connected stream send: chunk like writev (TCP never sees
+             * EMSGSIZE natively); control messages are not simulated */
+            return writev(fd, msg->msg_iov, (int)msg->msg_iovlen);
+        /* oversized *datagram*: all-or-nothing, never truncated */
         errno = EMSGSIZE;
         return -1;
     }
@@ -1410,6 +1445,17 @@ ssize_t sendto(int fd, const void *buf, size_t n, int flags,
     int64_t ip = -1, port = -1;
     if (addr)
         addr_to_parts(addr, len, &ip, &port);
+    if (n > SHIM_BUF_SIZE) {
+        if (addr) { /* dgram with destination: all-or-nothing, never split */
+            errno = EMSGSIZE;
+            return -1;
+        }
+        /* connected send: stream chunking, invisible to the guest. (A
+         * connected-UDP send this large would be EMSGSIZE natively; TCP —
+         * the case that matters — gets full-write semantics.) */
+        return vfd_write_chunked(VSYS_SENDTO, fd, -1, -1,
+                                 (flags & MSG_DONTWAIT) != 0, buf, n);
+    }
     int64_t r = vsys_ex(VSYS_SENDTO, fd, ip, port, (flags & MSG_DONTWAIT) != 0,
                         buf, (uint32_t)n, NULL);
     if (r < 0) {
@@ -1668,15 +1714,36 @@ ssize_t read(int fd, void *buf, size_t n) {
     return (ssize_t)cp;
 }
 
+/* Stream write with kernel-invisible chunking: one guest write() of any
+ * size completes fully on blocking fds (the kernel blocks inside each
+ * chunk when buffers fill), because a single IPC message carries at most
+ * SHIM_BUF_SIZE bytes. Short kernel rounds (nonblocking fds) surface as
+ * POSIX short writes. */
+static ssize_t vfd_write_chunked(int code, int fd, int64_t a2, int64_t a3,
+                                 int64_t a4, const void *buf, size_t n) {
+    size_t done = 0;
+    do {
+        uint32_t take =
+            n - done > SHIM_BUF_SIZE ? SHIM_BUF_SIZE : (uint32_t)(n - done);
+        int64_t r =
+            vsys_ex(code, fd, a2, a3, a4, (const char *)buf + done, take, NULL);
+        if (r < 0) {
+            if (done)
+                return (ssize_t)done;
+            errno = (int)-r;
+            return -1;
+        }
+        done += (size_t)r;
+        if ((size_t)r < take)
+            break; /* kernel short write: nonblocking fd out of room */
+    } while (done < n);
+    return (ssize_t)done;
+}
+
 ssize_t write(int fd, const void *buf, size_t n) {
     if (!g_active || !is_vfd(fd))
         return rsyscall(SYS_write, fd, buf, n);
-    int64_t r = vsys(VSYS_WRITE, fd, 0, 0, buf, (uint32_t)n, NULL);
-    if (r < 0) {
-        errno = (int)-r;
-        return -1;
-    }
-    return (ssize_t)r;
+    return vfd_write_chunked(VSYS_WRITE, fd, 0, 0, 0, buf, n);
 }
 
 int pipe2(int fds[2], int flags) {
@@ -1767,6 +1834,92 @@ int openat64(int dirfd, const char *path, int flags, ...) {
 
 int creat(const char *path, mode_t mode) {
     return open(path, O_CREAT | O_WRONLY | O_TRUNC, mode);
+}
+
+/* ---- memory-map bookkeeping ----
+ * The reference owns guest memory through its MemoryManager
+ * (memory_manager/mod.rs:1-17, memory_mapper.rs:73-312) because it must
+ * remap guest pages into shadow. This design never remaps — payloads ride
+ * the shm channel — so what remains of that component's role is the
+ * *ledger*: shadow tracks every guest mapping and the program break, so
+ * the kernel can answer address-space questions and audits deterministic
+ * resource use. Mappings execute natively (guest-private memory), then
+ * the region change is reported on the syscall channel. The shim's own
+ * channel blocks use raw_mmap and stay out of the ledger. */
+
+static void mm_note(int op, uint64_t addr, uint64_t len, int64_t prot,
+                    int64_t flags, int64_t fd, int64_t off) {
+    if (!g_active)
+        return;
+    int64_t extra[4] = {prot, flags, fd, off};
+    vsys(VSYS_MM_NOTE, op, (int64_t)addr, (int64_t)len, extra, sizeof(extra),
+         NULL);
+}
+
+void *mmap(void *addr, size_t len, int prot, int flags, int fd, off_t off) {
+    long r = shim_raw_syscall(SYS_mmap, (long)addr, (long)len, (long)prot,
+                              (long)flags, (long)fd, (long)off);
+    if (r < 0 && r > -4096) {
+        errno = (int)-r;
+        return MAP_FAILED;
+    }
+    if (g_active)
+        mm_note(1, (uint64_t)r, len, prot, flags, is_vfd(fd) ? -2 : fd, off);
+    return (void *)r;
+}
+
+void *mmap64(void *addr, size_t len, int prot, int flags, int fd, off_t off) {
+    return mmap(addr, len, prot, flags, fd, off);
+}
+
+int munmap(void *addr, size_t len) {
+    long r = shim_raw_syscall(SYS_munmap, (long)addr, (long)len, 0, 0, 0, 0);
+    if (r == 0 && g_active)
+        mm_note(2, (uint64_t)addr, len, 0, 0, -1, 0);
+    if (r < 0) {
+        errno = (int)-r;
+        return -1;
+    }
+    return 0;
+}
+
+void *mremap(void *old_addr, size_t old_len, size_t new_len, int flags, ...) {
+    va_list ap;
+    va_start(ap, flags);
+    void *new_fixed = (flags & MREMAP_FIXED) ? va_arg(ap, void *) : NULL;
+    va_end(ap);
+    long r = shim_raw_syscall(SYS_mremap, (long)old_addr, (long)old_len,
+                              (long)new_len, (long)flags, (long)new_fixed, 0);
+    if (r < 0 && r > -4096) {
+        errno = (int)-r;
+        return MAP_FAILED;
+    }
+    if (g_active)
+        mm_note(4, (uint64_t)r, new_len, 0, flags, -1, (int64_t)(uint64_t)old_addr);
+    return (void *)r;
+}
+
+/* libc tier: delegate to the real glibc brk/sbrk (they maintain glibc's
+ * cached __curbrk — going behind their back corrupts malloc) and report
+ * the resulting break to the ledger. */
+int brk(void *addr) {
+    static int (*real_brk)(void *) = NULL;
+    if (!real_brk)
+        real_brk = (int (*)(void *))dlsym(RTLD_NEXT, "brk");
+    int r = real_brk ? real_brk(addr) : -1;
+    if (r == 0 && g_active)
+        mm_note(3, (uint64_t)(uintptr_t)addr, 0, 0, 0, -1, 0);
+    return r;
+}
+
+void *sbrk(intptr_t inc) {
+    static void *(*real_sbrk)(intptr_t) = NULL;
+    if (!real_sbrk)
+        real_sbrk = (void *(*)(intptr_t))dlsym(RTLD_NEXT, "sbrk");
+    void *old = real_sbrk ? real_sbrk(inc) : (void *)-1;
+    if (old != (void *)-1 && inc != 0 && g_active)
+        mm_note(3, (uint64_t)((uintptr_t)old + inc), 0, 0, 0, -1, 0);
+    return old;
 }
 
 /* ---- eventfd / timerfd ---- */
@@ -2308,7 +2461,6 @@ void RAND_add(const void *buf, int num, double entropy) {
 
 long shim_route_syscall(long nr, long a1, long a2, long a3, long a4, long a5,
                         long a6) {
-    (void)a6;
     if (!g_active || t_detached_from_sim)
         /* teardown race, or a thread past its simulated exit: native */
         return shim_raw_syscall(nr, a1, a2, a3, a4, a5, a6);

@@ -44,6 +44,8 @@ from shadow_tpu.transport.header import (
     LANE_ACK,
     LANE_FLAGS_LEN,
     LANE_PORTS,
+    LANE_SACK_E,
+    LANE_SACK_S,
     LANE_SEQ,
     LANE_WND,
 )
@@ -81,10 +83,10 @@ class Slot:
     __slots__ = (
         "st", "lport", "rport", "rhost", "snd_una", "snd_nxt", "snd_max",
         "snd_end", "fin_pending", "fin_sent", "peer_wnd", "rcv_nxt",
-        "rcv_fin", "delivered", "ooo", "cwnd", "ssthresh", "dupacks",
-        "recover", "in_rec", "srtt", "rttvar", "rto", "rtt_pending",
-        "rtt_seq", "rtt_ts", "rto_expire", "backoff", "tev_time",
-        "retransmits", "segs_in", "segs_out",
+        "rcv_fin", "delivered", "ooo", "sacked", "rtx_mark", "cwnd", "ssthresh",
+        "dupacks", "recover", "in_rec", "srtt", "rttvar", "rto",
+        "rtt_pending", "rtt_seq", "rtt_ts", "rto_expire", "backoff",
+        "tev_time", "retransmits", "segs_in", "segs_out",
     )
 
     def __init__(self, p):
@@ -110,6 +112,8 @@ class Slot:
         self.rcv_fin = -1
         self.delivered = 0
         self.ooo = [[-1, -1] for _ in range(p.ooo_ranges)]
+        self.sacked = [[-1, -1] for _ in range(p.ooo_ranges)]
+        self.rtx_mark = 0
         self.cwnd = p.init_cwnd_segs * p.mss
         self.ssthresh = 1 << 40
         self.dupacks = 0
@@ -171,26 +175,30 @@ class Slot:
             for i in hits:
                 self.ooo[i] = [-1, -1]
 
-    def ooo_insert(self, s, e):
+    @staticmethod
+    def _range_insert(ranges, s, e):
         """_ooo_insert: merge all overlapping ranges with [s, e); place the
         merged range in the first overlapping-or-empty slot; silently drop
         when the set is full and disjoint (exactly the vector semantics)."""
         ms, me = s, e
         overlap = []
-        for i, (rs, re) in enumerate(self.ooo):
+        for i, (rs, re) in enumerate(ranges):
             if rs >= 0 and s <= re and e >= rs:
                 overlap.append(i)
                 ms = min(ms, rs)
                 me = max(me, re)
         ins = None
-        for i, (rs, re) in enumerate(self.ooo):
+        for i, (rs, re) in enumerate(ranges):
             if i in overlap or rs < 0:
                 ins = i
                 break
         for i in overlap:
-            self.ooo[i] = [-1, -1]
+            ranges[i] = [-1, -1]
         if ins is not None:
-            self.ooo[ins] = [ms, me]
+            ranges[ins] = [ms, me]
+
+    def ooo_insert(self, s, e):
+        self._range_insert(self.ooo, s, e)
 
 
 class CpuRefTcpBase:
@@ -430,6 +438,22 @@ class CpuRefTcpBase:
                 if valid_ack:
                     v.rto_expire = (t + v.rto) if outstanding else TIME_MAX
 
+                # SACK scoreboard (mirrors the vector order: insert the
+                # reported block, then drop ranges covered by the
+                # post-advance cumulative ACK)
+                if p.use_sack:
+                    ss_w, se_w = data[LANE_SACK_S], data[LANE_SACK_E]
+                    if m_ackp and ss_w != se_w:
+                        v._range_insert(
+                            v.sacked,
+                            _unwrap32(v.snd_una, ss_w),
+                            _unwrap32(v.snd_una, se_w),
+                        )
+                    if m_ackp:
+                        for i, (rs, re) in enumerate(v.sacked):
+                            if rs >= 0 and re <= v.snd_una:
+                                v.sacked[i] = [-1, -1]
+
                 dup = (
                     m_ackp
                     and not valid_ack
@@ -449,7 +473,28 @@ class CpuRefTcpBase:
                     v.in_rec = True
                 elif dup and v.in_rec:
                     v.cwnd += p.mss
-                rtx_hole = rtx_hole or dup3
+                if p.use_sack:
+                    # first unsacked hole per the tally, marched once per
+                    # episode (the managed _last_rexmit marks)
+                    hole_rx = v.snd_una
+                    for _ in range(len(v.sacked)):
+                        reach = -1
+                        for rs, re in v.sacked:
+                            if rs >= 0 and rs <= hole_rx < re:
+                                reach = max(reach, re)
+                        hole_rx = max(hole_rx, reach)
+                    sack_any = any(rs >= 0 for rs, _re in v.sacked)
+                    march = (
+                        dup and v.in_rec and sack_any
+                        and hole_rx > v.rtx_mark and hole_rx < v.snd_max
+                    )
+                    rtx_hole = rtx_hole or dup3 or march
+                    if full_ack:
+                        v.rtx_mark = 0
+                    elif rtx_hole:
+                        v.rtx_mark = hole_rx
+                else:
+                    rtx_hole = rtx_hole or dup3
 
                 fin_acked = m_ackp and v.fin_sent and v.snd_una >= v.snd_end + 1
                 if fin_acked:
@@ -535,6 +580,9 @@ class CpuRefTcpBase:
                 w.backoff += 1
                 w.rtt_pending = False
                 w.rto_expire = TIME_MAX
+                if p.use_sack:  # reneging safety: timeout clears the tally
+                    w.sacked = [[-1, -1] for _ in range(p.ooo_ranges)]
+                    w.rtx_mark = 0
 
         # ---------------- OUTPUT pass ------------------------------------
         if m_act:
@@ -557,7 +605,15 @@ class CpuRefTcpBase:
             wnd_lim = o.snd_una + min(o.cwnd, o.peer_wnd)
             fin_lim = o.snd_end + (1 if o.fin_pending else 0)
 
-            cursor = o.snd_una if (rtx_hole and can_send) else o.snd_nxt
+            hole = o.snd_una
+            if p.use_sack:
+                for _ in range(len(o.sacked)):
+                    reach = -1
+                    for rs, re in o.sacked:
+                        if rs >= 0 and rs <= hole < re:
+                            reach = max(reach, re)
+                    hole = max(hole, reach)
+            cursor = hole if (rtx_hole and can_send) else o.snd_nxt
             is_first_rtx = rtx_hole and can_send
             if is_first_rtx:
                 o.rtt_pending = False  # Karn
@@ -642,10 +698,15 @@ class CpuRefTcpBase:
         # control lane (ACK / stray RST) — post-output freshness
         if m_act and need_ack:
             va = slots[act_i]
+            ss = se = 0
+            if p.use_sack:
+                present = [(rs, re) for rs, re in va.ooo if rs >= 0]
+                if present:
+                    ss, se = min(present)  # lowest-start buffered range
             p_lanes[p.segs_per_flush] = (
                 va.rhost,
                 self._mk_seg(va.lport, va.rport, va.snd_nxt, va.rcv_nxt,
-                             FLAG_ACK, 0, p.rcv_wnd),
+                             FLAG_ACK, 0, p.rcv_wnd, sack_s=ss, sack_e=se),
                 p.header_bytes,
             )
         elif m_stray:
@@ -706,7 +767,7 @@ class CpuRefTcpBase:
         self.ctr[host] = base_ctr + p.packet_lanes
 
     @staticmethod
-    def _mk_seg(lport, rport, seq, ack, flags, plen, wnd):
+    def _mk_seg(lport, rport, seq, ack, flags, plen, wnd, sack_s=0, sack_e=0):
         data = [0] * PAYLOAD_LANES
         # the device packs ports into an i32 lane; local ports >= 32768
         # wrap negative on the wire, so mirror the two's-complement view
@@ -715,6 +776,8 @@ class CpuRefTcpBase:
         data[LANE_ACK] = _to_wire32(ack)
         data[LANE_FLAGS_LEN] = (flags & 0xFF) | (plen << 8)
         data[LANE_WND] = int(wnd)
+        data[LANE_SACK_S] = _to_wire32(sack_s)
+        data[LANE_SACK_E] = _to_wire32(sack_e)
         return tuple(data)
 
     def next_time(self) -> int:
@@ -741,9 +804,10 @@ class CpuRefTcpBase:
 
     def tcp_field(self, name) -> np.ndarray:
         """[H, S] array of one TcpState field for device comparison."""
-        if name == "ooo":
+        if name in ("ooo", "sacked"):
             return np.array(
-                [[s.ooo for s in row] for row in self.slots], dtype=np.int64
+                [[getattr(s, name) for s in row] for row in self.slots],
+                dtype=np.int64,
             )
         return np.array(
             [[getattr(s, name) for s in row] for row in self.slots]

@@ -27,9 +27,10 @@ from shadow_tpu.simtime import TIME_MAX
 
 # Number of i32 payload lanes carried by every event. Models/packets pack
 # their data into these (see engine/state.py for layouts). Transport packets
-# use lanes as headers: ports, seq, ack, flags|len, wnd (transport/header.py);
-# the reference's C packet headers are packet.h:20-40.
-PAYLOAD_LANES = 6
+# use lanes as headers: ports, seq, ack, flags|len, wnd, app, and one SACK
+# block (transport/header.py); the reference's C packet headers are
+# packet.h:20-40 with SACK blocks in tcp_retransmit_tally.cc.
+PAYLOAD_LANES = 8
 
 _I64_MAX = jnp.iinfo(jnp.int64).max
 

@@ -311,6 +311,10 @@ class ManagedProcess:
         self.threads: "list[GuestThread]" = []
         self.exited = False
         self.syscall_log: list[tuple[int, str, tuple]] = []
+        # memory-map ledger (reference memory_manager/mod.rs bookkeeping):
+        # addr -> (len, prot, flags, fd-kind, offset); break from the shim
+        self.mappings: "dict[int, tuple]" = {}
+        self.brk_end = 0
         self.exit_code: Optional[int] = None
         self._stdout_path = None
         self.strace: Optional[StraceFile] = None
@@ -1368,6 +1372,9 @@ class NetKernel:
         for fd, f in parent.fdtab._files.items():
             child.fdtab._files[fd] = f
             f.refcount += 1
+        # address space: the child inherits the parent's mappings/break
+        child.mappings = dict(parent.mappings)
+        child.brk_end = parent.brk_end
         ipc = I.IpcBlock(
             tag=f"h{parent.host.host_id}p{vpid}",
             vdso_latency_ns=parent.host.vdso_latency_ns,
@@ -1800,6 +1807,56 @@ class NetKernel:
             proc._reply(proc.fdtab.alloc(f))
             return True
         proc._reply(-ENOENT)
+        return True
+
+    # --- memory-map ledger -------------------------------------------------
+    # The role of the reference's MemoryManager bookkeeping
+    # (memory_manager/mod.rs:1-17): shadow tracks guest mappings and the
+    # program break. Mappings execute natively in the guest (this design
+    # never remaps guest pages into shadow — payloads ride the shm
+    # channel), and the shim's libc-level mmap/munmap/mremap/brk/sbrk
+    # interposers report each region change here (raw glibc-internal
+    # mappings are deliberately not trapped; see seccomp.c's note).
+
+    def _sys_mm_note(self, proc, msg):
+        op, addr, length = int(msg.a[1]), int(msg.a[2]) & (2**64 - 1), int(msg.a[3])
+        payload = I.msg_payload(msg)
+        prot = flags = fd = off = 0
+        if len(payload) >= 32:
+            prot, flags, fd, off = struct.unpack_from("<4q", payload)
+        p = proc.process
+
+        def _carve(lo: int, hi: int) -> None:
+            """Remove [lo, hi) from the ledger, trimming partial overlaps
+            (native mmap/munmap semantics: a new fixed mapping or an unmap
+            atomically replaces whatever it covers)."""
+            for base in list(p.mappings):
+                mlen, mprot, mflags, mfd, moff = p.mappings[base]
+                mend = base + mlen
+                if mend <= lo or base >= hi:
+                    continue
+                del p.mappings[base]
+                if base < lo:  # left remainder
+                    p.mappings[base] = (lo - base, mprot, mflags, mfd, moff)
+                if mend > hi:  # right remainder
+                    p.mappings[hi] = (mend - hi, mprot, mflags, mfd,
+                                      moff + (hi - base))
+
+        if op == 1:  # mmap (MAP_FIXED over an existing region replaces it)
+            _carve(addr, addr + length)
+            p.mappings[addr] = (length, int(prot), int(flags), int(fd), int(off))
+        elif op == 2:  # munmap: drop/trim overlapping regions
+            _carve(addr, addr + length)
+        elif op == 3:  # brk: shim reports the post-call break
+            p.brk_end = addr
+        elif op == 4:  # mremap: new addr in a[2], old in payload off slot
+            old = int(off) & (2**64 - 1)
+            ent = p.mappings.pop(old, None)
+            if ent is not None:
+                p.mappings[addr] = (length or ent[0], ent[1], ent[2], ent[3], ent[4])
+            else:
+                p.mappings[addr] = (length, 0, int(flags), -1, 0)
+        proc._reply(0)
         return True
 
     # --- descriptor ops ---------------------------------------------------
@@ -3252,6 +3309,7 @@ _DISPATCH = {
     I.VSYS_FUTEX_WAKE: NetKernel._sys_futex_wake,
     I.VSYS_FUTEX_REQUEUE: NetKernel._sys_futex_requeue,
     I.VSYS_SIGMASK: NetKernel._sys_sigmask,
+    I.VSYS_MM_NOTE: NetKernel._sys_mm_note,
     I.VSYS_FORK: NetKernel._sys_fork,
     I.VSYS_WAITPID: NetKernel._sys_waitpid,
     I.VSYS_PAUSE: NetKernel._sys_pause,

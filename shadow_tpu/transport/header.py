@@ -14,6 +14,8 @@ lane 2: ack  (wire u32)
 lane 3: flags | (payload_len << 8)         (flags: FIN/SYN/RST/ACK)
 lane 4: advertised receive window, bytes
 lane 5: free for app/model use (stream id, message marker, ...)
+lane 6: SACK block start (wire u32; 0 == lane 7 means no block)
+lane 7: SACK block end   (wire u32, exclusive)
 """
 
 from __future__ import annotations
@@ -26,6 +28,8 @@ LANE_ACK = 2
 LANE_FLAGS_LEN = 3
 LANE_WND = 4
 LANE_APP = 5
+LANE_SACK_S = 6
+LANE_SACK_E = 7
 
 # Standard TCP flag bit positions (low byte of lane 3).
 FLAG_FIN = 0x01

@@ -31,12 +31,23 @@ Semantics kept from the reference (re-specified, not translated):
     the earliest deadline; stale wakeups re-arm (the reference's
     `desiredTimerExpiration`, tcp.c:1062-1134).
 
-Known divergences (simulation-fidelity notes, not bugs): no delayed ACKs
-(every data segment is ACKed immediately), no zero-window probes (apps in
-scripted models consume instantly so the window never closes), no SACK
-blocks on the wire (receivers buffer out-of-order data; senders recover
-one hole per RTT, NewReno-style), deterministic ISS of 0 (the reference
-draws it from the host RNG).
+SACK (use_sack, default on): receivers advertise their lowest buffered
+out-of-order range on every ACK (one full-precision block on wire lanes
+6-7); senders keep a scoreboard of peer-reported ranges
+(tcp_retransmit_tally.cc role), retransmit the first *unsacked* hole, and
+march one hole per dupack during recovery — managed-tier parity
+(hostk/tcp.py sacked/tally). A timeout clears the scoreboard (RFC 2018
+reneging safety).
+
+Remaining divergences, with reasons: no delayed ACKs (the managed tier
+also ACKs immediately — matching it is the cross-tier contract); no
+zero-window probes or receive-buffer accounting (scripted apps consume
+instantly, so the advertised window is constant and can never close —
+the persist machinery lives in the managed tier, hostk/tcp.py:414-439,
+where real apps exist); deterministic ISS of 0 (both tiers; the
+reference draws it from the host RNG — an unpredictability property with
+no simulation-fidelity effect, since sequence numbers never leave the
+simulation).
 
 Sequence numbers are absolute i64 byte offsets internally (SYN occupies
 offset 0, data starts at 1, FIN occupies the offset after the last data
@@ -62,6 +73,8 @@ from shadow_tpu.transport.header import (
     LANE_ACK,
     LANE_FLAGS_LEN,
     LANE_PORTS,
+    LANE_SACK_E,
+    LANE_SACK_S,
     LANE_SEQ,
     LANE_WND,
     pack_flags_len,
@@ -108,6 +121,11 @@ class TcpParams:
     timewait_ns: int = 60 * NS_PER_SEC  # tcp.c:771 close timer
     ooo_ranges: int = 4  # R: out-of-order ranges buffered per socket
     segs_per_flush: int = 4  # data segments emitted per handler call
+    # SACK (tcp_retransmit_tally.cc role): receivers advertise their first
+    # out-of-order range on every ACK; senders keep a scoreboard of sacked
+    # ranges and retransmit the first *unsacked* hole instead of blindly
+    # resending at snd_una (managed-tier parity, hostk/tcp.py sacked/tally)
+    use_sack: bool = True
 
     @property
     def packet_lanes(self) -> int:
@@ -141,6 +159,13 @@ class TcpState:
     rcv_fin: jax.Array  # i64 peer FIN offset (-1 unknown)
     delivered: jax.Array  # i64 bytes handed to the app in order
     ooo: jax.Array  # [H, S, R, 2] i64 out-of-order [start, end); -1 empty
+    # sender-side SACK scoreboard: peer-reported received ranges above
+    # snd_una (the vectorized tally, tcp_retransmit_tally.cc)
+    sacked: jax.Array  # [H, S, R, 2] i64 [start, end); -1 empty
+    # highest hole already retransmitted this recovery episode — each hole
+    # is resent once per episode (the managed tier's _last_rexmit marks);
+    # without it a rtx's own dupack re-triggers the march forever
+    rtx_mark: jax.Array  # i64
     # congestion control (Reno/NewReno)
     cwnd: jax.Array  # i64 bytes
     ssthresh: jax.Array  # i64 bytes
@@ -189,6 +214,8 @@ def create(num_hosts: int, p: TcpParams) -> TcpState:
         rcv_fin=full(-1),
         delivered=z(),
         ooo=jnp.full((h, s, r, 2), -1, jnp.int64),
+        sacked=jnp.full((h, s, r, 2), -1, jnp.int64),
+        rtx_mark=z(),
         cwnd=full(p.init_cwnd_segs * p.mss),
         ssthresh=full(1 << 40),
         dupacks=z(jnp.int32),
@@ -269,6 +296,8 @@ def _reset_view(v: TcpState, m, p: TcpParams) -> TcpState:
         rcv_fin=w(v.rcv_fin, -1),
         delivered=w(v.delivered, 0),
         ooo=w(v.ooo, -1),
+        sacked=w(v.sacked, -1),
+        rtx_mark=w(v.rtx_mark, 0),
         cwnd=w(v.cwnd, p.init_cwnd_segs * p.mss),
         ssthresh=w(v.ssthresh, 1 << 40),
         dupacks=w(v.dupacks, 0),
@@ -422,7 +451,7 @@ def _empty_emits(h: int, p: TcpParams) -> TcpEmits:
     )
 
 
-def _mk_seg(lport, rport, seq, ack, flags, plen, wnd):
+def _mk_seg(lport, rport, seq, ack, flags, plen, wnd, sack_s=None, sack_e=None):
     """Build one segment's payload lanes ([H, PAYLOAD_LANES])."""
     h = lport.shape[0]
     data = jnp.zeros((h, PAYLOAD_LANES), jnp.int32)
@@ -431,6 +460,9 @@ def _mk_seg(lport, rport, seq, ack, flags, plen, wnd):
     data = data.at[:, LANE_ACK].set(to_wire32(ack))
     data = data.at[:, LANE_FLAGS_LEN].set(pack_flags_len(flags, plen))
     data = data.at[:, LANE_WND].set(wnd.astype(jnp.int32))
+    if sack_s is not None:
+        data = data.at[:, LANE_SACK_S].set(to_wire32(sack_s))
+        data = data.at[:, LANE_SACK_E].set(to_wire32(sack_e))
     return data
 
 
@@ -610,6 +642,21 @@ def tcp_handle(
         )
     )
 
+    # ---- SACK scoreboard update (tcp_retransmit_tally.cc role) ----
+    # Merge the peer-reported block in, then drop ranges the cumulative
+    # ACK has covered. Unwrap is relative to the post-advance snd_una.
+    if p.use_sack:
+        sack_s_w = ev.data[:, LANE_SACK_S]
+        sack_e_w = ev.data[:, LANE_SACK_E]
+        has_sack = m_ackp & (sack_s_w != sack_e_w)
+        abs_ss = unwrap32(v.snd_una, sack_s_w)
+        abs_se = unwrap32(v.snd_una, sack_e_w)
+        sacked1 = _ooo_insert(v.sacked, has_sack, abs_ss, abs_se)
+        drop = m_ackp[:, None] & (sacked1[:, :, 0] >= 0) & (
+            sacked1[:, :, 1] <= v.snd_una[:, None]
+        )
+        v = v.replace(sacked=jnp.where(drop[:, :, None], jnp.int64(-1), sacked1))
+
     # duplicate ACKs -> fast retransmit at 3 (tcp_cong_reno.c). A dupack is
     # a pure ACK that does NOT advance snd_una (checked against the pre-ACK
     # value — the advancing ACK itself must not count).
@@ -629,7 +676,37 @@ def tcp_handle(
         recover=jnp.where(dup3, v.snd_max, v.recover),
         in_rec=jnp.where(dup3, True, v.in_rec),
     )
-    rtx_hole = rtx_hole | dup3
+    if p.use_sack:
+        # first unsacked hole per the tally (same march the output pass
+        # performs — state is unchanged in between, so the values agree)
+        hole_rx = v.snd_una
+        for _ in range(p.ooo_ranges):
+            cover = (
+                (v.sacked[:, :, 0] >= 0)
+                & (v.sacked[:, :, 0] <= hole_rx[:, None])
+                & (v.sacked[:, :, 1] > hole_rx[:, None])
+            )
+            reach = jnp.max(
+                jnp.where(cover, v.sacked[:, :, 1], jnp.int64(-1)), axis=1
+            )
+            hole_rx = jnp.maximum(hole_rx, reach)
+        # march one hole per dupack while in recovery when the scoreboard
+        # has information — but each hole only once per episode (the
+        # managed tier's _last_rexmit marks; hostk/tcp.py parity)
+        sack_any = jnp.any(v.sacked[:, :, 0] >= 0, axis=1)
+        march = (
+            dup & v.in_rec & sack_any
+            & (hole_rx > v.rtx_mark)
+            & (hole_rx < v.snd_max)
+        )
+        rtx_hole = rtx_hole | dup3 | march
+        v = v.replace(
+            rtx_mark=jnp.where(
+                full_ack, 0, jnp.where(rtx_hole, hole_rx, v.rtx_mark)
+            )
+        )
+    else:
+        rtx_hole = rtx_hole | dup3
 
     # our FIN acked? (snd_limit = snd_end + 1 once the FIN is out)
     fin_acked = m_ackp & v.fin_sent & (v.snd_una >= v.snd_end + 1)
@@ -733,6 +810,9 @@ def tcp_handle(
         backoff=jnp.where(rto_fire, w.backoff + 1, w.backoff),
         rtt_pending=jnp.where(rto_fire, False, w.rtt_pending),  # Karn
         rto_expire=jnp.where(rto_fire, TIME_MAX, w.rto_expire),
+        # a timeout invalidates the scoreboard (reneging safety, RFC 2018)
+        sacked=jnp.where(rto_fire[:, None, None], jnp.int64(-1), w.sacked),
+        rtx_mark=jnp.where(rto_fire, 0, w.rtx_mark),
         # retransmits counted once, per segment, in the output pass
     )
     ts = scatter_slot(ts, t_slot, m_tmr, w)
@@ -769,9 +849,20 @@ def tcp_handle(
         emits.p_valid, emits.p_dst, emits.p_data, emits.p_size,
     )
 
-    # forced hole retransmit (fast retransmit / NewReno partial ack):
-    # one segment at snd_una, charged as a retransmission
-    cursor = jnp.where(rtx_hole & can_send, o.snd_una, o.snd_nxt)
+    # forced hole retransmit (fast retransmit / NewReno partial ack): one
+    # segment at the first *unsacked* hole (snd_una when the scoreboard is
+    # empty), charged as a retransmission
+    hole = o.snd_una
+    if p.use_sack:
+        for _ in range(p.ooo_ranges):
+            cover = (
+                (o.sacked[:, :, 0] >= 0)
+                & (o.sacked[:, :, 0] <= hole[:, None])
+                & (o.sacked[:, :, 1] > hole[:, None])
+            )
+            reach = jnp.max(jnp.where(cover, o.sacked[:, :, 1], jnp.int64(-1)), axis=1)
+            hole = jnp.maximum(hole, reach)
+    cursor = jnp.where(rtx_hole & can_send, hole, o.snd_nxt)
     is_first_rtx = rtx_hole & can_send
 
     # Karn: retransmitting invalidates any in-flight RTT sample
@@ -890,6 +981,21 @@ def tcp_handle(
     # ---------------- control lane: ACK / RST ----------------------------
     # (after output so the ACK carries the freshest rcv_nxt/window)
     va = gather_slot(ts, act_slot)
+    if p.use_sack:
+        # advertise the lowest buffered out-of-order range (the first-hole
+        # information the sender's scoreboard needs most)
+        starts = va.ooo[:, :, 0]
+        present = starts >= 0
+        min_start = jnp.min(
+            jnp.where(present, starts, jnp.int64(1) << 62), axis=1
+        )
+        at_min = present & (starts == min_start[:, None])
+        blk_e = jnp.max(jnp.where(at_min, va.ooo[:, :, 1], jnp.int64(-1)), axis=1)
+        has_blk = jnp.any(present, axis=1)
+        sack_s = jnp.where(has_blk, min_start, jnp.int64(0))
+        sack_e = jnp.where(has_blk, blk_e, jnp.int64(0))
+    else:
+        sack_s = sack_e = jnp.zeros((h,), jnp.int64)
     ack_data = _mk_seg(
         va.lport,
         va.rport,
@@ -898,6 +1004,8 @@ def tcp_handle(
         jnp.full((h,), FLAG_ACK, jnp.int32),
         jnp.zeros((h,), jnp.int32),
         jnp.full((h,), p.rcv_wnd, jnp.int64),
+        sack_s=sack_s,
+        sack_e=sack_e,
     )
     ctrl = p.segs_per_flush
     ctrl_valid = (need_ack & m_act) | m_stray

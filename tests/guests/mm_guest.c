@@ -1,0 +1,179 @@
+/* Memory-map + large-IO breadth guest (reference roles:
+ * memory_manager/mod.rs bookkeeping + regular-file mmap; the >64KB
+ * transfers exercise the shim's chunked write/writev, which must be
+ * invisible to the guest). Prints deterministic checksums — a native run
+ * and a shadow run must produce identical stdout.
+ * Usage: mm_guest */
+#include <fcntl.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+static uint64_t fnv(const unsigned char *p, size_t n) {
+    uint64_t h = 1469598103934665603ULL;
+    for (size_t i = 0; i < n; i++) {
+        h ^= p[i];
+        h *= 1099511628211ULL;
+    }
+    return h;
+}
+
+#define BIG (300 * 1024)
+
+int main(void) {
+    setvbuf(stdout, NULL, _IONBF, 0); /* forks must not replay the buffer */
+    /* 1. anonymous mmap: 1 MB, fill, checksum, unmap */
+    size_t alen = 1 << 20;
+    unsigned char *a = mmap(NULL, alen, PROT_READ | PROT_WRITE,
+                            MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+    if (a == MAP_FAILED) {
+        perror("mmap anon");
+        return 1;
+    }
+    for (size_t i = 0; i < alen; i++)
+        a[i] = (unsigned char)(i * 7 + 3);
+    printf("anon %llx\n", (unsigned long long)fnv(a, alen));
+    if (munmap(a, alen) != 0) {
+        perror("munmap");
+        return 1;
+    }
+
+    /* 2. file-backed mmap of a sandbox file (written natively first) */
+    size_t flen = 256 * 1024;
+    int fd = open("mmfile.bin", O_CREAT | O_RDWR | O_TRUNC, 0644);
+    if (fd < 0) {
+        perror("open");
+        return 1;
+    }
+    unsigned char *tmp = malloc(flen);
+    for (size_t i = 0; i < flen; i++)
+        tmp[i] = (unsigned char)(i ^ (i >> 8));
+    size_t off = 0;
+    while (off < flen) {
+        ssize_t w = write(fd, tmp + off, flen - off);
+        if (w <= 0) {
+            perror("file write");
+            return 1;
+        }
+        off += (size_t)w;
+    }
+    unsigned char *m = mmap(NULL, flen, PROT_READ, MAP_PRIVATE, fd, 0);
+    if (m == MAP_FAILED) {
+        perror("mmap file");
+        return 1;
+    }
+    close(fd);
+    printf("file %llx %s\n", (unsigned long long)fnv(m, flen),
+           memcmp(m, tmp, flen) == 0 ? "match" : "MISMATCH");
+    /* keep `m` mapped so the kernel ledger has a live region at exit */
+
+    /* 3. grow the break and touch it */
+    unsigned char *b = sbrk(64 * 1024);
+    if (b == (void *)-1) {
+        perror("sbrk");
+        return 1;
+    }
+    memset(b, 0x5a, 64 * 1024);
+    printf("brk %llx\n", (unsigned long long)fnv(b, 64 * 1024));
+
+    /* 4. one write() of 300 KB through a pipe (fork: child drains) */
+    int pfd[2], rfd[2];
+    if (pipe(pfd) != 0 || pipe(rfd) != 0) {
+        perror("pipe");
+        return 1;
+    }
+    unsigned char *big = malloc(BIG);
+    for (size_t i = 0; i < BIG; i++)
+        big[i] = (unsigned char)(i * 13 + 1);
+    pid_t pid = fork();
+    if (pid < 0) {
+        perror("fork");
+        return 1;
+    }
+    if (pid == 0) { /* child: drain the pipe, reply with checksum */
+        close(pfd[1]);
+        close(rfd[0]);
+        unsigned char *rb = malloc(BIG);
+        size_t got = 0;
+        while (got < BIG) {
+            ssize_t r = read(pfd[0], rb + got, BIG - got);
+            if (r <= 0)
+                break;
+            got += (size_t)r;
+        }
+        uint64_t h = fnv(rb, got);
+        char line[64];
+        int n = snprintf(line, sizeof(line), "%zu %llx", got,
+                         (unsigned long long)h);
+        if (write(rfd[1], line, (size_t)n) != n)
+            _exit(3);
+        _exit(0);
+    }
+    close(pfd[0]);
+    close(rfd[1]);
+    ssize_t w = write(pfd[1], big, BIG); /* ONE call, > shim buffer */
+    printf("pipe wrote %zd\n", w);
+    close(pfd[1]);
+    char line[64];
+    ssize_t r = read(rfd[0], line, sizeof(line) - 1);
+    if (r < 0) {
+        perror("reply read");
+        return 1;
+    }
+    line[r] = 0;
+    printf("pipe child %s (want %llx)\n", line, (unsigned long long)fnv(big, BIG));
+    int st = 0;
+    waitpid(pid, &st, 0);
+
+    /* 5. one writev (3 iovecs, ~200 KB total) over a stream socketpair */
+    int sv[2], rfd2[2];
+    if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv) != 0 || pipe(rfd2) != 0) {
+        perror("socketpair");
+        return 1;
+    }
+    pid_t pid2 = fork();
+    if (pid2 == 0) {
+        close(sv[0]);
+        close(rfd2[0]);
+        unsigned char *rb = malloc(BIG);
+        size_t got = 0;
+        for (;;) {
+            ssize_t rr = read(sv[1], rb + got, BIG - got);
+            if (rr <= 0)
+                break;
+            got += (size_t)rr;
+        }
+        char l2[64];
+        int n2 = snprintf(l2, sizeof(l2), "%zu %llx", got,
+                          (unsigned long long)fnv(rb, got));
+        if (write(rfd2[1], l2, (size_t)n2) != n2)
+            _exit(3);
+        _exit(0);
+    }
+    close(sv[1]);
+    close(rfd2[1]);
+    struct iovec iov[3] = {
+        {big, 90 * 1024}, {big + 90 * 1024, 70 * 1024}, {big + 160 * 1024, 40 * 1024},
+    };
+    ssize_t wv = writev(sv[0], iov, 3);
+    printf("sock writev %zd\n", wv);
+    close(sv[0]);
+    ssize_t r2 = read(rfd2[0], line, sizeof(line) - 1);
+    if (r2 < 0) {
+        perror("sock reply read");
+        return 1;
+    }
+    line[r2] = 0;
+    printf("sock child %s (want %llx)\n", line,
+           (unsigned long long)fnv(big, 200 * 1024));
+    waitpid(pid2, &st, 0);
+
+    printf("mm all ok\n");
+    return 0;
+}

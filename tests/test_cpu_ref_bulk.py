@@ -22,8 +22,8 @@ from shadow_tpu.simtime import NS_PER_MS
 TCP_FIELDS = [
     "st", "lport", "rport", "rhost", "snd_una", "snd_nxt", "snd_max",
     "snd_end", "fin_pending", "fin_sent", "peer_wnd", "rcv_nxt", "rcv_fin",
-    "delivered", "ooo", "cwnd", "ssthresh", "dupacks", "recover", "in_rec",
-    "srtt", "rttvar", "rto", "rtt_pending", "rtt_seq", "rtt_ts",
+    "delivered", "ooo", "sacked", "rtx_mark", "cwnd", "ssthresh", "dupacks", "recover",
+    "in_rec", "srtt", "rttvar", "rto", "rtt_pending", "rtt_seq", "rtt_ts",
     "rto_expire", "backoff", "tev_time", "retransmits", "segs_in", "segs_out",
 ]
 

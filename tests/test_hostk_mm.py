@@ -1,0 +1,70 @@
+"""Memory-map ledger + >64KB IO on virtual fds (round-2 verdict item 5):
+the shim chunks large write/writev transparently (one guest call, full
+count back) and reports every mmap/munmap/brk to the kernel's per-process
+address-space ledger (the bookkeeping role of the reference's
+MemoryManager, memory_manager/mod.rs:1-17). The guest's stdout must match
+a native run byte for byte."""
+
+import pathlib
+import subprocess
+
+import pytest
+
+from shadow_tpu.graph import compute_routing
+from shadow_tpu.hostk.kernel import NetKernel, ProcessSpec
+from shadow_tpu.simtime import NS_PER_SEC
+from tests.topo import two_node_graph
+
+GUESTS = pathlib.Path(__file__).parent / "guests"
+
+
+@pytest.fixture(scope="module")
+def mm_bin(tmp_path_factory):
+    out = tmp_path_factory.mktemp("mm") / "mm_guest"
+    subprocess.run(["cc", "-O2", "-o", str(out), str(GUESTS / "mm_guest.c")], check=True)
+    return str(out)
+
+
+def _native(mm_bin, tmp_path):
+    d = tmp_path / "native"
+    d.mkdir()
+    r = subprocess.run([mm_bin], capture_output=True, cwd=d)
+    assert r.returncode == 0, r.stdout.decode() + r.stderr.decode()
+    return r.stdout
+
+
+def _shadow(mm_bin, tmp_path):
+    graph = two_node_graph(10, 0.0)
+    tables = compute_routing(graph).with_hosts([0])
+    k = NetKernel(
+        tables, host_names=["h"], host_nodes=[0], data_dir=tmp_path / "shadow"
+    )
+    p = k.add_process(ProcessSpec(host="h", args=[mm_bin]))
+    try:
+        k.run(30 * NS_PER_SEC)
+    finally:
+        k.shutdown()
+    return k, p
+
+
+def test_mm_guest_matches_native(tmp_path, mm_bin):
+    native_out = _native(mm_bin, tmp_path)
+    k, p = _shadow(mm_bin, tmp_path)
+    assert p.exit_code == 0, p.stdout().decode() + p.stderr().decode()
+    assert p.stdout() == native_out
+    assert b"mm all ok" in p.stdout()
+
+
+def test_mm_ledger_tracks_guest_mappings(tmp_path, mm_bin):
+    k, p = _shadow(mm_bin, tmp_path)
+    assert p.exit_code == 0
+    # the 256 KB file mapping is still live at exit; the 1 MB anon one was
+    # unmapped and must be gone
+    live = sorted(p.mappings.values())
+    assert any(ln == 256 * 1024 for (ln, *_rest) in live), live
+    assert not any(ln == 1 << 20 for (ln, *_rest) in live), live
+    # the break moved (sbrk growth was reported)
+    assert p.brk_end > 0
+    # strace saw the mm traffic
+    names = [s for _, s, _ in p.syscall_log]
+    assert "mmap" in names

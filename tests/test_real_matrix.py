@@ -1,0 +1,102 @@
+"""Real-binary matrix at 100+ hosts through the hybrid schedulers
+(round-2 verdict item 9; reference analogue: the tgen client/server
+matrices and iperf suites, src/test/tgen/, examples/http-server/): real
+compiled C HTTP servers and clients — 104 hosts, 52 concurrent fetch
+pairs — run under the parallel hybrid scheduler with their packets on the
+device engine, and every client must fetch its document exactly."""
+
+import pathlib
+import subprocess
+
+import pytest
+
+from shadow_tpu.engine import EngineConfig
+from shadow_tpu.graph import NetworkGraph, compute_routing
+from shadow_tpu.hostk.kernel import ProcessSpec
+from shadow_tpu.runtime.hybrid import ParallelHybridScheduler
+from shadow_tpu.simtime import NS_PER_MS, NS_PER_SEC
+
+SRC = pathlib.Path(__file__).parent.parent / "examples" / "http-matrix"
+
+PAIRS = 52
+NBYTES = 12_000
+
+
+@pytest.fixture(scope="module")
+def bins(tmp_path_factory):
+    out = tmp_path_factory.mktemp("httpm")
+    built = {}
+    for name in ("http_server", "http_client"):
+        dst = out / name
+        subprocess.run(["cc", "-O2", "-o", str(dst), str(SRC / f"{name}.c")], check=True)
+        built[name] = str(dst)
+    return built
+
+
+def test_http_matrix_104_hosts(tmp_path, bins):
+    graph = NetworkGraph.from_gml(
+        """graph [
+  directed 0
+  node [ id 0 ]
+  node [ id 1 ]
+  edge [ source 0 target 0 latency "1 ms" ]
+  edge [ source 1 target 1 latency "1 ms" ]
+  edge [ source 0 target 1 latency "8 ms" packet_loss 0.002 ]
+]"""
+    )
+    host_names = [f"server{i}" for i in range(PAIRS)] + [
+        f"client{i}" for i in range(PAIRS)
+    ]
+    host_nodes = [0] * PAIRS + [1] * PAIRS
+    tables = compute_routing(graph).with_hosts(host_nodes)
+    cfg = EngineConfig(
+        num_hosts=2 * PAIRS,
+        queue_capacity=256,
+        outbox_capacity=64,
+        runahead_ns=graph.min_latency_ns(),
+        seed=9,
+    )
+    specs = []
+    for i in range(PAIRS):
+        specs.append(
+            ProcessSpec(host=f"server{i}", args=[bins["http_server"], "8080", str(NBYTES), "1"])
+        )
+        specs.append(
+            ProcessSpec(
+                host=f"client{i}",
+                args=[bins["http_client"], f"server{i}", "8080", "1"],
+                start_ns=(50 + 5 * i) * NS_PER_MS,
+            )
+        )
+
+    sched = ParallelHybridScheduler(
+        tables,
+        cfg,
+        host_names=host_names,
+        host_nodes=host_nodes,
+        specs=specs,
+        num_workers=4,
+        seed=9,
+        data_dir=tmp_path / "matrix",
+    )
+    try:
+        try:
+            sched.run(20 * NS_PER_SEC)
+        finally:
+            sched.shutdown()
+        stats = sched.stats()
+        info = sched.proc_info()
+        assert sched.device_passes > 0
+        assert stats["processes"] == 2 * PAIRS
+        ok = 0
+        for p in info:
+            if p["host"].startswith("client"):
+                assert p["exit_code"] == 0, (p["host"], p["stdout"])
+                assert b"fetched 1/1 docs" in p["stdout"], (p["host"], p["stdout"])
+                ok += 1
+        assert ok == PAIRS
+        assert not sched.unexpected_final_states()
+        # real traffic actually crossed the device plane
+        assert stats["packets_sent"] > PAIRS * 10
+    finally:
+        sched.close()
