@@ -131,6 +131,7 @@ enum {
     VSYS_MM_NOTE = 66,       /* a[1]=op(1 mmap,2 munmap,3 brk,4 mremap)
                               * a[2]=addr a[3]=len, buf = 4 x i64
                               * (prot, flags, fd, offset-or-old-addr) */
+    VSYS_FD_NATIVE = 67,     /* a[1]=op(1 opened, 2 closed) a[2]=native fd */
     VSYS_SIGMASK = 65,       /* a[1]=new 64-bit blocked mask (kernel-side
                                 delivery honors it; syscall/signal.c) */
 };

@@ -175,7 +175,8 @@ static long rsyscall(long nr, ...) {
     return r;
 }
 
-#define VFD_BASE 1000 /* virtual fds live above real ones */
+#define VFD_BASE 1000 /* simulated PID base (fds now share the unified
+                        * real number space via the g_vfd_map bitmap) */
 
 /* Every mapped ShimShmem block (process block, per-thread blocks, forked
  * children's blocks). Futexes inside these are the IPC channel's own
@@ -255,6 +256,17 @@ static __thread int t_native_futex_ok = 0;
  * call from glibc's thread-death cleanup would park forever. Post-exit,
  * vsys becomes a no-op and trapped syscalls run natively. */
 static __thread int t_detached_from_sim = 0;
+
+/* unified-fd-space helpers (definitions live with the socket layer) */
+static int is_vfd(int fd);
+static void vfd_mark(int fd, int on);
+static long raw_close(int fd);
+static int64_t vfd_adopt(int64_t r);
+static void vfd_release(int fd);
+static void fd_native_note(int op, int fd);
+static long raw_open_rw(const char *path) {
+    return shim_raw_syscall(SYS_open, (long)path, O_RDWR, 0, 0, 0, 0);
+}
 static int g_main_exited = 0; /* main pthread_exit'ed; kernel-side it is gone */
 static int g_exit_sent = 0;  /* VSYS_EXIT already recorded for this process */
 
@@ -364,12 +376,12 @@ __attribute__((constructor)) static void shim_attach(void) {
     const char *path = getenv("SHADOW_SHM");
     if (!path)
         return;
-    int fd = open(path, O_RDWR);
+    int fd = (int)raw_open_rw(path);
     if (fd < 0)
         return;
     void *p = raw_mmap(NULL, SHIM_SHMEM_SIZE, PROT_READ | PROT_WRITE,
                        MAP_SHARED, fd, 0);
-    close(fd);
+    raw_close(fd);
     if (p == MAP_FAILED)
         return;
     g_shm = (ShimShmem *)p;
@@ -590,12 +602,12 @@ static int g_thread_count = 0;
 static void *thread_trampoline(void *p) {
     ThreadBoot tb = *(ThreadBoot *)p;
     free(p);
-    int fd = open(tb.path, O_RDWR);
+    int fd = (int)raw_open_rw(tb.path);
     if (fd < 0)
         return NULL;
     void *m = raw_mmap(NULL, SHIM_SHMEM_SIZE, PROT_READ | PROT_WRITE,
                        MAP_SHARED, fd, 0);
-    close(fd);
+    raw_close(fd);
     if (m == MAP_FAILED)
         return NULL;
     t_shm = (ShimShmem *)m;
@@ -628,8 +640,10 @@ void pthread_exit(void *retval) {
         if (t_tid == 0)
             g_main_exited = 1; /* destructor must not expect a reply */
         vsys(VSYS_THREAD_EXIT, (int64_t)(intptr_t)retval, 0, 0, NULL, 0, NULL);
-        if (t_tid != 0)
-            t_detached_from_sim = 1; /* worker: kernel dropped the channel */
+        /* the kernel dropped this channel (main's included) — everything
+         * this thread still does (glibc's pthread_exit lazily dlopens
+         * libgcc_s for unwinding!) must stay native */
+        t_detached_from_sim = 1;
     }
     t_native_futex_ok = 1; /* glibc thread-death cleanup runs native */
     real(retval);
@@ -752,12 +766,12 @@ pid_t fork(void) {
     if (p == 0) {
         /* child: leave the parent's (shared) block alone and adopt our own.
          * Only the forking thread survives; reset all per-thread state. */
-        int fd = open(path, O_RDWR);
+        int fd = (int)raw_open_rw(path);
         void *m = fd >= 0 ? raw_mmap(NULL, SHIM_SHMEM_SIZE,
                                      PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0)
                           : MAP_FAILED;
         if (fd >= 0)
-            close(fd);
+            raw_close(fd);
         if (m == MAP_FAILED)
             rsyscall(SYS_exit_group, 117L); /* cannot join the simulation */
         g_shm = (ShimShmem *)m;
@@ -824,6 +838,9 @@ void exit(int status) {
         /* record the code for waitpid before the destructor runs */
         g_exit_sent = 1;
         vsys(VSYS_EXIT, (int64_t)status, 0, 0, NULL, 0, NULL);
+        /* past this point the kernel no longer serves our channel; late
+         * teardown syscalls (atexit stdio closes...) must stay native */
+        t_detached_from_sim = 1;
     }
     real(status);
     __builtin_unreachable();
@@ -1039,35 +1056,147 @@ int pause(void) {
 
 /* ---- sockets (UDP first tier; TCP rides the device stack later) ---- */
 
-static int is_vfd(int fd) { return fd >= VFD_BASE; }
+/* ---- unified fd space (reference descriptor_table.rs:12 POSIX
+ * lowest-free) ----
+ * Virtual fds are allocated lowest-free in the REAL fd number space by
+ * the kernel, which tracks native usage via VSYS_FD_NATIVE notes from
+ * the passthrough paths. To keep native allocation from colliding with
+ * a kernel-allocated number, every virtual fd is *claimed* natively by
+ * dup2()ing a /dev/null placeholder onto it. Whether a number is
+ * virtual is a process-wide bitmap, shared by all guest threads.
+ *
+ * Known window: guest threads run native code concurrently with another
+ * thread's vsys, so a native open racing a virtual allocation can in
+ * principle land on the same number before the claim/note round-trips
+ * settle. Syscall-serialized guests (the simulated contract) are exact;
+ * the race needs simultaneous native fd creation in one thread and
+ * virtual allocation in another within the claim window. */
+
+#define VFD_MAP_MAX 65536
+static uint8_t g_vfd_map[VFD_MAP_MAX / 8];
+static int g_resv_fd = -1; /* high-numbered /dev/null placeholder source */
+
+static int is_vfd(int fd) {
+    return fd >= 0 && fd < VFD_MAP_MAX &&
+           ((__atomic_load_n(&g_vfd_map[fd >> 3], __ATOMIC_RELAXED) >>
+             (fd & 7)) &
+            1);
+}
+
+static void vfd_mark(int fd, int on) {
+    if (fd < 0 || fd >= VFD_MAP_MAX)
+        return;
+    if (on)
+        __atomic_or_fetch(&g_vfd_map[fd >> 3], (uint8_t)(1u << (fd & 7)),
+                          __ATOMIC_RELAXED);
+    else
+        __atomic_and_fetch(&g_vfd_map[fd >> 3], (uint8_t)~(1u << (fd & 7)),
+                           __ATOMIC_RELAXED);
+}
+
+static long raw_close(int fd) {
+    return shim_raw_syscall(SYS_close, fd, 0, 0, 0, 0, 0);
+}
+
+static void resv_init(void) {
+    if (g_resv_fd >= 0)
+        return;
+    int fd = (int)shim_raw_syscall(SYS_open, (long)"/dev/null", O_RDWR, 0, 0,
+                                   0, 0);
+    if (fd < 0)
+        return;
+    /* park the placeholder just under the fd soft limit, far above any
+     * number a guest plausibly uses */
+    struct rlimit rl = {1024, 1024};
+    shim_raw_syscall(SYS_getrlimit, RLIMIT_NOFILE, (long)&rl, 0, 0, 0, 0);
+    long target = (rl.rlim_cur > 64 && rl.rlim_cur < (1 << 20))
+                      ? (long)rl.rlim_cur - 4
+                      : 1020;
+    int hi = (int)shim_raw_syscall(SYS_fcntl, fd, F_DUPFD, target, 0, 0, 0);
+    if (hi < 0)
+        hi = (int)shim_raw_syscall(SYS_fcntl, fd, F_DUPFD, 900, 0, 0, 0);
+    if (hi >= 0) {
+        raw_close(fd);
+        g_resv_fd = hi;
+    } else {
+        g_resv_fd = fd;
+    }
+    /* the placeholder source is itself a native fd the kernel must never
+     * allocate over */
+    fd_native_note(1, g_resv_fd);
+}
+
+/* Adopt a kernel-allocated virtual fd number: claim it natively with the
+ * placeholder (so native opens can never be handed this number) and mark
+ * the bitmap. Safe to call on error returns (negative passes through). */
+static int64_t vfd_adopt(int64_t r) {
+    if (r >= 0 && r < VFD_MAP_MAX) {
+        resv_init();
+        if (g_resv_fd >= 0)
+            shim_raw_syscall(SYS_dup2, g_resv_fd, (long)r, 0, 0, 0, 0);
+        vfd_mark((int)r, 1);
+    }
+    return r;
+}
+
+/* Drop a virtual fd: free the native placeholder and clear the bitmap. */
+static void vfd_release(int fd) {
+    if (is_vfd(fd)) {
+        vfd_mark(fd, 0);
+        raw_close(fd);
+    }
+}
+
+/* Tell the kernel a NATIVE fd number came into / went out of use, so its
+ * lowest-free allocator never collides with passthrough files. */
+static void fd_native_note(int op, int fd) {
+    if (g_active && !t_detached_from_sim && fd >= 0)
+        vsys(VSYS_FD_NATIVE, op, fd, 0, NULL, 0, NULL);
+}
 
 /* ---- descriptor breadth: dup2/dup3, vectored IO, msghdr IO, fstat,
  * lseek — on virtual fds (reference: handler/{unistd,uio,socket}.rs) ---- */
 
 int dup2(int oldfd, int newfd) {
     if (!g_active || !is_vfd(oldfd)) {
-        if (g_active && newfd >= VFD_BASE) {
-            /* a real fd in the virtual range would be misrouted forever */
-            errno = EBADF;
-            return -1;
+        if (g_active && is_vfd(newfd)) {
+            /* POSIX: dup2 closes whatever lives at newfd — but only if
+             * the call will succeed (a bad oldfd must leave newfd
+             * untouched), so validate oldfd first */
+            if (shim_raw_syscall(SYS_fcntl, oldfd, F_GETFD, 0, 0, 0, 0) < 0) {
+                errno = EBADF;
+                return -1;
+            }
+            vsys(VSYS_CLOSE, newfd, 0, 0, NULL, 0, NULL);
+            vfd_mark(newfd, 0);
         }
-        return (int)rsyscall(SYS_dup2, oldfd, newfd);
+        int r = (int)rsyscall(SYS_dup2, oldfd, newfd);
+        if (r >= 0)
+            fd_native_note(1, r);
+        return r;
     }
     int64_t r = vsys(VSYS_DUP2, oldfd, newfd, 0, NULL, 0, NULL);
     if (r < 0) {
         errno = (int)-r;
         return -1;
     }
-    return (int)r;
+    return (int)vfd_adopt(r);
 }
 
 int dup3(int oldfd, int newfd, int flags) {
     if (!g_active || !is_vfd(oldfd)) {
-        if (g_active && newfd >= VFD_BASE) {
-            errno = EBADF;
-            return -1;
+        if (g_active && is_vfd(newfd) && oldfd != newfd) {
+            if (shim_raw_syscall(SYS_fcntl, oldfd, F_GETFD, 0, 0, 0, 0) < 0) {
+                errno = EBADF;
+                return -1;
+            }
+            vsys(VSYS_CLOSE, newfd, 0, 0, NULL, 0, NULL);
+            vfd_mark(newfd, 0);
         }
-        return (int)rsyscall(SYS_dup3, oldfd, newfd, flags);
+        int r = (int)rsyscall(SYS_dup3, oldfd, newfd, flags);
+        if (r >= 0)
+            fd_native_note(1, r);
+        return r;
     }
     if (oldfd == newfd) {
         errno = EINVAL; /* dup3 differs from dup2 here */
@@ -1075,6 +1204,8 @@ int dup3(int oldfd, int newfd, int flags) {
     }
     int64_t r = vsys(VSYS_DUP2, oldfd, newfd, (flags & O_CLOEXEC) != 0, NULL,
                      0, NULL);
+    if (r >= 0)
+        vfd_adopt(r);
     if (r < 0) {
         errno = (int)-r;
         return -1;
@@ -1329,7 +1460,12 @@ int socket(int domain, int type, int protocol) {
     int base = type & 0xFF;
     if (!g_active || (domain != AF_INET && domain != AF_UNIX) ||
         (base != SOCK_DGRAM && base != SOCK_STREAM))
-        return (int)rsyscall(SYS_socket, domain, type, protocol);
+    {
+        int rn = (int)rsyscall(SYS_socket, domain, type, protocol);
+        if (rn >= 0)
+            fd_native_note(1, rn);
+        return rn;
+    }
     /* forward base type + the SOCK_NONBLOCK bit (== O_NONBLOCK) */
     int64_t vtype = base | (type & SOCK_NONBLOCK ? 0x800 : 0);
     int64_t r = vsys(VSYS_SOCKET, domain, vtype, protocol, NULL, 0, NULL);
@@ -1337,7 +1473,7 @@ int socket(int domain, int type, int protocol) {
         errno = (int)-r;
         return -1;
     }
-    return (int)r;
+    return (int)vfd_adopt(r);
 }
 
 static int bind_or_connect_unix(int code, int fd, const struct sockaddr *addr,
@@ -1397,7 +1533,14 @@ int socketpair(int domain, int type, int protocol, int sv[2]) {
     int base = type & 0xFF;
     if (!g_active || domain != AF_UNIX ||
         (base != SOCK_DGRAM && base != SOCK_STREAM))
-        return (int)rsyscall(SYS_socketpair, domain, type, protocol, sv);
+    {
+        int rn = (int)rsyscall(SYS_socketpair, domain, type, protocol, sv);
+        if (rn == 0) {
+            fd_native_note(1, sv[0]);
+            fd_native_note(1, sv[1]);
+        }
+        return rn;
+    }
     int64_t vtype = base | (type & SOCK_NONBLOCK ? 0x800 : 0);
     ShimMsg reply;
     int64_t r = vsys(VSYS_SOCKETPAIR, domain, vtype, protocol, NULL, 0, &reply);
@@ -1405,8 +1548,8 @@ int socketpair(int domain, int type, int protocol, int sv[2]) {
         errno = (int)-r;
         return -1;
     }
-    sv[0] = (int)r;
-    sv[1] = (int)reply.a[2];
+    sv[0] = (int)vfd_adopt(r);
+    sv[1] = (int)vfd_adopt(reply.a[2]);
     return 0;
 }
 
@@ -1560,13 +1703,18 @@ int getsockname(int fd, struct sockaddr *addr, socklen_t *len) {
 }
 
 int close(int fd) {
-    if (!g_active || !is_vfd(fd))
-        return (int)rsyscall(SYS_close, fd);
+    if (!g_active || !is_vfd(fd)) {
+        int r = (int)rsyscall(SYS_close, fd);
+        if (r == 0)
+            fd_native_note(2, fd);
+        return r;
+    }
     int64_t r = vsys(VSYS_CLOSE, fd, 0, 0, NULL, 0, NULL);
     if (r < 0) {
         errno = (int)-r;
         return -1;
     }
+    vfd_release(fd);
     return 0;
 }
 
@@ -1585,7 +1733,12 @@ int listen(int fd, int backlog) {
 
 int accept4(int fd, struct sockaddr *addr, socklen_t *len, int flags) {
     if (!g_active || !is_vfd(fd))
-        return (int)rsyscall(SYS_accept4, fd, addr, len, flags);
+    {
+        int rn = (int)rsyscall(SYS_accept4, fd, addr, len, flags);
+        if (rn >= 0)
+            fd_native_note(1, rn);
+        return rn;
+    }
     ShimMsg reply;
     int64_t r = vsys(VSYS_ACCEPT, fd, (flags & SOCK_NONBLOCK) ? 1 : 0, 0, NULL,
                      0, &reply);
@@ -1599,7 +1752,7 @@ int accept4(int fd, struct sockaddr *addr, socklen_t *len, int flags) {
         else
             parts_to_addr(reply.a[2], reply.a[3], addr, len);
     }
-    return (int)r;
+    return (int)vfd_adopt(r);
 }
 
 int accept(int fd, struct sockaddr *addr, socklen_t *len) {
@@ -1670,13 +1823,19 @@ int fcntl(int fd, int cmd, ...) {
     va_start(ap, cmd);
     long arg = va_arg(ap, long);
     va_end(ap);
-    if (!g_active || !is_vfd(fd))
-        return (int)rsyscall(SYS_fcntl, fd, cmd, arg);
+    if (!g_active || !is_vfd(fd)) {
+        int rn = (int)rsyscall(SYS_fcntl, fd, cmd, arg);
+        if (rn >= 0 && (cmd == F_DUPFD || cmd == F_DUPFD_CLOEXEC))
+            fd_native_note(1, rn);
+        return rn;
+    }
     int64_t r = vsys(VSYS_FCNTL, fd, cmd, arg, NULL, 0, NULL);
     if (r < 0) {
         errno = (int)-r;
         return -1;
     }
+    if (cmd == F_DUPFD || cmd == F_DUPFD_CLOEXEC)
+        vfd_adopt(r);
     return (int)r;
 }
 
@@ -1755,8 +1914,8 @@ int pipe2(int fds[2], int flags) {
         errno = (int)-r;
         return -1;
     }
-    fds[0] = (int)reply.a[1];
-    fds[1] = (int)reply.a[2];
+    fds[0] = (int)vfd_adopt(reply.a[1]);
+    fds[1] = (int)vfd_adopt(reply.a[2]);
     return 0;
 }
 
@@ -1767,14 +1926,18 @@ int pipe(int fds[2]) {
 }
 
 int dup(int fd) {
-    if (!g_active || !is_vfd(fd))
-        return (int)rsyscall(SYS_dup, fd);
+    if (!g_active || !is_vfd(fd)) {
+        int r = (int)rsyscall(SYS_dup, fd);
+        if (r >= 0)
+            fd_native_note(1, r);
+        return r;
+    }
     int64_t r = vsys(VSYS_DUP, fd, 0, 0, NULL, 0, NULL);
     if (r < 0) {
         errno = (int)-r;
         return -1;
     }
-    return (int)r;
+    return (int)vfd_adopt(r);
 }
 
 /* ---- open family: virtual device files ----
@@ -1796,14 +1959,18 @@ int open(const char *path, int flags, ...) {
     va_start(ap, flags);
     mode_t mode = (mode_t)va_arg(ap, unsigned int);
     va_end(ap);
-    if (!g_active || !is_virtual_path(path))
-        return (int)rsyscall(SYS_open, path, flags, mode);
+    if (!g_active || !is_virtual_path(path)) {
+        int rn = (int)rsyscall(SYS_open, path, flags, mode);
+        if (rn >= 0)
+            fd_native_note(1, rn);
+        return rn;
+    }
     int64_t r = vsys(VSYS_OPEN, flags, mode, 0, path, (uint32_t)strlen(path) + 1, NULL);
     if (r < 0) {
         errno = (int)-r;
         return -1;
     }
-    return (int)r;
+    return (int)vfd_adopt(r);
 }
 
 int open64(const char *path, int flags, ...) {
@@ -1819,8 +1986,12 @@ int openat(int dirfd, const char *path, int flags, ...) {
     va_start(ap, flags);
     mode_t mode = (mode_t)va_arg(ap, unsigned int);
     va_end(ap);
-    if (!g_active || !is_virtual_path(path))
-        return (int)rsyscall(SYS_openat, dirfd, path, flags, mode);
+    if (!g_active || !is_virtual_path(path)) {
+        int rn = (int)rsyscall(SYS_openat, dirfd, path, flags, mode);
+        if (rn >= 0)
+            fd_native_note(1, rn);
+        return rn;
+    }
     return open(path, flags, mode);
 }
 
@@ -1932,7 +2103,7 @@ int eventfd(unsigned int initval, int flags) {
         errno = (int)-r;
         return -1;
     }
-    return (int)r;
+    return (int)vfd_adopt(r);
 }
 
 struct itimerspec; /* avoid including sys/timerfd.h (conflicts are possible
@@ -1946,7 +2117,7 @@ int timerfd_create(int clockid, int flags) {
         errno = (int)-r;
         return -1;
     }
-    return (int)r;
+    return (int)vfd_adopt(r);
 }
 
 int timerfd_settime(int fd, int flags, const void *new_value, void *old_value) {
@@ -2007,7 +2178,7 @@ int epoll_create1(int flags) {
         errno = (int)-r;
         return -1;
     }
-    return (int)r;
+    return (int)vfd_adopt(r);
 }
 
 int epoll_create(int size) {
@@ -2841,7 +3012,7 @@ long shim_route_syscall(long nr, long a1, long a2, long a3, long a4, long a5,
         return shim_raw_syscall(nr, a1, a2, a3, a4, a5, a6);
 
     case SYS_newfstatat:
-        if ((int)a1 >= VFD_BASE && a2 && ((const char *)a2)[0] == '\0')
+        if (is_vfd((int)a1) && a2 && ((const char *)a2)[0] == '\0')
             /* AT_EMPTY_PATH on a virtual fd: our fstat emulation */
             return KR(fstat((int)a1, (struct stat *)a3));
         if (is_virtual_path((const char *)a2)) {
@@ -2854,12 +3025,12 @@ long shim_route_syscall(long nr, long a1, long a2, long a3, long a4, long a5,
         return shim_raw_syscall(nr, a1, a2, a3, a4, a5, a6);
 
     case SYS_statx:
-        if (((int)a1 >= VFD_BASE && a2 && ((const char *)a2)[0] == '\0') ||
+        if ((is_vfd((int)a1) && a2 && ((const char *)a2)[0] == '\0') ||
             is_virtual_path((const char *)a2)) {
             /* statx on simulated objects: synthesize from our fstat */
             struct stat st;
             int rc = 0;
-            if ((int)a1 >= VFD_BASE)
+            if (is_vfd((int)a1))
                 rc = fstat((int)a1, &st);
             else {
                 memset(&st, 0, sizeof(st));
@@ -2932,6 +3103,7 @@ long shim_route_syscall(long nr, long a1, long a2, long a3, long a4, long a5,
         if (!g_exit_sent && !g_main_exited) {
             g_exit_sent = 1;
             vsys(VSYS_EXIT, (int64_t)a1, 0, 0, NULL, 0, NULL);
+            t_detached_from_sim = 1; /* late teardown stays native */
         }
         return shim_raw_syscall(nr, a1, a2, a3, a4, a5, a6);
 

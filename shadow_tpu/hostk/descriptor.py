@@ -121,16 +121,21 @@ class File:
 
 
 class DescriptorTable:
-    """fd -> File with POSIX lowest-free allocation above VFD_BASE
-    (reference: descriptor_table.rs:12-212; virtual fds live above real
-    ones so native fds pass through the shim untouched)."""
+    """fd -> File with POSIX lowest-free allocation in the UNIFIED real fd
+    number space (reference: descriptor_table.rs:12-212). Native
+    passthrough fds share the space: the shim claims every virtual number
+    with a /dev/null placeholder and reports native opens/closes
+    (VSYS_FD_NATIVE), so the allocator never hands out a number a real
+    file occupies — select()/dup2-to-low-fd guests see POSIX numbering."""
 
     def __init__(self):
         self._files: dict[int, File] = {}
+        # native fd numbers the shim reported in use (stdio preset)
+        self.native_used: set[int] = {0, 1, 2}
 
-    def alloc(self, file: File, min_fd: int = VFD_BASE) -> int:
+    def alloc(self, file: File, min_fd: int = 0) -> int:
         fd = min_fd
-        while fd in self._files:
+        while fd in self._files or fd in self.native_used:
             fd += 1
         self._files[fd] = file
         file.refcount += 1

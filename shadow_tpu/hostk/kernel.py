@@ -84,7 +84,6 @@ from shadow_tpu.hostk.dns import Dns
 from shadow_tpu.hostk.strace import StraceFile
 from shadow_tpu.simtime import SIM_START_UNIX_NS, TIME_MAX
 
-from shadow_tpu.hostk.descriptor import VFD_BASE
 
 EPHEMERAL_PORT_BASE = 10_000
 LOOPBACK_LATENCY_NS = 1_000  # same-host delivery when the graph has no self-path
@@ -1372,6 +1371,7 @@ class NetKernel:
         for fd, f in parent.fdtab._files.items():
             child.fdtab._files[fd] = f
             f.refcount += 1
+        child.fdtab.native_used = set(parent.fdtab.native_used)
         # address space: the child inherits the parent's mappings/break
         child.mappings = dict(parent.mappings)
         child.brk_end = parent.brk_end
@@ -1861,6 +1861,18 @@ class NetKernel:
 
     # --- descriptor ops ---------------------------------------------------
 
+    def _sys_fd_native(self, proc, msg):
+        """The shim reports native passthrough fds entering (op 1) and
+        leaving (op 2) use, keeping the unified lowest-free allocator off
+        numbers real files occupy (descriptor_table.rs:12 role)."""
+        op, fd = int(msg.a[1]), int(msg.a[2])
+        if op == 1:
+            proc.process.fdtab.native_used.add(fd)
+        else:
+            proc.process.fdtab.native_used.discard(fd)
+        proc._reply(0)
+        return True
+
     def _sys_close(self, proc, msg):
         fd = int(msg.a[1])
         if self._file(proc, fd) is None:
@@ -1881,17 +1893,14 @@ class NetKernel:
         if f is None:
             proc._reply(-EBADF)
             return True
-        if newfd < VFD_BASE:
-            # virtual files cannot shadow native fd numbers: the shim
-            # routes by fd range (vfds >= 1000), so dup2 of a simulated
-            # file onto 0/1/2 etc. is not representable
-            proc._reply(-EINVAL)
-            return True
         if oldfd == newfd:
             proc._reply(newfd)
             return True
         if proc.fdtab.get(newfd) is not None:
             self._close_fd(proc, newfd)
+        # dup2 onto a native number displaces the native file (the shim's
+        # placeholder claim closes it on the real kernel)
+        proc.fdtab.native_used.discard(newfd)
         proc.fdtab.alloc_at(f, newfd)
         proc._reply(newfd)
         return True
@@ -1923,6 +1932,8 @@ class NetKernel:
         elif cmd == F_SETFL:
             f.nonblock = bool(arg & O_NONBLOCK)
             proc._reply(0)
+        elif cmd in (0, 1030):  # F_DUPFD / F_DUPFD_CLOEXEC
+            proc._reply(proc.fdtab.alloc(f, min_fd=max(int(arg), 0)))
         else:
             proc._reply(0)  # accept-and-ignore (F_SETFD etc.)
         return True
@@ -2755,10 +2766,11 @@ class NetKernel:
             count = 0
             for i, (fd, events) in enumerate(entries):
                 f = self._file(proc, fd)
-                if fd >= VFD_BASE and f is None:
-                    rev = 0x20  # POLLNVAL: virtual fd that was never/no longer open
-                elif f is None:
-                    rev = 0  # native fd in a mixed set: treated as never-ready
+                if f is None:
+                    # unknown fd: could be a native file the shim never
+                    # noted (launcher-inherited, unnotable creator) — be
+                    # lenient and treat as never-ready, not POLLNVAL
+                    rev = 0
                 else:
                     mask = f.poll_mask()
                     rev = 0
@@ -3310,6 +3322,7 @@ _DISPATCH = {
     I.VSYS_FUTEX_REQUEUE: NetKernel._sys_futex_requeue,
     I.VSYS_SIGMASK: NetKernel._sys_sigmask,
     I.VSYS_MM_NOTE: NetKernel._sys_mm_note,
+    I.VSYS_FD_NATIVE: NetKernel._sys_fd_native,
     I.VSYS_FORK: NetKernel._sys_fork,
     I.VSYS_WAITPID: NetKernel._sys_waitpid,
     I.VSYS_PAUSE: NetKernel._sys_pause,

@@ -1,0 +1,54 @@
+/* Unified-fd-space guest (reference descriptor_table.rs:12 POSIX
+ * lowest-free): virtual fds get real lowest-free numbers, interleave
+ * correctly with native files, work in select() below FD_SETSIZE, and can
+ * be dup2()ed onto stdin (inetd style). Output must match a native run
+ * byte for byte — including the fd numbers themselves. */
+#include <fcntl.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/select.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+int main(void) {
+    setvbuf(stdout, NULL, _IONBF, 0);
+
+    int s1 = socket(AF_INET, SOCK_DGRAM, 0); /* lowest free: 3 */
+    int f = open("data.txt", O_CREAT | O_RDWR, 0644); /* native: 4 */
+    int s2 = socket(AF_INET, SOCK_DGRAM, 0); /* 5 */
+    close(s1);
+    int s3 = socket(AF_INET, SOCK_DGRAM, 0); /* reuses 3 */
+    printf("fds %d %d %d %d\n", s1, f, s2, s3);
+
+    int sv[2];
+    if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv) != 0) {
+        perror("socketpair");
+        return 1;
+    }
+    printf("pair %d %d\n", sv[0], sv[1]);
+    if (write(sv[1], "x", 1) != 1)
+        return 1;
+    fd_set rf;
+    FD_ZERO(&rf);
+    FD_SET(sv[0], &rf);
+    struct timeval tv = {5, 0};
+    int n = select(sv[0] + 1, &rf, NULL, NULL, &tv);
+    printf("select %d ready=%d\n", n, FD_ISSET(sv[0], &rf));
+
+    int p[2];
+    if (pipe(p) != 0)
+        return 1;
+    if (write(p[1], "hello", 5) != 5)
+        return 1;
+    if (dup2(p[0], 0) != 0) { /* redirect stdin to the pipe */
+        perror("dup2");
+        return 1;
+    }
+    char buf[8] = {0};
+    ssize_t r = read(0, buf, 5);
+    printf("stdin %zd %s\n", r, buf);
+
+    printf("fd all ok\n");
+    return 0;
+}
