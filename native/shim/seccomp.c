@@ -107,6 +107,7 @@ static const int TRAPPED[] = {
      * clone/exec family must never silently escape (shim.c routes or
      * fails loudly; the shim's own IPC futexes ride the gadget) */
     202 /*futex*/,     56 /*clone*/,       435 /*clone3*/,
+    60 /*exit: a raw thread's death must reach the kernel*/,
     58 /*vfork*/,      59 /*execve*/,      322 /*execveat*/,
     /* guests must never block SIGSYS (a blocked seccomp trap is a forced
      * kill — glibc blocks *all* signals around pthread_create/fork);

@@ -270,7 +270,52 @@ static long raw_open_rw(const char *path) {
 static int g_main_exited = 0; /* main pthread_exit'ed; kernel-side it is gone */
 static int g_exit_sent = 0;  /* VSYS_EXIT already recorded for this process */
 
-static inline ShimShmem *cur_shm(void) { return t_shm ? t_shm : g_shm; }
+/* Raw-clone threads cannot use the shim's __thread state: without
+ * CLONE_SETTLS they alias their creator's TLS block (writes would
+ * corrupt the parent), and with a guest-built TLS the shim's __thread
+ * offsets dereference guest memory. Their per-thread state lives in
+ * this real-tid-keyed table instead; the accessors consult it only
+ * while raw threads exist (zero overhead otherwise). */
+#define RAW_THREADS_MAX 128
+struct RawThreadSlot {
+    int rtid; /* real kernel tid; 0 = free */
+    ShimShmem *shm;
+    int64_t vtid;
+    int detached;
+};
+static struct RawThreadSlot g_raw_threads[RAW_THREADS_MAX];
+static int g_raw_threads_live = 0;
+
+static struct RawThreadSlot *raw_slot_self(void) {
+    if (!__atomic_load_n(&g_raw_threads_live, __ATOMIC_ACQUIRE))
+        return NULL;
+    int rt = (int)shim_raw_syscall(SYS_gettid, 0, 0, 0, 0, 0, 0);
+    for (int i = 0; i < RAW_THREADS_MAX; i++)
+        if (__atomic_load_n(&g_raw_threads[i].rtid, __ATOMIC_RELAXED) == rt)
+            return &g_raw_threads[i];
+    return NULL;
+}
+
+static inline ShimShmem *cur_shm(void) {
+    struct RawThreadSlot *s = raw_slot_self();
+    if (s)
+        return s->shm;
+    return t_shm ? t_shm : g_shm;
+}
+
+static inline int64_t cur_vtid(void) {
+    struct RawThreadSlot *s = raw_slot_self();
+    if (s)
+        return s->vtid;
+    return t_tid;
+}
+
+static inline int cur_detached(void) {
+    struct RawThreadSlot *s = raw_slot_self();
+    if (s)
+        return s->detached;
+    return t_detached_from_sim;
+}
 
 /* ---- raw syscalls for passthrough (avoid dlsym recursion) ---- */
 
@@ -282,7 +327,7 @@ static long raw_clock_gettime(clockid_t c, struct timespec *ts) {
 
 static void ipc_call(ShimMsg *m) {
     ShimShmem *s = cur_shm();
-    m->tid = (uint32_t)(t_tid ? t_tid : g_vpid);
+    m->tid = (uint32_t)({ int64_t _v = cur_vtid(); _v ? _v : g_vpid; });
     shim_channel_send(&s->to_shadow, m);
     shim_channel_recv(&s->to_shim, m, -1);
     if (m->sig) {
@@ -306,7 +351,7 @@ static void ipc_call(ShimMsg *m) {
 
 static int64_t vsys_ex(int code, int64_t a1, int64_t a2, int64_t a3, int64_t a5,
                        const void *out_buf, uint32_t out_len, ShimMsg *reply) {
-    if (t_detached_from_sim)
+    if (cur_detached())
         return 0; /* thread already exited the simulation */
     ShimMsg m;
     memset(&m, 0, offsetof(ShimMsg, buf));
@@ -543,7 +588,8 @@ pid_t getppid(void) {
 pid_t gettid(void) {
     if (!g_active)
         return (pid_t)rsyscall(SYS_gettid);
-    return (pid_t)(t_tid ? t_tid : g_vpid);
+    int64_t v = cur_vtid();
+    return (pid_t)(v ? v : g_vpid);
 }
 
 uid_t getuid(void) { return g_active ? 1000 : (uid_t)rsyscall(SYS_getuid); }
@@ -627,6 +673,189 @@ static void *thread_trampoline(void *p) {
     t_detached_from_sim = 1; /* the kernel dropped this channel */
     unregister_shm_map((void *)t_shm); /* reclaim the table slot */
     return ret;
+}
+
+/* ---- raw clone(CLONE_THREAD) adoption ----
+ * (reference: ManagedThread::native_clone, managed_thread.rs:294-365 +
+ * the shim's hand-rolled child trampoline, shim_syscall.c:25-112.)
+ *
+ * A guest that bypasses glibc pthreads issues a raw clone with its own
+ * child stack; the child is expected to resume at the instruction after
+ * the syscall, on that stack, with rax = 0 and every other register
+ * preserved. We cannot let the child start there directly — it must
+ * first attach its simulation channel — so the actual clone runs on a
+ * shim-owned trampoline stack whose top holds a boot record with the
+ * guest's full register image (captured from the SIGSYS ucontext). The
+ * child attaches, announces THREAD_START, parks until scheduled, then
+ * restores the image (rsp = the guest's newsp, rax = 0) and jumps back
+ * into guest code. CLONE_SETTLS/CHILD_SETTID/CLEARTID pass through to
+ * the real clone, so TLS and the kernel's exit-time ctid futex wake keep
+ * native semantics. Divergence: the parent's return value is the
+ * *virtual* tid (consistent with the simulated pid/tid namespace), while
+ * the kernel writes real tids into ptid/ctid words.
+ */
+
+typedef struct RawCloneBoot {
+    char path[256];   /* the thread's shm channel */
+    long tid;         /* virtual tid */
+    int has_fp;
+    char fp[512] __attribute__((aligned(16))); /* fxsave image at trap */
+    /* guest register image: [0]=rip [1]=rsp(newsp) [2]=rbx [3]=rbp
+     * [4]=r12 [5]=r13 [6]=r14 [7]=r15 [8]=rdi [9]=rsi [10]=rdx
+     * [11]=r8 [12]=r9 [13]=r10 */
+    long regs[14];
+} RawCloneBoot;
+
+void shim_raw_clone_child(RawCloneBoot *boot) {
+    int fd = (int)raw_open_rw(boot->path);
+    void *m = fd >= 0 ? raw_mmap(NULL, SHIM_SHMEM_SIZE,
+                                 PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0)
+                      : MAP_FAILED;
+    if (fd >= 0)
+        raw_close(fd);
+    if (m == MAP_FAILED)
+        shim_raw_syscall(SYS_exit, 119, 0, 0, 0, 0, 0);
+    /* NO __thread writes here: this thread has no shim TLS of its own
+     * (see the RawThreadSlot table). Claim a slot keyed by real tid. */
+    int rt = (int)shim_raw_syscall(SYS_gettid, 0, 0, 0, 0, 0, 0);
+    struct RawThreadSlot *slot = NULL;
+    for (int i = 0; i < RAW_THREADS_MAX && !slot; i++) {
+        int zero = 0;
+        if (__atomic_compare_exchange_n(&g_raw_threads[i].rtid, &zero, rt, 0,
+                                        __ATOMIC_ACQ_REL, __ATOMIC_RELAXED))
+            slot = &g_raw_threads[i];
+    }
+    if (!slot)
+        shim_raw_syscall(SYS_exit, 119, 0, 0, 0, 0, 0);
+    slot->shm = (ShimShmem *)m;
+    slot->vtid = boot->tid;
+    slot->detached = 0;
+    __atomic_add_fetch(&g_raw_threads_live, 1, __ATOMIC_RELEASE);
+    register_shm_map(m);
+    /* the clone inherited the SIGSYS-blocked mask of the parent's signal
+     * handler; unblock it or this thread's first trapped syscall is a
+     * forced kill */
+    uint64_t sysmask = 1ULL << (SIGSYS - 1);
+    shim_raw_syscall(SYS_rt_sigprocmask, SIG_UNBLOCK, (long)&sysmask, 0, 8, 0,
+                     0);
+    ShimMsg msg;
+    memset(&msg, 0, offsetof(ShimMsg, buf));
+    msg.kind = SHIM_MSG_THREAD_START;
+    msg.tid = (uint32_t)boot->tid;
+    msg.a[0] = boot->tid;
+    shim_channel_send(&slot->shm->to_shadow, &msg);
+    shim_channel_recv(&slot->shm->to_shim, &msg, -1);
+    /* scheduled: become the guest thread it asked for. Restore the FP/SSE
+     * image first (a real clone preserves it; our detour ran shim code) */
+    if (boot->has_fp)
+        asm volatile("fxrstor64 (%0)" : : "r"(boot->fp) : "memory");
+    asm volatile(
+        "mov 0x10(%%rax), %%rbx\n\t"
+        "mov 0x18(%%rax), %%rbp\n\t"
+        "mov 0x20(%%rax), %%r12\n\t"
+        "mov 0x28(%%rax), %%r13\n\t"
+        "mov 0x30(%%rax), %%r14\n\t"
+        "mov 0x38(%%rax), %%r15\n\t"
+        "mov 0x40(%%rax), %%rdi\n\t"
+        "mov 0x48(%%rax), %%rsi\n\t"
+        "mov 0x50(%%rax), %%rdx\n\t"
+        "mov 0x58(%%rax), %%r8\n\t"
+        "mov 0x60(%%rax), %%r9\n\t"
+        "mov 0x68(%%rax), %%r10\n\t"
+        "mov 0x08(%%rax), %%rsp\n\t" /* the guest's newsp */
+        "mov 0x00(%%rax), %%r11\n\t" /* rip (r11 is syscall-clobbered) */
+        "xor %%eax, %%eax\n\t"       /* clone returns 0 in the child */
+        "jmp *%%r11\n\t"
+        :
+        : "a"(&boot->regs[0])
+        : "memory");
+    __builtin_unreachable();
+}
+
+/* The clone must be issued through the BPF-allowed gadget
+ * (shim_raw_syscall) — any other syscall instruction re-traps SIGSYS.
+ * The gadget ends in `ret`, so the child's landing is controlled by
+ * planting this thunk's address in the cell its fresh stack points at:
+ * the gadget's ret pops it, leaving rsp = &boot. */
+__asm__(".text\n"
+        ".globl shim_raw_clone_entry\n"
+        ".type shim_raw_clone_entry, @function\n"
+        "shim_raw_clone_entry:\n"
+        "  mov %rsp, %rdi\n"
+        "  sub $512, %rsp\n"
+        "  and $-16, %rsp\n"
+        "  call shim_raw_clone_child\n"
+        "  hlt\n"
+        ".size shim_raw_clone_entry, .-shim_raw_clone_entry\n");
+extern char shim_raw_clone_entry[];
+
+/* per-thread trampoline stack; abandoned (not unmapped) once the child
+ * jumps into guest code — acceptable for the thread counts managed
+ * guests run today, revisit with a parked-stack free list for
+ * Go-runtime-scale thread churn */
+#define RAW_THREAD_STACK (256 * 1024)
+
+static long raw_thread_clone(unsigned long flags, void *newsp, int *ptid,
+                             int *ctid, unsigned long tls) {
+    ucontext_t *uc = (ucontext_t *)shim_sigsys_uctx;
+    if (uc == NULL || newsp == NULL)
+        return -ENOSYS; /* only raw (seccomp-trapped) clones arrive here */
+    ShimMsg reply;
+    int64_t r = vsys(VSYS_THREAD_CREATE, 0, 0, 0, NULL, 0, &reply);
+    if (r < 0)
+        return r;
+    long vtid = (long)reply.a[2];
+
+    void *stk = raw_mmap(NULL, RAW_THREAD_STACK, PROT_READ | PROT_WRITE,
+                         MAP_PRIVATE | MAP_ANONYMOUS | MAP_STACK, -1, 0);
+    if (stk == MAP_FAILED) {
+        vsys(VSYS_THREAD_FAILED, vtid, 0, 0, NULL, 0, NULL);
+        return -ENOMEM;
+    }
+    /* boot record at the top; directly below it, the landing cell the
+     * gadget's ret pops (leaving the child's rsp = &boot) */
+    RawCloneBoot *boot =
+        (RawCloneBoot *)(((uintptr_t)stk + RAW_THREAD_STACK -
+                          sizeof(RawCloneBoot) - 64) &
+                         ~(uintptr_t)15);
+    void **cell = (void **)((uintptr_t)boot - 8);
+    *cell = (void *)shim_raw_clone_entry;
+    if (reply.buf_len >= sizeof(boot->path)) {
+        /* a truncated channel path would strand the child; refuse */
+        vsys(VSYS_THREAD_FAILED, vtid, 0, 0, NULL, 0, NULL);
+        return -ENOSYS;
+    }
+    memcpy(boot->path, reply.buf, reply.buf_len);
+    boot->path[reply.buf_len] = 0;
+    boot->tid = vtid;
+    boot->has_fp = 0;
+    if (uc->uc_mcontext.fpregs) {
+        memcpy(boot->fp, uc->uc_mcontext.fpregs, sizeof(boot->fp));
+        boot->has_fp = 1;
+    }
+    greg_t *g = uc->uc_mcontext.gregs;
+    boot->regs[0] = (long)g[REG_RIP];
+    boot->regs[1] = (long)newsp;
+    boot->regs[2] = (long)g[REG_RBX];
+    boot->regs[3] = (long)g[REG_RBP];
+    boot->regs[4] = (long)g[REG_R12];
+    boot->regs[5] = (long)g[REG_R13];
+    boot->regs[6] = (long)g[REG_R14];
+    boot->regs[7] = (long)g[REG_R15];
+    boot->regs[8] = (long)g[REG_RDI];
+    boot->regs[9] = (long)g[REG_RSI];
+    boot->regs[10] = (long)g[REG_RDX];
+    boot->regs[11] = (long)g[REG_R8];
+    boot->regs[12] = (long)g[REG_R9];
+    boot->regs[13] = (long)g[REG_R10];
+
+    long rtid = shim_raw_syscall(SYS_clone, (long)flags, (long)cell,
+                                 (long)ptid, (long)ctid, (long)tls);
+    if (rtid < 0) {
+        vsys(VSYS_THREAD_FAILED, vtid, 0, 0, NULL, 0, NULL);
+        return rtid;
+    }
+    return vtid;
 }
 
 void pthread_exit(void *retval) {
@@ -1150,7 +1379,7 @@ static void vfd_release(int fd) {
 /* Tell the kernel a NATIVE fd number came into / went out of use, so its
  * lowest-free allocator never collides with passthrough files. */
 static void fd_native_note(int op, int fd) {
-    if (g_active && !t_detached_from_sim && fd >= 0)
+    if (g_active && !cur_detached() && fd >= 0)
         vsys(VSYS_FD_NATIVE, op, fd, 0, NULL, 0, NULL);
 }
 
@@ -2632,7 +2861,7 @@ void RAND_add(const void *buf, int num, double entropy) {
 
 long shim_route_syscall(long nr, long a1, long a2, long a3, long a4, long a5,
                         long a6) {
-    if (!g_active || t_detached_from_sim)
+    if (!g_active || cur_detached())
         /* teardown race, or a thread past its simulated exit: native */
         return shim_raw_syscall(nr, a1, a2, a3, a4, a5, a6);
     switch (nr) {
@@ -2776,7 +3005,7 @@ long shim_route_syscall(long nr, long a1, long a2, long a3, long a4, long a5,
          * raw signaling is not modeled and fails honestly */
         long sig = nr == SYS_tgkill ? a3 : a2;
         long tid = nr == SYS_tgkill ? a2 : a1;
-        long my_vtid = t_tid ? t_tid : g_vpid;
+        long my_vtid = cur_vtid() ? cur_vtid() : g_vpid;
         if (tid <= 0)
             return -22; /* EINVAL */
         if (tid == my_vtid) {
@@ -2900,12 +3129,30 @@ long shim_route_syscall(long nr, long a1, long a2, long a3, long a4, long a5,
             /* fork-style clone (glibc fork issues clone(SIGCHLD|...)):
              * route through the managed fork path */
             return KR(fork());
-        /* raw thread birth needs the reference's no-libc TLS scheme
-         * (managed_thread.rs:294-365); executing it natively would
-         * silently desimulate the guest — fail loudly instead */
-        shim_warn("shadow-shim: raw clone(CLONE_THREAD/VM) is not yet "
-                  "simulated, failing ENOSYS\n");
+        if (flags & CLONE_THREAD)
+            /* raw thread birth: trampoline adoption (see raw_thread_clone) */
+            return raw_thread_clone(flags, (void *)a2, (int *)a3, (int *)a4,
+                                    (unsigned long)a5);
+        shim_warn("shadow-shim: raw clone(CLONE_VM without CLONE_THREAD / "
+                  "CLONE_VFORK) is not simulated, failing ENOSYS\n");
         return -ENOSYS;
+    }
+    case SYS_exit: {
+        /* a single thread exiting (raw-clone threads end here; glibc
+         * pthread workers arrive already detached and take the raw
+         * path via the top-of-function check) */
+        vsys(VSYS_THREAD_EXIT, a1, 0, 0, NULL, 0, NULL);
+        struct RawThreadSlot *slot = raw_slot_self();
+        if (slot) {
+            slot->detached = 1;
+            unregister_shm_map((void *)slot->shm);
+            __atomic_store_n(&slot->rtid, -1, __ATOMIC_RELEASE);
+        } else {
+            t_native_futex_ok = 1;
+            t_detached_from_sim = 1;
+            unregister_shm_map((void *)t_shm);
+        }
+        return shim_raw_syscall(SYS_exit, a1, 0, 0, 0, 0, 0);
     }
     case SYS_clone3:
         if (t_native_clone_ok)
@@ -2953,7 +3200,7 @@ long shim_route_syscall(long nr, long a1, long a2, long a3, long a4, long a5,
             /* tell the kernel so simulated delivery honors the mask — but
              * only from a thread that owns a channel (a clone child runs
              * glibc's mask-restore before our trampoline attaches one) */
-            if (t_tid != 0 ||
+            if (cur_vtid() != 0 ||
                 shim_raw_syscall(SYS_gettid, 0L, 0L, 0L, 0L, 0L, 0L) ==
                     shim_raw_syscall(SYS_getpid, 0L, 0L, 0L, 0L, 0L, 0L))
                 vsys(VSYS_SIGMASK, (int64_t)nm, 0, 0, NULL, 0, NULL);

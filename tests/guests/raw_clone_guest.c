@@ -1,0 +1,93 @@
+/* Raw clone(CLONE_THREAD) guest: creates a thread the musl way — raw
+ * clone syscall with a self-managed stack, no glibc pthreads anywhere —
+ * then synchronizes with raw futexes and joins via a flag. The adoption
+ * trampoline (shim.c raw_thread_clone) must attach the child to the
+ * simulation: its raw syscalls (write, futex, nanosleep, exit) are
+ * simulated and deterministically scheduled.
+ * (reference: managed_thread.rs:294-365 native_clone + src/test/golang/
+ * as the eventual runtime target) */
+#define _GNU_SOURCE
+#include <sched.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <unistd.h>
+
+static long rsys(long nr, long a1, long a2, long a3, long a4, long a5) {
+    long ret;
+    register long r10 asm("r10") = a4;
+    register long r8 asm("r8") = a5;
+    asm volatile("syscall"
+                 : "=a"(ret)
+                 : "0"(nr), "D"(a1), "S"(a2), "d"(a3), "r"(r10), "r"(r8)
+                 : "rcx", "r11", "memory");
+    return ret;
+}
+
+#define SYS_write_ 1
+#define SYS_nanosleep_ 35
+#define SYS_futex_ 202
+#define SYS_exit_ 60
+#define SYS_clone_ 56
+#define FUTEX_WAIT_ 0
+#define FUTEX_WAKE_ 1
+
+static volatile int g_flag = 0;
+static volatile int g_sum = 0;
+
+static int child_fn(void *arg) {
+    long n = (long)arg;
+    struct { long s, ns; } d = {0, 20 * 1000 * 1000};
+    rsys(SYS_nanosleep_, (long)&d, 0, 0, 0, 0); /* 20 ms simulated */
+    g_sum = (int)(n * 7);
+    const char msg[] = "child ran\n";
+    rsys(SYS_write_, 1, (long)msg, sizeof(msg) - 1, 0, 0);
+    g_flag = 1;
+    rsys(SYS_futex_, (long)&g_flag, FUTEX_WAKE_, 1, 0, 0);
+    return 0;
+}
+
+static long my_clone(int (*fn)(void *), void *stack_top, void *arg) {
+    void **sp = (void **)stack_top;
+    *--sp = arg;
+    *--sp = (void *)fn;
+    long flags = CLONE_VM | CLONE_FS | CLONE_FILES | CLONE_SIGHAND |
+                 CLONE_THREAD | CLONE_SYSVSEM;
+    long ret;
+    asm volatile("syscall\n\t"
+                 "test %%rax, %%rax\n\t"
+                 "jnz 1f\n\t"
+                 /* child: pop fn and arg from our prepared stack */
+                 "pop %%rax\n\t"
+                 "pop %%rdi\n\t"
+                 "call *%%rax\n\t"
+                 "mov %%rax, %%rdi\n\t"
+                 "mov $60, %%rax\n\t"
+                 "syscall\n\t"
+                 "1:"
+                 : "=a"(ret)
+                 : "0"((long)SYS_clone_), "D"(flags), "S"(sp), "d"(0)
+                 : "rcx", "r11", "memory");
+    return ret;
+}
+
+int main(void) {
+    setvbuf(stdout, NULL, _IONBF, 0);
+    void *stk = mmap(NULL, 256 * 1024, PROT_READ | PROT_WRITE,
+                     MAP_PRIVATE | MAP_ANONYMOUS | MAP_STACK, -1, 0);
+    if (stk == MAP_FAILED) {
+        perror("mmap");
+        return 1;
+    }
+    long tid = my_clone(child_fn, (char *)stk + 256 * 1024, (void *)6L);
+    if (tid < 0) {
+        printf("clone failed %ld\n", tid);
+        return 1;
+    }
+    printf("cloned tid>0: %d\n", tid > 0);
+    while (!g_flag) /* futex join on our own flag */
+        rsys(SYS_futex_, (long)&g_flag, FUTEX_WAIT_, 0, 0, 0);
+    printf("sum %d\n", g_sum);
+    printf("raw clone all ok\n");
+    return 0;
+}

@@ -1,0 +1,64 @@
+"""Raw clone(CLONE_THREAD) adoption (round-2 verdict item 4; reference
+ManagedThread::native_clone, managed_thread.rs:294-365 + the shim child
+trampoline, shim_syscall.c:25-112): a guest that creates threads the
+musl/Go way — raw clone with a self-managed stack, zero glibc pthread
+involvement — gets its child adopted into the simulation: the child's
+raw syscalls are simulated, scheduled deterministically, and its exit is
+a kernel-visible THREAD_EXIT."""
+
+import pathlib
+import subprocess
+
+import pytest
+
+from shadow_tpu.graph import NetworkGraph, compute_routing
+from shadow_tpu.hostk.kernel import NetKernel, ProcessSpec
+from shadow_tpu.simtime import NS_PER_SEC
+
+GUESTS = pathlib.Path(__file__).parent / "guests"
+
+
+@pytest.fixture(scope="module")
+def rc_bin(tmp_path_factory):
+    out = tmp_path_factory.mktemp("rc") / "raw_clone_guest"
+    subprocess.run(
+        ["cc", "-O2", "-o", str(out), str(GUESTS / "raw_clone_guest.c")], check=True
+    )
+    return str(out)
+
+
+def _run(tmp_path, rc_bin, sub="s"):
+    graph = NetworkGraph.from_gml(
+        'graph [\n  node [ id 0 ]\n  edge [ source 0 target 0 latency "1 ms" ]\n]'
+    )
+    tables = compute_routing(graph).with_hosts([0])
+    k = NetKernel(
+        tables, host_names=["box"], host_nodes=[0], data_dir=tmp_path / sub
+    )
+    p = k.add_process(ProcessSpec(host="box", args=[rc_bin]))
+    try:
+        k.run(10 * NS_PER_SEC)
+    finally:
+        k.shutdown()
+    return k, p
+
+
+def test_raw_clone_thread_adopted(tmp_path, rc_bin):
+    k, p = _run(tmp_path, rc_bin)
+    out = p.stdout().decode()
+    assert p.exit_code == 0, out + p.stderr().decode()
+    assert "cloned tid>0: 1" in out
+    assert "child ran" in out
+    assert "sum 42" in out
+    assert "raw clone all ok" in out
+    # the child's life was simulated: its nanosleep advanced sim time and
+    # its syscalls hit the kernel
+    names = [s for _, s, _ in p.syscall_log]
+    assert names.count("nanosleep") >= 1
+
+
+def test_raw_clone_deterministic(tmp_path, rc_bin):
+    a = _run(tmp_path, rc_bin, "r1")[1]
+    b = _run(tmp_path, rc_bin, "r2")[1]
+    assert a.stdout() == b.stdout()
+    assert [s for _, s, _ in a.syscall_log] == [s for _, s, _ in b.syscall_log]
