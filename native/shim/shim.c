@@ -417,6 +417,44 @@ static int64_t local_now_ns(void) {
 
 /* ---- attach (reference shim.c:383-470 init order, much simplified) ---- */
 
+/* Launcher-inherited native fds >= 3 are unknown to the kernel's unified
+ * lowest-free fd allocator (its native_used preset is {0,1,2}), so a
+ * virtual allocation could land on one and vfd_adopt's placeholder dup2
+ * would silently clobber it. Enumerate /proc/self/fd once at attach and
+ * report every inherited fd before any virtual allocation can happen. */
+static void report_inherited_fds(void) {
+    int dfd = (int)shim_raw_syscall(SYS_open, (long)"/proc/self/fd",
+                                    O_RDONLY | O_DIRECTORY, 0, 0, 0, 0);
+    if (dfd < 0)
+        return;
+    char buf[2048];
+    for (;;) {
+        long n = shim_raw_syscall(SYS_getdents64, dfd, (long)buf,
+                                  (long)sizeof(buf), 0, 0, 0);
+        if (n <= 0)
+            break;
+        for (long off = 0; off < n;) {
+            /* struct linux_dirent64 layout: u64 ino, s64 off, u16 reclen,
+             * u8 type, char name[] */
+            unsigned short reclen;
+            memcpy(&reclen, buf + off + 16, 2);
+            const char *name = buf + off + 19;
+            if (name[0] >= '0' && name[0] <= '9') {
+                int fd = 0;
+                for (const char *p = name; *p >= '0' && *p <= '9'; p++)
+                    fd = fd * 10 + (*p - '0');
+                /* note inline — fd_native_note sends one channel message
+                 * and allocates no fds, so the open dfd stays valid and
+                 * no fixed-size collection can silently truncate */
+                if (fd >= 3 && fd != dfd)
+                    fd_native_note(1, fd);
+            }
+            off += reclen;
+        }
+    }
+    raw_close(dfd);
+}
+
 __attribute__((constructor)) static void shim_attach(void) {
     const char *path = getenv("SHADOW_SHM");
     if (!path)
@@ -444,6 +482,7 @@ __attribute__((constructor)) static void shim_attach(void) {
     g_vpid = m.a[0];
     g_host_ip = (uint32_t)m.a[1]; /* host-order simulated address */
     g_active = 1;
+    report_inherited_fds();
     /* second interposition tier (reference init order shim.c:383-470:
      * patch vdso, then install seccomp LAST): raw syscall instructions
      * that bypass the libc symbol layer get trapped to the same handlers.
@@ -1361,8 +1400,19 @@ static void resv_init(void) {
 static int64_t vfd_adopt(int64_t r) {
     if (r >= 0 && r < VFD_MAP_MAX) {
         resv_init();
-        if (g_resv_fd >= 0)
+        if (g_resv_fd >= 0) {
+            /* collision check: the number must be free natively (or
+             * already ours). A live native fd here means an unreported
+             * native allocation raced the kernel's — clobbering it would
+             * corrupt fd routing silently, so at least be loud. */
+            if (!is_vfd((int)r) && (int)r != g_resv_fd &&
+                shim_raw_syscall(SYS_fcntl, (long)r, F_GETFD, 0, 0, 0, 0) >=
+                    0)
+                shim_warn("shadow-shim: virtual fd collides with a live "
+                          "unreported native fd; fd routing may be "
+                          "corrupted\n");
             shim_raw_syscall(SYS_dup2, g_resv_fd, (long)r, 0, 0, 0, 0);
+        }
         vfd_mark((int)r, 1);
     }
     return r;
@@ -3146,7 +3196,17 @@ long shim_route_syscall(long nr, long a1, long a2, long a3, long a4, long a5,
         if (slot) {
             slot->detached = 1;
             unregister_shm_map((void *)slot->shm);
-            __atomic_store_n(&slot->rtid, -1, __ATOMIC_RELEASE);
+            /* Release the slot with the allocator's free value (0): the
+             * claimant CAS in shim_raw_clone_child only takes rtid==0, so
+             * storing any other sentinel would leak the slot permanently
+             * and exhaust the table after RAW_THREADS_MAX creations.
+             * tid-ABA is impossible — the kernel can't reuse this real
+             * tid until after the SYS_exit below, and the claimant fully
+             * reinitializes shm/vtid/detached after its CAS. */
+            __atomic_store_n(&slot->rtid, 0, __ATOMIC_RELEASE);
+            /* keep the live count honest so pure-pthread phases (and
+             * fork children) stop paying the 128-slot scan per call */
+            __atomic_sub_fetch(&g_raw_threads_live, 1, __ATOMIC_RELEASE);
         } else {
             t_native_futex_ok = 1;
             t_detached_from_sim = 1;

@@ -1,0 +1,86 @@
+/* Raw-thread churn guest (ADVICE r3 regression): creates and joins more
+ * raw clone(CLONE_THREAD) threads than the shim's slot table holds
+ * (RAW_THREADS_MAX = 128). Before the fix, exited slots were retired
+ * with rtid=-1 — a value the allocator CAS (which claims rtid==0) never
+ * reuses — so creation #129 died child-side with exit(119) after the
+ * parent already got a vtid, hanging the simulation on a THREAD_START
+ * that never arrives. */
+#define _GNU_SOURCE
+#include <sched.h>
+#include <stdio.h>
+#include <sys/mman.h>
+#include <unistd.h>
+
+static long rsys(long nr, long a1, long a2, long a3, long a4, long a5) {
+    long ret;
+    register long r10 asm("r10") = a4;
+    register long r8 asm("r8") = a5;
+    asm volatile("syscall"
+                 : "=a"(ret)
+                 : "0"(nr), "D"(a1), "S"(a2), "d"(a3), "r"(r10), "r"(r8)
+                 : "rcx", "r11", "memory");
+    return ret;
+}
+
+#define SYS_futex_ 202
+#define FUTEX_WAIT_ 0
+#define FUTEX_WAKE_ 1
+
+#define ROUNDS 140 /* > RAW_THREADS_MAX */
+
+static volatile int g_flag;
+static volatile int g_count;
+
+static int child_fn(void *arg) {
+    (void)arg;
+    g_count++;
+    g_flag = 1;
+    rsys(SYS_futex_, (long)&g_flag, FUTEX_WAKE_, 1, 0, 0);
+    return 0;
+}
+
+static long my_clone(int (*fn)(void *), void *stack_top, void *arg) {
+    void **sp = (void **)stack_top;
+    *--sp = arg;
+    *--sp = (void *)fn;
+    long flags = CLONE_VM | CLONE_FS | CLONE_FILES | CLONE_SIGHAND |
+                 CLONE_THREAD | CLONE_SYSVSEM;
+    long ret;
+    asm volatile("syscall\n\t"
+                 "test %%rax, %%rax\n\t"
+                 "jnz 1f\n\t"
+                 "pop %%rax\n\t"
+                 "pop %%rdi\n\t"
+                 "call *%%rax\n\t"
+                 "mov %%rax, %%rdi\n\t"
+                 "mov $60, %%rax\n\t"
+                 "syscall\n\t"
+                 "1:"
+                 : "=a"(ret)
+                 : "0"(56L), "D"(flags), "S"(sp), "d"(0)
+                 : "rcx", "r11", "memory");
+    return ret;
+}
+
+int main(void) {
+    setvbuf(stdout, NULL, _IONBF, 0);
+    for (int i = 0; i < ROUNDS; i++) {
+        /* fresh stack per thread (leaked): the exiting child still runs
+         * its seccomp-trapped exit path on this stack after the join
+         * wake, so the stack cannot be reused for the next thread */
+        void *stk = mmap(NULL, 64 * 1024, PROT_READ | PROT_WRITE,
+                         MAP_PRIVATE | MAP_ANONYMOUS | MAP_STACK, -1, 0);
+        if (stk == MAP_FAILED)
+            return 1;
+        g_flag = 0;
+        long tid = my_clone(child_fn, (char *)stk + 64 * 1024, 0);
+        if (tid < 0) {
+            printf("clone %d failed %ld\n", i, tid);
+            return 1;
+        }
+        while (!g_flag)
+            rsys(SYS_futex_, (long)&g_flag, FUTEX_WAIT_, 0, 0, 0);
+    }
+    printf("churn ok %d\n", g_count);
+    return 0;
+}

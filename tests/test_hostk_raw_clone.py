@@ -57,6 +57,24 @@ def test_raw_clone_thread_adopted(tmp_path, rc_bin):
     assert names.count("nanosleep") >= 1
 
 
+@pytest.fixture(scope="module")
+def churn_bin(tmp_path_factory):
+    out = tmp_path_factory.mktemp("rc") / "raw_clone_churn"
+    subprocess.run(
+        ["cc", "-O2", "-o", str(out), str(GUESTS / "raw_clone_churn.c")], check=True
+    )
+    return str(out)
+
+
+def test_raw_clone_slot_reuse(tmp_path, churn_bin):
+    """ADVICE r3 (medium): exited raw-thread slots must be reusable; 140
+    sequential create/join cycles exceed the 128-slot table."""
+    k, p = _run(tmp_path, churn_bin)
+    out = p.stdout().decode()
+    assert p.exit_code == 0, out + p.stderr().decode()
+    assert "churn ok 140" in out
+
+
 def test_raw_clone_deterministic(tmp_path, rc_bin):
     a = _run(tmp_path, rc_bin, "r1")[1]
     b = _run(tmp_path, rc_bin, "r2")[1]
