@@ -400,6 +400,8 @@ static int64_t vsys(int code, int64_t a1, int64_t a2, int64_t a3,
 
 /* ---- local time (reference shim_sys.c:58-90) ---- */
 
+static int64_t sim_boot_rel_ns(void); /* defined with the /proc views */
+
 static int64_t local_now_ns(void) {
     ShimShmem *s = cur_shm();
     int64_t t =
@@ -652,8 +654,7 @@ int sysinfo(struct sysinfo *info) {
         return (int)rsyscall(SYS_sysinfo, info);
     memset(info, 0, sizeof(*info));
     /* uptime = simulated seconds since the 2000-01-01 epoch */
-    info->uptime = (long)((local_now_ns() - 946684800000000000LL) /
-                          1000000000LL);
+    info->uptime = (long)(sim_boot_rel_ns() / 1000000000LL);
     info->totalram = 16UL << 30;
     info->freeram = 8UL << 30;
     info->procs = 1;
@@ -2233,11 +2234,172 @@ static int is_virtual_path(const char *path) {
                     strcmp(path, "/dev/random") == 0);
 }
 
+/* ---- deterministic /proc views (reference regular_file.c's special file
+ * handling + the determinism contract): real-kernel pids, addresses and
+ * timings must never leak into guests, so the common /proc reads are
+ * served from synthesized memfds. The returned fd is a plain native fd —
+ * read/lseek/fstat/close all work with zero extra plumbing — and is
+ * reported to the unified allocator like any other native fd. */
+
+#define SYS_memfd_create_ 319
+#define MFD_CLOEXEC_ 1U
+
+/* simulated epoch: 2000-01-01T00:00:00Z (simtime.py; emulated_time.rs:25) */
+#define SIM_EPOCH_NS 946684800000000000LL
+#define SIM_EPOCH_SEC 946684800LL
+
+/* ns since simulated boot (= sim start), clamped at 0 */
+static int64_t sim_boot_rel_ns(void) {
+    int64_t el = local_now_ns() - SIM_EPOCH_NS;
+    return el > 0 ? el : 0;
+}
+
+static const char *proc_self_tail(const char *path) {
+    /* "/proc/self/X" or "/proc/<vpid>/X" -> "X"; NULL otherwise */
+    if (strncmp(path, "/proc/", 6) != 0)
+        return NULL;
+    const char *p = path + 6;
+    if (strncmp(p, "self/", 5) == 0)
+        return p + 5;
+    char vbuf[16];
+    int n = snprintf(vbuf, sizeof(vbuf), "%lld/", (long long)g_vpid);
+    if (n > 0 && strncmp(p, vbuf, (size_t)n) == 0)
+        return p + n;
+    return NULL;
+}
+
+static int proc_virtual_content(const char *path, char *out, size_t cap) {
+    const char *tail = proc_self_tail(path);
+    /* proc uptime/ticks are relative to boot = sim start */
+    int64_t now = sim_boot_rel_ns();
+    long long ticks = now / 10000000LL; /* 100 Hz jiffies */
+    if (tail) {
+        char comm[20] = "guest";
+        shim_raw_syscall(SYS_prctl, 16 /*PR_GET_NAME*/, (long)comm, 0, 0, 0,
+                         0);
+        comm[sizeof(comm) - 1] = '\0';
+        if (strcmp(tail, "status") == 0)
+            return snprintf(out, cap,
+                            "Name:\t%s\nUmask:\t0022\nState:\tR (running)\n"
+                            "Tgid:\t%lld\nNgid:\t0\nPid:\t%lld\nPPid:\t0\n"
+                            "TracerPid:\t0\nUid:\t0\t0\t0\t0\nGid:\t0\t0\t0\t0\n"
+                            "FDSize:\t256\nThreads:\t1\n"
+                            "VmPeak:\t  131072 kB\nVmSize:\t  131072 kB\n"
+                            "VmRSS:\t    8192 kB\nVmData:\t   16384 kB\n"
+                            "VmStk:\t     132 kB\n"
+                            "Cpus_allowed:\t1\nCpus_allowed_list:\t0\n"
+                            "voluntary_ctxt_switches:\t0\n"
+                            "nonvoluntary_ctxt_switches:\t0\n",
+                            comm, (long long)g_vpid, (long long)g_vpid);
+        if (strcmp(tail, "stat") == 0)
+            return snprintf(out, cap,
+                            "%lld (%s) R 0 %lld %lld 0 -1 4194304 100 0 0 0 "
+                            "%lld %lld 0 0 20 0 1 0 0 134217728 2048 "
+                            "18446744073709551615 4194304 4198400 "
+                            "140737000000000 0 0 0 0 0 0 0 0 0 17 0 0 0 0 0 0 "
+                            "6291456 6293504 30000000 140737000001000 "
+                            "140737000002000 140737000002000 140737000003000 "
+                            "0\n",
+                            (long long)g_vpid, comm, (long long)g_vpid,
+                            (long long)g_vpid, ticks / 2, ticks / 2);
+        if (strcmp(tail, "statm") == 0)
+            return snprintf(out, cap, "32768 2048 1024 512 0 4096 0\n");
+        if (strcmp(tail, "cgroup") == 0)
+            return snprintf(out, cap, "0::/\n");
+        return -1;
+    }
+    if (strcmp(path, "/proc/meminfo") == 0)
+        /* 16 GB total / 8 GB free — must agree with the sysinfo()
+         * interposer's totalram/freeram (one simulated machine) */
+        return snprintf(out, cap,
+                        "MemTotal:       16777216 kB\n"
+                        "MemFree:         8388608 kB\n"
+                        "MemAvailable:   12582912 kB\n"
+                        "Buffers:          131072 kB\n"
+                        "Cached:           524288 kB\n"
+                        "SwapCached:            0 kB\n"
+                        "SwapTotal:             0 kB\n"
+                        "SwapFree:              0 kB\n");
+    if (strcmp(path, "/proc/cpuinfo") == 0)
+        return snprintf(out, cap,
+                        "processor\t: 0\nvendor_id\t: ShadowTPU\n"
+                        "model name\t: simulated cpu\ncpu MHz\t\t: 1000.000\n"
+                        "cache size\t: 1024 KB\ncpu cores\t: 1\n"
+                        "bogomips\t: 2000.00\n\n");
+    if (strcmp(path, "/proc/stat") == 0)
+        return snprintf(out, cap,
+                        "cpu  %lld 0 %lld 0 0 0 0 0 0 0\n"
+                        "cpu0 %lld 0 %lld 0 0 0 0 0 0 0\n"
+                        "btime %lld\nprocesses 1\n"
+                        "procs_running 1\nprocs_blocked 0\n",
+                        ticks / 2, ticks / 2, ticks / 2, ticks / 2,
+                        (long long)SIM_EPOCH_SEC);
+    if (strcmp(path, "/proc/uptime") == 0)
+        return snprintf(out, cap, "%lld.%02lld %lld.%02lld\n",
+                        now / 1000000000LL, (now / 10000000LL) % 100,
+                        now / 1000000000LL, (now / 10000000LL) % 100);
+    if (strcmp(path, "/proc/loadavg") == 0)
+        return snprintf(out, cap, "0.00 0.00 0.00 1/1 %lld\n",
+                        (long long)g_vpid);
+    if (strcmp(path, "/proc/sys/net/core/somaxconn") == 0)
+        return snprintf(out, cap, "4096\n");
+    if (strcmp(path, "/proc/sys/kernel/pid_max") == 0)
+        return snprintf(out, cap, "4194304\n");
+    return -1;
+}
+
+/* returns a native fd, -2 when the path is not a virtual proc file, or
+ * a negative errno */
+static int proc_virtual_open(const char *path, int flags) {
+    char content[2048];
+    int n = proc_virtual_content(path, content, sizeof(content));
+    if (n < 0)
+        return -2;
+    if ((flags & O_ACCMODE) != O_RDONLY)
+        /* virtual proc views are read-only: a silently-discarded write
+         * (e.g. tuning somaxconn) must not look like it took effect */
+        return -EACCES;
+    int fd = (int)shim_raw_syscall(
+        SYS_memfd_create_, (long)"shadow-proc",
+        (flags & O_CLOEXEC) ? MFD_CLOEXEC_ : 0, 0, 0, 0, 0);
+    if (fd < 0)
+        return fd;
+    long off = 0;
+    while (off < n) {
+        long w = shim_raw_syscall(SYS_write, fd, (long)(content + off),
+                                  n - off, 0, 0, 0);
+        if (w <= 0)
+            break;
+        off += w;
+    }
+    shim_raw_syscall(SYS_lseek, fd, 0, SEEK_SET, 0, 0, 0);
+    fd_native_note(1, fd);
+    return fd;
+}
+
 int open(const char *path, int flags, ...) {
     va_list ap;
     va_start(ap, flags);
     mode_t mode = (mode_t)va_arg(ap, unsigned int);
     va_end(ap);
+    char self_path[256];
+    if (g_active && path && strncmp(path, "/proc/", 6) == 0) {
+        int pf = proc_virtual_open(path, flags);
+        if (pf >= 0)
+            return pf;
+        if (pf != -2) { /* virtual path, refused or memfd failed */
+            errno = -pf;
+            return -1;
+        }
+        /* /proc/<vpid>/<anything else>: the vpid is OUR virtual pid, but
+         * natively that number may name an unrelated real process —
+         * rewrite to /proc/self so the guest reads its own data */
+        const char *tail = proc_self_tail(path);
+        if (tail && strncmp(path + 6, "self/", 5) != 0 &&
+            snprintf(self_path, sizeof(self_path), "/proc/self/%s", tail) <
+                (int)sizeof(self_path))
+            path = self_path;
+    }
     if (!g_active || !is_virtual_path(path)) {
         int rn = (int)rsyscall(SYS_open, path, flags, mode);
         if (rn >= 0)
@@ -2265,6 +2427,8 @@ int openat(int dirfd, const char *path, int flags, ...) {
     va_start(ap, flags);
     mode_t mode = (mode_t)va_arg(ap, unsigned int);
     va_end(ap);
+    if (g_active && path && strncmp(path, "/proc/", 6) == 0)
+        return open(path, flags, mode); /* absolute: dirfd irrelevant */
     if (!g_active || !is_virtual_path(path)) {
         int rn = (int)rsyscall(SYS_openat, dirfd, path, flags, mode);
         if (rn >= 0)
@@ -2814,6 +2978,8 @@ void freeifaddrs(struct ifaddrs *ifa) {
     }
 }
 
+static char *g_empty_aliases[1] = {NULL}; /* glibc never returns NULL */
+
 struct hostent *gethostbyname(const char *name) {
     static __thread struct hostent he;
     static __thread uint32_t addr_be;
@@ -2832,11 +2998,123 @@ struct hostent *gethostbyname(const char *name) {
     addr_list[0] = (char *)&addr_be;
     addr_list[1] = NULL;
     he.h_name = hname;
-    he.h_aliases = NULL;
+    he.h_aliases = g_empty_aliases;
     he.h_addrtype = AF_INET;
     he.h_length = 4;
     he.h_addr_list = addr_list;
     return &he;
+}
+
+struct hostent *gethostbyaddr(const void *addr, socklen_t len, int type) {
+    /* CPython's socket.getfqdn()/gethostbyaddr reach libc's NSS reverse
+     * lookup, which would otherwise fire real UDP DNS queries at the
+     * system resolver (unanswerable in-sim). Serve from the simulated
+     * registry (reference shim_api_addrinfo.c role). */
+    static __thread struct hostent he;
+    static __thread uint32_t addr_be;
+    static __thread char *addr_list[2];
+    static __thread char hname[256];
+    if (!g_active || type != AF_INET || len < 4)
+        return NULL;
+    uint32_t ip;
+    memcpy(&ip, addr, 4);
+    ip = ntohl(ip);
+    ShimMsg reply;
+    int64_t r = vsys(VSYS_RESOLVE_REV, (int64_t)ip, 0, 0, NULL, 0, &reply);
+    if (r < 0) {
+        /* unknown address: stable numeric name (NSS would fail too, but a
+         * deterministic answer keeps getfqdn() fast and replayable) */
+        snprintf(hname, sizeof(hname), "%u.%u.%u.%u", ip >> 24,
+                 (ip >> 16) & 0xFF, (ip >> 8) & 0xFF, ip & 0xFF);
+    } else {
+        size_t n = reply.buf_len < sizeof(hname) - 1 ? reply.buf_len
+                                                     : sizeof(hname) - 1;
+        memcpy(hname, reply.buf, n);
+        hname[n] = '\0';
+    }
+    addr_be = htonl(ip);
+    addr_list[0] = (char *)&addr_be;
+    addr_list[1] = NULL;
+    he.h_name = hname;
+    he.h_aliases = g_empty_aliases;
+    he.h_addrtype = AF_INET;
+    he.h_length = 4;
+    he.h_addr_list = addr_list;
+    return &he;
+}
+
+/* glibc's re-entrant variants (CPython prefers these when available).
+ * Layout carved from caller-provided buf: hostent pointers + name + addr. */
+static int fill_hostent_r(const char *name, uint32_t ip_hostorder,
+                          struct hostent *ret, char *buf, size_t buflen,
+                          struct hostent **result, int *h_errnop) {
+    size_t nlen = strlen(name) + 1;
+    size_t need = nlen + 4 + 2 * sizeof(char *) + 16;
+    if (buflen < need) {
+        if (h_errnop)
+            *h_errnop = NETDB_INTERNAL;
+        return ERANGE;
+    }
+    char **alist = (char **)(((uintptr_t)buf + sizeof(char *) - 1) &
+                             ~(uintptr_t)(sizeof(char *) - 1));
+    char *addr = (char *)(alist + 2);
+    char *nm = addr + 4;
+    uint32_t be = htonl(ip_hostorder);
+    memcpy(addr, &be, 4);
+    memcpy(nm, name, nlen);
+    alist[0] = addr;
+    alist[1] = NULL;
+    ret->h_name = nm;
+    ret->h_aliases = g_empty_aliases;
+    ret->h_addrtype = AF_INET;
+    ret->h_length = 4;
+    ret->h_addr_list = alist;
+    if (result)
+        *result = ret;
+    return 0;
+}
+
+int gethostbyname_r(const char *name, struct hostent *ret, char *buf,
+                    size_t buflen, struct hostent **result, int *h_errnop) {
+    if (!g_active)
+        return ENOENT; /* no passthrough: libc internals */
+    if (result)
+        *result = NULL;
+    ShimMsg reply;
+    int64_t r = vsys(VSYS_RESOLVE, 0, 0, 0, name, (uint32_t)strlen(name) + 1,
+                     &reply);
+    if (r < 0) {
+        if (h_errnop)
+            *h_errnop = HOST_NOT_FOUND;
+        return 0; /* glibc contract: 0 with *result == NULL on not-found */
+    }
+    return fill_hostent_r(name, (uint32_t)reply.a[2], ret, buf, buflen,
+                          result, h_errnop);
+}
+
+int gethostbyaddr_r(const void *addr, socklen_t len, int type,
+                    struct hostent *ret, char *buf, size_t buflen,
+                    struct hostent **result, int *h_errnop) {
+    if (!g_active || type != AF_INET || len < 4)
+        return ENOENT;
+    if (result)
+        *result = NULL;
+    uint32_t ip;
+    memcpy(&ip, addr, 4);
+    ip = ntohl(ip);
+    char name[64];
+    ShimMsg reply;
+    int64_t r = vsys(VSYS_RESOLVE_REV, (int64_t)ip, 0, 0, NULL, 0, &reply);
+    if (r < 0) {
+        snprintf(name, sizeof(name), "%u.%u.%u.%u", ip >> 24,
+                 (ip >> 16) & 0xFF, (ip >> 8) & 0xFF, ip & 0xFF);
+    } else {
+        size_t n = reply.buf_len < sizeof(name) - 1 ? reply.buf_len
+                                                    : sizeof(name) - 1;
+        memcpy(name, reply.buf, n);
+        name[n] = '\0';
+    }
+    return fill_hostent_r(name, ip, ret, buf, buflen, result, h_errnop);
 }
 
 /* ---- deterministic randomness (reference handler/random.rs + the
@@ -2922,7 +3200,10 @@ long shim_route_syscall(long nr, long a1, long a2, long a3, long a4, long a5,
     case SYS_open:
         return KR(open((const char *)a1, (int)a2, (mode_t)a3));
     case SYS_openat:
-        if ((int)a1 == AT_FDCWD || is_virtual_path((const char *)a2))
+        /* absolute paths ignore dirfd, so the /proc virtualization must
+         * apply regardless of a1 (musl/Go issue openat with real dirfds) */
+        if ((int)a1 == AT_FDCWD || is_virtual_path((const char *)a2) ||
+            ((const char *)a2 && strncmp((const char *)a2, "/proc/", 6) == 0))
             return KR(open((const char *)a2, (int)a3, (mode_t)a4));
         return shim_raw_syscall(nr, a1, a2, a3, a4, a5, a6);
     case SYS_close:
@@ -3356,8 +3637,8 @@ long shim_route_syscall(long nr, long a1, long a2, long a3, long a4, long a5,
 
     case SYS_times: {
         /* deterministic: process times derived from the sim clock
-         * (100 Hz ticks since the sim epoch) */
-        int64_t ticks = local_now_ns() / 10000000LL;
+         * (100 Hz ticks since sim start — boot-relative, as Linux) */
+        int64_t ticks = sim_boot_rel_ns() / 10000000LL;
         if (a1) {
             long *t = (long *)a1;
             t[0] = (long)(ticks / 2); /* utime */
@@ -3370,7 +3651,7 @@ long shim_route_syscall(long nr, long a1, long a2, long a3, long a4, long a5,
     case SYS_getrusage: {
         struct rusage *ru = (struct rusage *)a2;
         memset(ru, 0, sizeof(*ru));
-        int64_t us = local_now_ns() / 1000;
+        int64_t us = sim_boot_rel_ns() / 1000;
         ru->ru_utime.tv_sec = us / 2000000;
         ru->ru_utime.tv_usec = (us / 2) % 1000000;
         ru->ru_stime = ru->ru_utime;

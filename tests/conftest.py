@@ -67,6 +67,8 @@ SLOW_TESTS = {
     "test_parallel_matches_serial",
     "test_parallel_worker_count_invariant",
     "test_phold_compact_bit_identical",
+    "test_python_http_server_serves_curl",
+    "test_python_http_server_deterministic",
     "test_sharded_bulk_tcp_1k_hosts_matches_single",
     "test_sharded_compact_matches_single_device",
     "test_sharded_matches_single_device",

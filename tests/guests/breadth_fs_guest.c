@@ -1,0 +1,153 @@
+/* Syscall-breadth guest: the nginx-grade file/metadata surface the
+ * round-3 verdict listed (reference checklist:
+ * src/main/host/syscall_handler.c:301-463): getdents64, statx,
+ * newfstatat, access/faccessat, readlink(at), getcwd/chdir,
+ * sched_getaffinity, sysinfo, prlimit64, times/getrusage, and the
+ * deterministic /proc views. Prints a transcript that must be
+ * byte-identical across runs and contain only simulated values. */
+#define _GNU_SOURCE
+#include <dirent.h>
+#include <fcntl.h>
+#include <sched.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/resource.h>
+#include <sys/stat.h>
+#include <sys/sysinfo.h>
+#include <sys/syscall.h>
+#include <sys/times.h>
+#include <unistd.h>
+
+static int cmpstr(const void *a, const void *b) {
+    return strcmp(*(const char *const *)a, *(const char *const *)b);
+}
+
+int main(void) {
+    setvbuf(stdout, NULL, _IONBF, 0);
+
+    /* getcwd / mkdir / chdir */
+    char cwd0[512], cwd1[512];
+    if (!getcwd(cwd0, sizeof(cwd0)))
+        return 1;
+    mkdir("subdir", 0755);
+    if (chdir("subdir") != 0)
+        return 2;
+    getcwd(cwd1, sizeof(cwd1));
+    printf("chdir ok: %d\n", strlen(cwd1) > strlen(cwd0));
+    chdir("..");
+
+    /* files + getdents64 via readdir */
+    for (int i = 0; i < 3; i++) {
+        char name[32];
+        snprintf(name, sizeof(name), "f%d.txt", i);
+        FILE *f = fopen(name, "w");
+        fprintf(f, "hello %d\n", i);
+        fclose(f);
+    }
+    DIR *d = opendir(".");
+    if (!d)
+        return 3;
+    char *names[64];
+    int n = 0;
+    struct dirent *de;
+    while ((de = readdir(d)) && n < 64)
+        if (de->d_name[0] != '.')
+            names[n++] = strdup(de->d_name);
+    closedir(d);
+    qsort(names, n, sizeof(char *), cmpstr);
+    printf("dirents:");
+    for (int i = 0; i < n; i++)
+        printf(" %s", names[i]);
+    printf("\n");
+
+    /* stat family */
+    struct stat st;
+    if (stat("f1.txt", &st) != 0)
+        return 4;
+    printf("stat size %lld mode %o\n", (long long)st.st_size,
+           st.st_mode & 0777);
+    struct statx sx;
+    if (syscall(SYS_statx, AT_FDCWD, "f1.txt", 0, 0x7ff, &sx) == 0)
+        printf("statx size %llu\n", (unsigned long long)sx.stx_size);
+    else
+        printf("statx unsupported\n");
+
+    /* access / faccessat */
+    printf("access rw %d missing %d\n", access("f1.txt", R_OK | W_OK),
+           access("nope.txt", F_OK));
+    printf("faccessat %d\n", faccessat(AT_FDCWD, "f2.txt", R_OK, 0));
+
+    /* readlink */
+    symlink("f0.txt", "link0");
+    char lbuf[64];
+    ssize_t ln = readlink("link0", lbuf, sizeof(lbuf) - 1);
+    lbuf[ln > 0 ? ln : 0] = '\0';
+    printf("readlink %s\n", lbuf);
+
+    /* sched_getaffinity: exactly one simulated cpu */
+    cpu_set_t cs;
+    CPU_ZERO(&cs);
+    sched_getaffinity(0, sizeof(cs), &cs);
+    printf("cpus %d\n", CPU_COUNT(&cs));
+    printf("nprocs %d\n", get_nprocs());
+
+    /* sysinfo: fixed simulated memory, sim uptime */
+    struct sysinfo si;
+    sysinfo(&si);
+    printf("sysinfo ram %lu procs %d uptime<10 %d\n",
+           (unsigned long)(si.totalram >> 30), si.procs, si.uptime < 10);
+
+    /* prlimit64 roundtrip */
+    struct rlimit rl;
+    getrlimit(RLIMIT_NOFILE, &rl);
+    printf("nofile %llu\n", (unsigned long long)rl.rlim_cur);
+    struct rlimit nrl = {512, rl.rlim_max};
+    printf("setrlim %d\n", setrlimit(RLIMIT_NOFILE, &nrl));
+    getrlimit(RLIMIT_NOFILE, &rl);
+    printf("nofile2 %llu\n", (unsigned long long)rl.rlim_cur);
+
+    /* deterministic /proc views */
+    char buf[4096];
+    FILE *f = fopen("/proc/self/status", "r");
+    if (!f)
+        return 5;
+    while (fgets(buf, sizeof(buf), f))
+        if (strncmp(buf, "Pid:", 4) == 0 || strncmp(buf, "Threads:", 8) == 0)
+            printf("status %s", buf);
+    fclose(f);
+    f = fopen("/proc/meminfo", "r");
+    if (f && fgets(buf, sizeof(buf), f))
+        printf("meminfo %s", buf);
+    if (f)
+        fclose(f);
+    f = fopen("/proc/uptime", "r");
+    if (f && fgets(buf, sizeof(buf), f))
+        printf("uptime-digits %d\n", (int)(strchr(buf, '.') - buf));
+    if (f)
+        fclose(f);
+    f = fopen("/proc/loadavg", "r");
+    if (f && fgets(buf, sizeof(buf), f))
+        printf("loadavg %s", buf);
+    if (f)
+        fclose(f);
+    f = fopen("/proc/sys/net/core/somaxconn", "r");
+    if (f && fgets(buf, sizeof(buf), f))
+        printf("somaxconn %s", buf);
+    if (f)
+        fclose(f);
+
+    /* pid visible to the guest is the virtual pid */
+    printf("pid %d\n", (int)getpid());
+
+    /* times/getrusage derived from sim clock */
+    struct tms tm;
+    long t = (long)times(&tm);
+    printf("times<1000 %d\n", t < 1000);
+    struct rusage ru;
+    getrusage(RUSAGE_SELF, &ru);
+    printf("maxrss %ld\n", ru.ru_maxrss);
+
+    printf("breadth all ok\n");
+    return 0;
+}

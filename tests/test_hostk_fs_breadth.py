@@ -1,0 +1,83 @@
+"""Nginx-grade file/metadata syscall breadth (round-3 verdict Missing #1 /
+Next #3): getdents64, statx, newfstatat, access/faccessat, readlink,
+getcwd/chdir, sched_getaffinity, sysinfo, prlimit64, times/getrusage, and
+the deterministic /proc views (reference checklist:
+src/main/host/syscall_handler.c:301-463 + regular_file.c special files).
+The guest transcript must carry only simulated values (virtual pid, fixed
+topology/memory, sim-relative clocks) and be byte-identical across runs."""
+
+import pathlib
+import subprocess
+
+import pytest
+
+from shadow_tpu.graph import NetworkGraph, compute_routing
+from shadow_tpu.hostk.kernel import NetKernel, ProcessSpec
+from shadow_tpu.simtime import NS_PER_SEC
+
+GUESTS = pathlib.Path(__file__).parent / "guests"
+
+
+@pytest.fixture(scope="module")
+def fs_bin(tmp_path_factory):
+    out = tmp_path_factory.mktemp("fs") / "breadth_fs_guest"
+    subprocess.run(
+        ["cc", "-O2", "-o", str(out), str(GUESTS / "breadth_fs_guest.c")],
+        check=True,
+    )
+    return str(out)
+
+
+def _run(tmp_path, fs_bin, sub):
+    graph = NetworkGraph.from_gml(
+        'graph [\n  node [ id 0 ]\n  edge [ source 0 target 0 latency "1 ms" ]\n]'
+    )
+    tables = compute_routing(graph).with_hosts([0])
+    k = NetKernel(tables, host_names=["box"], host_nodes=[0], data_dir=tmp_path / sub)
+    p = k.add_process(ProcessSpec(host="box", args=[fs_bin]))
+    try:
+        k.run(5 * NS_PER_SEC)
+    finally:
+        k.shutdown()
+    return p
+
+
+def test_fs_breadth_values(tmp_path, fs_bin):
+    p = _run(tmp_path, fs_bin, "a")
+    out = p.stdout().decode()
+    assert p.exit_code == 0, out + p.stderr().decode()
+    assert "breadth all ok" in out
+    assert "chdir ok: 1" in out
+    # sandbox cwd also holds the host's own log files; the created entries
+    # must appear in sorted order
+    assert "f0.txt f1.txt f2.txt subdir" in out
+    assert "stat size 8 mode 644" in out
+    assert "statx size 8" in out
+    assert "access rw 0 missing -1" in out
+    assert "faccessat 0" in out
+    assert "readlink f0.txt" in out
+    # deterministic topology: exactly one simulated CPU
+    assert "cpus 1" in out
+    assert "nprocs 1" in out
+    # fixed simulated memory (16 GB), 1 proc, sim-relative uptime
+    assert "sysinfo ram 16 procs 1 uptime<10 1" in out
+    # prlimit64 roundtrip through the deterministic rlimit table
+    assert "setrlim 0" in out
+    assert "nofile2 512" in out
+    # /proc views carry the virtual pid and fixed values
+    assert "status Pid:\t1000" in out
+    assert "status Threads:\t1" in out
+    # one simulated machine: meminfo MemTotal == sysinfo totalram (16 GB)
+    assert "meminfo MemTotal:       16777216 kB" in out
+    assert "loadavg 0.00 0.00 0.00 1/1 1000" in out
+    assert "somaxconn 4096" in out
+    assert "pid 1000" in out
+    assert "times<1000 1" in out
+    assert "maxrss 4096" in out
+
+
+def test_fs_breadth_deterministic(tmp_path, fs_bin):
+    a = _run(tmp_path, fs_bin, "r1")
+    b = _run(tmp_path, fs_bin, "r2")
+    assert a.stdout() == b.stdout()
+    assert [s for _, s, _ in a.syscall_log] == [s for _, s, _ in b.syscall_log]

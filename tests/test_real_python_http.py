@@ -1,0 +1,83 @@
+"""Stock CPython as an in-sim server: /usr/bin/python3 -m http.server
+serving distro curl over the simulated network — the nginx-grade
+acceptance workload for syscall breadth (round-3 verdict Next #3;
+reference flagship example: examples/http-server nginx+curl,
+src/test/examples/). CPython's startup walks the interpreter tree with
+getdents64/newfstatat/statx, readlink, getcwd; the server loop runs
+selectors (poll/epoll) over a listening socket; the resolver uses the
+simulated DNS via the hostent family. Run-twice determinism covers the
+whole transcript."""
+
+import json
+import os
+import pathlib
+
+import pytest
+
+from shadow_tpu.runtime.cli_run import run_from_config
+
+PY = "/usr/bin/python3"
+CURL = "/usr/bin/curl"
+
+pytestmark = pytest.mark.skipif(
+    not (os.access(PY, os.X_OK) and os.access(CURL, os.X_OK)),
+    reason="system python3/curl missing",
+)
+
+CONFIG = """
+general:
+  stop_time: 10 s
+  seed: 1
+  data_directory: {data_dir}
+network:
+  graph:
+    type: 1_gbit_switch
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+      - path: {py}
+        args: ["-u", "-m", "http.server", "80", "--bind", "0.0.0.0"]
+        expected_final_state: running
+  client:
+    network_node_id: 0
+    processes:
+      - path: {curl}
+        args: ["-sS", "--max-time", "5", "-o", "page.html", "http://server/"]
+        start_time: 3 s
+"""
+
+
+def _run(tmp_path, sub):
+    d = tmp_path / sub
+    d.mkdir(parents=True)
+    cfg = d / "shadow.yaml"
+    cfg.write_text(CONFIG.format(data_dir=d / "data", py=PY, curl=CURL))
+    rc = run_from_config(str(cfg))
+    return rc, d / "data"
+
+
+def test_python_http_server_serves_curl(tmp_path):
+    rc, data = _run(tmp_path, "a")
+    assert rc == 0
+    page = (data / "client" / "page.html").read_text()
+    assert "Directory listing" in page
+    stdout = next((data / "server").glob("python3.*.stdout")).read_text()
+    assert "Serving HTTP on 11.0.0.1 port 80" in stdout
+    # the GET is logged (to stderr) at *simulated* time by the stock logger
+    stderr = next((data / "server").glob("python3.*.stderr")).read_text()
+    assert '[01/Jan/2000 00:00:03] "GET / HTTP/1.1" 200' in stderr
+    stats = json.loads((data / "sim-stats.json").read_text())
+    assert sum(stats["syscall_counts"].values()) > 10_000  # real startup ran
+
+
+def test_python_http_server_deterministic(tmp_path):
+    outs = []
+    for sub in ("r1", "r2"):
+        rc, data = _run(tmp_path, sub)
+        assert rc == 0
+        page = (data / "client" / "page.html").read_bytes()
+        stdout = next((data / "server").glob("python3.*.stdout")).read_bytes()
+        stderr = next((data / "server").glob("python3.*.stderr")).read_bytes()
+        outs.append((page, stdout, stderr))
+    assert outs[0] == outs[1]

@@ -48,6 +48,7 @@ def main():
     we = jnp.asarray(40_000_000, jnp.int64)
 
     # run a few real rounds first so queues hold a realistic backlog
+    print("compiling warm round...", flush=True)
     warm = jax.jit(lambda s: run_round(s, we, model, tables, cfg))
     st = warm(st0)
     jax.block_until_ready(st.events_handled)
@@ -56,21 +57,25 @@ def main():
 
     it_full = jax.jit(lambda s: handle_one_iteration(s, we, model, tables, cfg))
     results["iter_full_ms"] = round(bench_fn(it_full, st, reps=reps) * 1e3, 3)
+    print("iter_full_ms", results["iter_full_ms"], flush=True)
 
     for lanes in (1024, 128):
         itc = jax.jit(
             lambda s, L=lanes: handle_one_iteration_compact(s, we, model, tables, cfg, L)
         )
         results[f"iter_compact{lanes}_ms"] = round(bench_fn(itc, st, reps=reps) * 1e3, 3)
+        print(f"iter_compact{lanes}_ms", results[f"iter_compact{lanes}_ms"], flush=True)
 
     fl = jax.jit(lambda s: flush_outbox(s, None, cfg))
     results["flush_ms"] = round(bench_fn(fl, st, reps=reps) * 1e3, 3)
+    print("flush_ms", results["flush_ms"], flush=True)
 
     # isolated: queue pop only
     from shadow_tpu import equeue
 
     pop = jax.jit(lambda s: equeue.pop_min(s.queue, equeue.next_time(s.queue) < we)[1].count)
     results["pop_only_ms"] = round(bench_fn(pop, st, reps=reps) * 1e3, 3)
+    print("pop_only_ms", results["pop_only_ms"], flush=True)
 
     # model handler only (with a fake popped event)
     def handler_only(s):
@@ -83,6 +88,7 @@ def main():
 
     h = jax.jit(handler_only)
     results["pop_plus_handler_ms"] = round(bench_fn(h, st, reps=reps) * 1e3, 3)
+    print("pop_plus_handler_ms", results["pop_plus_handler_ms"], flush=True)
 
     # one full round (many iterations) for iteration-count estimation
     t0 = time.perf_counter()
