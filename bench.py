@@ -101,6 +101,10 @@ def _build(num_hosts: int, seed: int = 7):
         runahead_ns=graph.min_latency_ns(),
         seed=seed,
         use_netstack=True,
+        # pairwise traffic (one server per client stream): per-host fan-in
+        # per round is small, so a narrow delivery grid keeps the exchange
+        # sorts at traffic scale (overflow is loud if this ever binds)
+        deliver_lanes=64,
         # Bound each round's pop-iteration loop so no single device call
         # can run unboundedly long (shaping backlogs concentrate events on
         # single hosts; an over-long XLA execution kills the TPU tunnel

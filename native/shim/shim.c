@@ -282,9 +282,50 @@ struct RawThreadSlot {
     ShimShmem *shm;
     int64_t vtid;
     int detached;
+    int *ctid;        /* guest's CLONE_CHILD_CLEARTID word (NULL none) */
 };
 static struct RawThreadSlot g_raw_threads[RAW_THREADS_MAX];
 static int g_raw_threads_live = 0;
+
+/* virtual->real tid map for every live thread of this process (both the
+ * pthread tier and raw-clone adoptees). Cross-thread tgkill — the Go
+ * runtime's async-preemption IPI (SIGURG) — resolves the target's real
+ * tid here and delivers natively, like the reference interrupting
+ * managed threads with real host signals. */
+#define TID_MAP_MAX 256
+struct TidMapEnt {
+    int64_t vtid; /* 0 = free */
+    int rtid;
+};
+static struct TidMapEnt g_tid_map[TID_MAP_MAX];
+
+static void tid_map_add(int64_t vtid, int rtid) {
+    if (!vtid)
+        return;
+    for (int i = 0; i < TID_MAP_MAX; i++) {
+        int64_t zero = 0;
+        if (__atomic_compare_exchange_n(&g_tid_map[i].vtid, &zero, vtid, 0,
+                                        __ATOMIC_ACQ_REL, __ATOMIC_RELAXED)) {
+            g_tid_map[i].rtid = rtid;
+            return;
+        }
+    }
+}
+
+static void tid_map_del(int64_t vtid) {
+    for (int i = 0; i < TID_MAP_MAX; i++)
+        if (__atomic_load_n(&g_tid_map[i].vtid, __ATOMIC_ACQUIRE) == vtid) {
+            __atomic_store_n(&g_tid_map[i].vtid, 0, __ATOMIC_RELEASE);
+            return;
+        }
+}
+
+static int tid_map_find(int64_t vtid) {
+    for (int i = 0; i < TID_MAP_MAX; i++)
+        if (__atomic_load_n(&g_tid_map[i].vtid, __ATOMIC_ACQUIRE) == vtid)
+            return g_tid_map[i].rtid;
+    return 0;
+}
 
 static struct RawThreadSlot *raw_slot_self(void) {
     if (!__atomic_load_n(&g_raw_threads_live, __ATOMIC_ACQUIRE))
@@ -699,6 +740,7 @@ static void *thread_trampoline(void *p) {
     t_shm = (ShimShmem *)m;
     register_shm_map(m);
     t_tid = tb.tid;
+    tid_map_add(tb.tid, (int)shim_raw_syscall(SYS_gettid, 0, 0, 0, 0, 0, 0));
     /* announce on our own channel and park until scheduled */
     ShimMsg msg;
     memset(&msg, 0, offsetof(ShimMsg, buf));
@@ -708,6 +750,7 @@ static void *thread_trampoline(void *p) {
     shim_channel_send(&t_shm->to_shadow, &msg);
     shim_channel_recv(&t_shm->to_shim, &msg, -1);
     void *ret = tb.fn(tb.arg);
+    tid_map_del(tb.tid);
     vsys(VSYS_THREAD_EXIT, (int64_t)(intptr_t)ret, 0, 0, NULL, 0, NULL);
     t_native_futex_ok = 1; /* glibc thread-death cleanup runs native */
     t_detached_from_sim = 1; /* the kernel dropped this channel */
@@ -738,6 +781,8 @@ static void *thread_trampoline(void *p) {
 typedef struct RawCloneBoot {
     char path[256];   /* the thread's shm channel */
     long tid;         /* virtual tid */
+    int *ctid;        /* CLONE_CHILD_CLEARTID/SETTID word (NULL none) */
+    int set_ctid;     /* CLONE_CHILD_SETTID requested */
     int has_fp;
     char fp[512] __attribute__((aligned(16))); /* fxsave image at trap */
     /* guest register image: [0]=rip [1]=rsp(newsp) [2]=rbx [3]=rbp
@@ -770,6 +815,12 @@ void shim_raw_clone_child(RawCloneBoot *boot) {
     slot->shm = (ShimShmem *)m;
     slot->vtid = boot->tid;
     slot->detached = 0;
+    slot->ctid = boot->ctid;
+    tid_map_add(boot->tid, rt);
+    /* CLONE_CHILD_SETTID: the kernel wrote the REAL tid into the guest's
+     * word; overwrite with the virtual tid the guest's world speaks */
+    if (boot->set_ctid && boot->ctid)
+        __atomic_store_n(boot->ctid, (int)boot->tid, __ATOMIC_SEQ_CST);
     __atomic_add_fetch(&g_raw_threads_live, 1, __ATOMIC_RELEASE);
     register_shm_map(m);
     /* the clone inherited the SIGSYS-blocked mask of the parent's signal
@@ -868,6 +919,10 @@ static long raw_thread_clone(unsigned long flags, void *newsp, int *ptid,
     memcpy(boot->path, reply.buf, reply.buf_len);
     boot->path[reply.buf_len] = 0;
     boot->tid = vtid;
+    boot->ctid = (flags & (CLONE_CHILD_CLEARTID | CLONE_CHILD_SETTID))
+                     ? ctid
+                     : NULL;
+    boot->set_ctid = !!(flags & CLONE_CHILD_SETTID);
     boot->has_fp = 0;
     if (uc->uc_mcontext.fpregs) {
         memcpy(boot->fp, uc->uc_mcontext.fpregs, sizeof(boot->fp));
@@ -1048,6 +1103,7 @@ pid_t fork(void) {
         t_shm = NULL;
         t_tid = 0;
         t_native_clone_ok = 0;
+        memset(g_tid_map, 0, sizeof(g_tid_map));
         g_ppid = g_vpid;
         g_vpid = child_vpid;
         g_thread_count = 0;
@@ -3331,19 +3387,27 @@ long shim_route_syscall(long nr, long a1, long a2, long a3, long a4, long a5,
         return KR(waitpid((pid_t)a1, (int *)a2, (int)a3));
     case SYS_tgkill:
     case SYS_tkill: {
-        /* raw self-signal (glibc raise, runtimes): deliver only when the
-         * named tid is the *calling* thread's virtual id; cross-thread
-         * raw signaling is not modeled and fails honestly */
+        /* raw thread-directed signal, virtual tid namespace. Self-signals
+         * (glibc raise) deliver to self; cross-thread signals — the Go
+         * runtime's async-preemption IPI (SIGURG) — resolve the target's
+         * real tid via the live-thread map and deliver natively, like the
+         * reference interrupting managed threads with real host signals */
         long sig = nr == SYS_tgkill ? a3 : a2;
         long tid = nr == SYS_tgkill ? a2 : a1;
         long my_vtid = cur_vtid() ? cur_vtid() : g_vpid;
         if (tid <= 0)
             return -22; /* EINVAL */
+        long rpid = shim_raw_syscall(SYS_getpid, 0L, 0L, 0L, 0L, 0L, 0L);
         if (tid == my_vtid) {
-            long rpid = shim_raw_syscall(SYS_getpid, 0L, 0L, 0L, 0L, 0L, 0L);
             long rtid = shim_raw_syscall(SYS_gettid, 0L, 0L, 0L, 0L, 0L, 0L);
             return shim_raw_syscall(SYS_tgkill, rpid, rtid, sig, 0L, 0L, 0L);
         }
+        if (tid == g_vpid) /* main thread's vtid is the vpid */
+            return shim_raw_syscall(SYS_tgkill, rpid, rpid, sig, 0L, 0L, 0L);
+        int rt = tid_map_find(tid);
+        if (rt)
+            return shim_raw_syscall(SYS_tgkill, rpid, (long)rt, sig, 0L, 0L,
+                                    0L);
         return -3; /* ESRCH */
     }
     case SYS_uname:
@@ -3472,6 +3536,19 @@ long shim_route_syscall(long nr, long a1, long a2, long a3, long a4, long a5,
         /* a single thread exiting (raw-clone threads end here; glibc
          * pthread workers arrive already detached and take the raw
          * path via the top-of-function check) */
+        struct RawThreadSlot *slot0 = raw_slot_self();
+        if (slot0 && slot0->ctid) {
+            /* CLONE_CHILD_CLEARTID with SIMULATED visibility: clear the
+             * guest's tid word and wake its simulated futex before the
+             * exit notification, so a ctid-join (the Go runtime's thread
+             * join) observes the death deterministically. The real
+             * kernel's own clear+wake at real exit is redundant but
+             * harmless (same value, real futex nobody waits on). */
+            __atomic_store_n(slot0->ctid, 0, __ATOMIC_SEQ_CST);
+            vsys(VSYS_FUTEX_WAKE, (int64_t)(intptr_t)slot0->ctid,
+                 (int64_t)0x7fffffff, 0, NULL, 0, NULL);
+        }
+        tid_map_del(cur_vtid());
         vsys(VSYS_THREAD_EXIT, a1, 0, 0, NULL, 0, NULL);
         struct RawThreadSlot *slot = raw_slot_self();
         if (slot) {

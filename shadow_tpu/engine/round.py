@@ -421,7 +421,10 @@ def flush_outbox(
             fits = valid_s & (rank < cap)
             sdst = jnp.where(fits, sh_s, d)
             sslot = jnp.where(fits, rank, cap)
-            overflow_extra = jnp.sum(valid_s & ~fits).astype(jnp.int32)
+            a2a_over = jnp.sum(valid_s & ~fits).astype(jnp.int32)
+            overflow_extra = (
+                a2a_over if overflow_extra is None else overflow_extra + a2a_over
+            )
 
             def bucketize(x, fill):
                 buf = jnp.full((d, cap) + x.shape[1:], fill, x.dtype)
@@ -455,8 +458,10 @@ def flush_outbox(
 
     local_dst = dst - base
     mine = valid & (local_dst >= 0) & (local_dst < h_local)
-    queue = equeue.push_many(
-        st.queue,
+    lanes = getattr(cfg, "deliver_lanes", 0) if cfg is not None else 0
+    queue = equeue.push_many_sorted(
+        deliver_lanes=lanes if lanes > 0 else st.queue.capacity,
+        q=st.queue,
         dst=local_dst,
         valid=mine,
         time=time,
@@ -593,7 +598,10 @@ def check_capacity(st: SimState) -> None:
     if dropped:
         raise CapacityError(
             f"event capacity exhausted: {dropped} events/packets dropped "
-            f"(queue.overflow/outbox.overflow); increase queue_capacity/outbox_capacity"
+            f"(queue.overflow/outbox.overflow); increase queue_capacity/"
+            f"outbox_capacity — or, for sharded all_to_all runs with "
+            f"pair-skewed destinations, set a2a_capacity=-1 (whole-outbox "
+            f"buckets, never overflow)"
         )
 
 

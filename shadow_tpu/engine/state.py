@@ -50,10 +50,30 @@ class EngineConfig:
     # shard and exchanges only each peer's bucket over ICI; "all_gather"
     # replicates every shard's whole outbox (more traffic, never overflows).
     exchange: str = "all_to_all"
-    # per-peer bucket capacity for all_to_all; 0 = the whole local outbox
-    # (never overflows; set lower to cut ICI traffic when destinations are
-    # known to spread across shards)
-    a2a_capacity: int = 0
+    # per-peer bucket capacity for all_to_all:
+    #  -1  (default) = the whole local outbox: never overflows. PDES
+    #        traffic is often pair-skewed (client i -> server i+H/2 lands
+    #        a shard's entire outbox on one peer), so the safe bucket is
+    #        the default;
+    #   0  = auto under ShardedRunner (topology-derived, ~4x
+    #        local/devices, auto_a2a_capacity): cuts ICI traffic when
+    #        destinations spread across the mesh; skew beyond the safety
+    #        factor fails loudly via check_capacity. Direct flush_outbox
+    #        callers treat 0 like -1;
+    #  >0  = explicit bucket size.
+    a2a_capacity: int = -1
+    # Round-boundary delivery grid width: the exchange routes packets into
+    # a dest-major [H, deliver_lanes] grid via three multi-operand sorts
+    # (equeue.push_many_sorted) and merges it densely — zero scatters.
+    # XLA TPU scatter serializes per index (~125 ms/round at bench scale,
+    # the dominant engine cost, tools/profile_flush.py) while full-payload
+    # sorts of the same entries are ~4 ms (tools/profile_prims.py).
+    # Bounds deliveries per host per ROUND; beyond it overflows loudly
+    # via check_capacity. 0 (default) = queue_capacity: exact — a
+    # delivery wave the queue could hold can never be grid-bounded.
+    # Large worlds with bounded fan-in (e.g. the pairwise bench) set a
+    # small width so the grid sort stays at traffic scale.
+    deliver_lanes: int = 0
     # Active-set compaction (engine/round.py handle_one_iteration_compact):
     # per pop-iteration, gather only the <= active_lanes hosts that actually
     # have an eligible event into a compact sub-state, run the handler

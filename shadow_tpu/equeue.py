@@ -100,59 +100,47 @@ def pop_min(q: EventQueue, want: jax.Array) -> tuple[Popped, EventQueue]:
     """Pop each host's minimum event where `want[h]` and the host is non-empty.
 
     Ordering follows the reference's total order: min by time, ties broken by
-    the packed (variant, src_host, seq) key (event.rs:104-155). The freed slot
-    is back-filled from slot count-1 to keep rows compact.
+    the packed (variant, src_host, seq) key (event.rs:104-155). The freed
+    slot becomes a tombstone (time=TIME_MAX): rows are NOT kept compact —
+    pushes fill free slots by rank over the free mask — so a pop only
+    rewrites the two key arrays instead of back-filling all five
+    (data alone is [H, Q, 8] i32, the single biggest traffic term of the
+    per-iteration cost at bench scale).
     """
     tmin = q.head_time  # [H]
     at_min = q.time == tmin[:, None]
     tie_masked = jnp.where(at_min, q.tie, _I64_MAX)
     slot = jnp.argmin(tie_masked, axis=1)  # [H]
-
     valid = want & (q.count > 0)
 
-    # One-hot masked reductions and where-passes throughout, NOT
-    # gather/scatter HLOs: on TPU the mask/select/sum chains over all five
-    # slot arrays fuse into a couple of passes, while every gather/scatter
-    # is an unfusable fixed-cost dispatch (measured ~0.4-1.8 ms each at any
-    # size — they dominated the round engine before this form).
-    slot_idx = jnp.arange(q.capacity)[None, :]
-    sel = slot_idx == slot[:, None]  # [H, Q] exactly-one-hot
-    last = jnp.maximum(q.count - 1, 0)
-    lastm = slot_idx == last[:, None]
+    # Payload reads are per-row GATHERS (one index per host): ~10k-index
+    # gathers cost well under a millisecond on TPU, while the previous
+    # one-hot masked reductions re-read every [H, Q(, 8)] payload array in
+    # full — the single biggest per-iteration traffic term at bench scale
+    # (tools/profile_prims.py: per-index cost is what matters, and it only
+    # bites at exchange scale, not at H).
+    sl1 = slot[:, None]
 
-    def pick(arr, mask):
+    def pick(arr):
         if arr.ndim == 3:
-            return jnp.sum(jnp.where(mask[:, :, None], arr, 0), axis=1).astype(arr.dtype)
-        return jnp.sum(jnp.where(mask, arr, 0), axis=1).astype(arr.dtype)
+            return jnp.take_along_axis(arr, sl1[:, :, None], axis=1)[:, 0]
+        return jnp.take_along_axis(arr, sl1, axis=1)[:, 0]
 
     ev = Popped(
         valid=valid,
-        time=pick(q.time, sel),
-        tie=pick(q.tie, sel),
-        kind=pick(q.kind, sel),
-        data=pick(q.data, sel),
-        aux=pick(q.aux, sel),
+        time=tmin,  # the selected slot's time IS the cached row minimum
+        tie=pick(q.tie),
+        kind=pick(q.kind),
+        data=pick(q.data),
+        aux=pick(q.aux),
     )
 
-    # Back-fill the popped slot with the last valid slot, then clear the last.
-    take_last = sel & valid[:, None]
-    clear = lastm & valid[:, None]
-
-    def fill(arr, empty_val):
-        from_last = pick(arr, lastm)
-        if arr.ndim == 3:
-            out = jnp.where(take_last[:, :, None], from_last[:, None, :], arr)
-            return jnp.where(clear[:, :, None], empty_val, out)
-        out = jnp.where(take_last, from_last[:, None], arr)
-        return jnp.where(clear, empty_val, out)
-
-    new_time = fill(q.time, TIME_MAX)
+    slot_idx = jnp.arange(q.capacity)[None, :]
+    clear = (slot_idx == sl1) & valid[:, None]
+    new_time = jnp.where(clear, TIME_MAX, q.time)
     return ev, q.replace(
         time=new_time,
-        tie=fill(q.tie, _I64_MAX),
-        kind=fill(q.kind, KIND_INVALID),
-        data=fill(q.data, 0),
-        aux=fill(q.aux, 0),
+        tie=jnp.where(clear, _I64_MAX, q.tie),
         count=q.count - valid.astype(jnp.int32),
         head_time=jnp.min(new_time, axis=1),
     )
@@ -170,13 +158,15 @@ def push_self(
     """Each host pushes at most one event into its *own* queue (conflict-free).
 
     One-hot where writes (fusable on TPU), not scatters; see pop_min.
+    Targets the first free (tombstoned) slot of each row.
     """
     if aux is None:
         aux = jnp.zeros_like(kind)
-    slot_idx = jnp.arange(q.capacity)[None, :]
+    free = q.time == TIME_MAX  # [H, Q]
     has_room = q.count < q.capacity
     write = valid & has_room
-    at = (slot_idx == q.count[:, None]) & write[:, None]
+    fr = jnp.cumsum(free, axis=1) - free  # rank among free slots
+    at = free & (fr == 0) & write[:, None]
     return q.replace(
         time=jnp.where(at, time[:, None], q.time),
         tie=jnp.where(at, tie[:, None], q.tie),
@@ -201,20 +191,21 @@ def push_self_lanes(
     """Each host pushes up to L events into its *own* queue, in lane order —
     semantically identical to L sequential push_self calls, but the slot
     writes collapse into one fused where-chain per array (one pass on TPU
-    instead of L)."""
+    instead of L). Lane l lands in the row's l-th free (tombstoned) slot."""
     if valid.shape[1] == 0:
         return q  # no lanes: the sequential-push contract is a no-op
     if aux is None:
         aux = jnp.zeros_like(kind)
-    slot_idx = jnp.arange(q.capacity)[None, :]
+    free = q.time == TIME_MAX  # [H, Q]
+    fr = jnp.cumsum(free, axis=1) - free  # rank among free slots
     ranks = jnp.cumsum(valid.astype(jnp.int32), axis=1) - valid.astype(jnp.int32)
-    cols = q.count[:, None] + ranks  # [H, L]
-    write = valid & (cols < q.capacity)
+    room = q.capacity - q.count  # [H] free-slot count
+    write = valid & (ranks < room[:, None])
 
     new_time, new_tie = q.time, q.tie
     new_kind, new_data, new_aux = q.kind, q.data, q.aux
     for l in range(valid.shape[1]):
-        at = (slot_idx == cols[:, l][:, None]) & write[:, l][:, None]
+        at = free & (fr == ranks[:, l][:, None]) & write[:, l][:, None]
         new_time = jnp.where(at, time[:, l][:, None], new_time)
         new_tie = jnp.where(at, tie[:, l][:, None], new_tie)
         new_kind = jnp.where(at, kind[:, l][:, None], new_kind)
@@ -248,44 +239,156 @@ def push_many(
 
     This is the round-boundary exchange step (the analogue of
     Worker::push_packet_to_host, reference src/main/core/worker.rs:619-629,
-    minus the mutex): sort entries by destination, rank within each
-    destination segment, and scatter into each destination's free slots.
+    minus the mutex). Delegates to the all-sort implementation with a
+    full-capacity delivery grid (exact, never grid-bounded)."""
+    return push_many_sorted(
+        q, dst, valid, time, tie, kind, data, aux,
+        deliver_lanes=q.capacity,
+    )
+
+
+def push_many_sorted(
+    q: EventQueue,
+    dst: jax.Array,  # [M] i32 destination host ids
+    valid: jax.Array,  # [M] bool
+    time: jax.Array,  # [M] i64
+    tie: jax.Array,  # [M] i64
+    kind: jax.Array,  # [M] i32
+    data: jax.Array,  # [M, PAYLOAD_LANES] i32
+    aux: "jax.Array | None" = None,  # [M] i32
+    deliver_lanes: int = 48,
+) -> EventQueue:
+    """push_many built entirely on multi-operand sorts — zero scatters,
+    zero large gathers.
+
+    XLA TPU scatter/gather serialize per index (~40-130 ns each; the five
+    scatters of the plain push_many cost ~125 ms per round at bench
+    scale), while a full-payload lax.sort of the same entries is ~4 ms
+    (tools/profile_prims.py). So the exchange becomes:
+
+      S1  stable sort of everything by destination (invalids last) —
+          per-destination ranks fall out of a dense segment cummax;
+      S2  stable sort by final grid slot: real entry i -> dst*D + rank
+          (D = deliver_lanes), invalid entries -> the ascending
+          enumeration of unfilled grid slots (computed densely; aligned
+          to the invalid positions by one dynamic_slice) — the first H*D
+          sorted entries ARE the dest-major delivery grid [H, D];
+      S3  a light (key, slot) sort that enumerates the unfilled slots.
+
+    The grid merges into the queue rows with the push_self_lanes dense
+    one-hot pattern (per-host append, fused selects). Per-host deliveries
+    beyond D or queue capacity are counted loudly in overflow. Slot
+    order within a destination equals arrival order of the stable sort —
+    the same order plain push_many produced; pop order is key-driven
+    anyway.
     """
     if aux is None:
         aux = jnp.zeros_like(kind)
     m = dst.shape[0]
-    num_hosts = q.num_hosts
-    pos = jnp.arange(m)
+    h = q.num_hosts
+    d = deliver_lanes
+    grid = h * d
+    big = jnp.int32(1 << 30)
 
-    # Invalid entries sort to a sentinel destination past all hosts and are
-    # dropped by out-of-bounds scatter semantics.
-    key = jnp.where(valid, dst, num_hosts).astype(jnp.int32)
-    order = jnp.argsort(key, stable=True)
-    key_s = key[order]
-    valid_s = valid[order]
+    # pad so every grid slot can receive a filler entry (empty payload)
+    mp = max(m, grid)
+    if mp > m:
+        pad = mp - m
 
-    seg_start = jnp.concatenate([jnp.ones((1,), bool), key_s[1:] != key_s[:-1]])
-    start_pos = jax.lax.cummax(jnp.where(seg_start, pos, -1))
-    rank = pos - start_pos  # index within this destination's batch
+        def padded(x, fill):
+            cst = jnp.full((pad,) + x.shape[1:], fill, x.dtype)
+            return jnp.concatenate([x, cst])
 
-    slot = q.count[jnp.minimum(key_s, num_hosts - 1)] + rank.astype(jnp.int32)
-    fits = valid_s & (slot < q.capacity)
-    # Route dropped/invalid entries fully out of bounds so scatter drops them.
-    sdst = jnp.where(fits, key_s, num_hosts)
-    sslot = jnp.where(fits, slot, q.capacity)
+        dst = padded(dst, 0)
+        valid = padded(valid, False)
+        time = padded(time, TIME_MAX)
+        tie = padded(tie, _I64_MAX)
+        kind = padded(kind, KIND_INVALID)
+        data = padded(data, 0)
+        aux = padded(aux, 0)
 
-    return q.replace(
-        time=q.time.at[sdst, sslot].set(time[order], mode="drop"),
-        tie=q.tie.at[sdst, sslot].set(tie[order], mode="drop"),
-        kind=q.kind.at[sdst, sslot].set(kind[order], mode="drop"),
-        data=q.data.at[sdst, sslot].set(data[order], mode="drop"),
-        aux=q.aux.at[sdst, sslot].set(aux[order], mode="drop"),
-        count=q.count.at[sdst].add(fits.astype(jnp.int32), mode="drop"),
-        overflow=q.overflow.at[jnp.where(valid_s & ~fits, key_s, num_hosts)].add(
-            (valid_s & ~fits).astype(jnp.int32), mode="drop"
-        ),
-        head_time=q.head_time.at[sdst].min(time[order], mode="drop"),
+    # S1: group by destination (stable; invalids/pad sort last)
+    key1 = jnp.where(valid, dst, h).astype(jnp.int32)
+    key1_s, time_s, tie_s, kind_s, aux_s, valid_s, *data_cols = jax.lax.sort(
+        (key1, time, tie, kind, aux, valid)
+        + tuple(data[:, i] for i in range(data.shape[1])),
+        num_keys=1,
+        is_stable=True,
     )
+    pos = jnp.arange(mp, dtype=jnp.int32)
+    seg_start = jnp.concatenate(
+        [jnp.ones((1,), bool), key1_s[1:] != key1_s[:-1]]
+    )
+    rank = pos - jax.lax.cummax(jnp.where(seg_start, pos, -1))
+    real = valid_s
+    n_valid = jnp.sum(real.astype(jnp.int32))
+
+    # per-destination delivery counts (for the unfilled-slot enumeration);
+    # one searchsorted over [0..H] gives every segment boundary (stop of
+    # host h == start of host h+1)
+    hosts = jnp.arange(h + 1, dtype=jnp.int32)
+    bounds = jnp.searchsorted(key1_s, hosts, side="left", method="sort")
+    cnt = jnp.minimum((bounds[1:] - bounds[:-1]).astype(jnp.int32), d)  # [H]
+
+    # S3: ascending enumeration of unfilled grid slots
+    lane_r = jnp.arange(d, dtype=jnp.int32)[None, :]
+    unfilled = (lane_r >= cnt[:, None]).reshape(grid)
+    filler_key = jnp.where(
+        unfilled, jnp.cumsum(unfilled.astype(jnp.int32)) - 1, big
+    )
+    _, fill_pos = jax.lax.sort(
+        (filler_key, jnp.arange(grid, dtype=jnp.int32)), num_keys=1,
+        is_stable=True,
+    )
+    # positions past the unfilled count hold FILLED slots (their filler_key
+    # was the sentinel); a leftover invalid entry picking one up would
+    # collide with the real entry targeting that slot and shift the whole
+    # grid — replace them with unique beyond-grid keys
+    n_unfilled = jnp.sum(unfilled.astype(jnp.int32))
+    gpos = jnp.arange(grid, dtype=jnp.int32)
+    fill_pos = jnp.where(gpos < n_unfilled, fill_pos, big + gpos)
+    # align filler slots with the invalid positions (which are contiguous
+    # from n_valid): key2[p] for an invalid at position p must read
+    # fill_pos[p - n_valid] — one dynamic_slice, no gather
+    fill_pad = jnp.concatenate(
+        [jnp.zeros((mp,), jnp.int32), fill_pos,
+         big + jnp.arange(mp, dtype=jnp.int32)]
+    )
+    fill_for_pos = jax.lax.dynamic_slice(fill_pad, (mp - n_valid,), (mp,))
+
+    fits = real & (rank < d)
+    target = key1_s * d + rank
+    key2 = jnp.where(
+        fits, target, jnp.where(real, big + pos, fill_for_pos)
+    ).astype(jnp.int32)
+
+    # S2: place into grid order; the first H*D entries are the grid
+    _, time_g, tie_g, kind_g, aux_g, used_g, *data_g = jax.lax.sort(
+        (key2, time_s, tie_s, kind_s, aux_s, fits)
+        + tuple(data_cols),
+        num_keys=1,
+        is_stable=True,
+    )
+
+    def to_grid(x):
+        return x[:grid].reshape(h, d)
+
+    g_valid = to_grid(used_g)
+    g_time = to_grid(time_g)
+    g_tie = to_grid(tie_g)
+    g_kind = to_grid(kind_g)
+    g_aux = to_grid(aux_g)
+    g_data = jnp.stack([to_grid(c) for c in data_g], axis=-1)
+
+    overflow_extra = n_valid - jnp.sum(g_valid.astype(jnp.int32))
+
+    q2 = push_self_lanes(
+        q, valid=g_valid, time=g_time, tie=g_tie, kind=g_kind,
+        data=g_data, aux=g_aux,
+    )
+    # per-destination overflow beyond deliver_lanes is counted globally
+    # (loud via check_capacity), not per host
+    return q2.replace(overflow=q2.overflow.at[0].add(overflow_extra))
 
 
 def debug_sorted_events(q: EventQueue, host: int):
@@ -295,8 +398,10 @@ def debug_sorted_events(q: EventQueue, host: int):
     kind = jax.device_get(q.kind[host])
     data = jax.device_get(q.data[host])
     n = int(q.count[host])
+    # live slots are those without a tombstone (stale kind/data may remain
+    # in popped slots; time is the occupancy marker)
     items = sorted(
-        ((int(time[i]), int(tie[i]), int(kind[i]), tuple(int(x) for x in data[i])) for i in range(q.capacity) if kind[i] != KIND_INVALID),
+        ((int(time[i]), int(tie[i]), int(kind[i]), tuple(int(x) for x in data[i])) for i in range(q.capacity) if time[i] != TIME_MAX),
     )
     assert len(items) == n, (len(items), n)
     return items

@@ -106,28 +106,27 @@ class BulkTcpModel:
         # client connect: open, queue all bytes, half-close — the TCP output
         # pass in the same invocation emits the SYN
         m_conn = ev.valid & (ev.kind == KIND_CONNECT) & is_client
-        ts = tcp.connect(
-            ts,
-            m_conn,
-            slot0,
-            jnp.full((h,), self.client_port, jnp.int32),
-            (host_id + self.num_pairs).astype(jnp.int32),
-            jnp.full((h,), self.port, jnp.int32),
-            p,
+        app = tcp.AppOpen(
+            mask=m_conn,
+            slot=slot0,
+            lport=jnp.full((h,), self.client_port, jnp.int32),
+            rhost=(host_id + self.num_pairs).astype(jnp.int32),
+            rport=jnp.full((h,), self.port, jnp.int32),
+            write_bytes=jnp.full((h,), self.total_bytes, jnp.int64),
+            close=jnp.ones((h,), bool),
         )
-        ts = tcp.app_write(ts, m_conn, slot0, jnp.int64(self.total_bytes))
-        ts = tcp.app_close(ts, m_conn, slot0)
 
         is_tcp_packet = ev.valid & (ev.kind == KIND_PACKET)
-        ts, emits, sig = tcp.tcp_handle(
-            ts, ev, host_id, p, is_tcp_packet, app_slot=slot0, app_mask=m_conn
+        slot, touched, v, emits, sig, _dopen = tcp.tcp_handle(
+            ts, ev, host_id, p, is_tcp_packet, app=app
         )
 
         # server echo-close on EOF: close, then force an output pass via a
         # same-time flush event so the FIN actually goes out
         m_eof = sig.fin_seen & is_server
         eof_slot = jnp.where(sig.slot >= 0, sig.slot, 0).astype(jnp.int32)
-        ts = tcp.app_close(ts, m_eof, eof_slot)
+        v = tcp.view_close(v, m_eof)
+        ts = tcp.commit_slot(ts, slot, touched, v)
 
         el = self.LOCAL_EMITS
         l_valid = jnp.zeros((h, el), bool)

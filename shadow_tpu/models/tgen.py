@@ -138,19 +138,22 @@ class TgenModel:
             self.num_clients
             + (host_id.astype(jnp.int64) + state.streams_started) % self.num_servers
         ).astype(jnp.int32)
-        ts = tcp.connect(
-            ts, can, cslot, lport, server, jnp.full((h,), self.port, jnp.int32), p
+        app = tcp.AppOpen(
+            mask=can,
+            slot=cslot,
+            lport=lport,
+            rhost=server,
+            rport=jnp.full((h,), self.port, jnp.int32),
+            write_bytes=jnp.full((h,), self.req_bytes, jnp.int64),
+            close=jnp.zeros((h,), bool),
         )
-        ts = tcp.app_write(ts, can, cslot, jnp.int64(self.req_bytes))
         state = state.replace(streams_started=state.streams_started + can)
 
         is_tcp_packet = ev.valid & (ev.kind == KIND_PACKET)
-        bytes_before = jnp.sum(ts.delivered, axis=1)
-        ts, emits, sig = tcp.tcp_handle(
-            ts, ev, host_id, p, is_tcp_packet, app_slot=cslot, app_mask=can
+        slot, touched, v, emits, sig, delivered_open = tcp.tcp_handle(
+            ts, ev, host_id, p, is_tcp_packet, app=app
         )
-        sslot = jnp.where(sig.slot >= 0, sig.slot, 0).astype(jnp.int32)
-        v = tcp.gather_slot(ts, sslot)
+        sslot = slot
 
         # --- server: request complete -> respond + close -----------------
         # (snd_end == 1 <=> nothing written yet on this child)
@@ -161,20 +164,24 @@ class TgenModel:
             & (v.delivered >= self.req_bytes)
             & (v.snd_end == 1)
         )
-        ts = tcp.app_write(ts, m_resp, sslot, jnp.int64(self.resp_bytes))
-        ts = tcp.app_close(ts, m_resp, sslot)
+        v = tcp.view_write(v, m_resp, jnp.int64(self.resp_bytes))
+        v = tcp.view_close(v, m_resp)
 
         # --- client: server closed -> close back (-> LASTACK -> CLOSED) --
         m_eof = sig.fin_seen & is_client
-        ts = tcp.app_close(ts, m_eof, sslot)
+        v = tcp.view_close(v, m_eof)
         need_flush = m_resp | m_eof
 
+        ts = tcp.commit_slot(ts, slot, touched, v)  # the ONE scatter
+
         # --- client: stream fully torn down -> schedule the next ---------
+        # (delivered only moves on the focus slot, so the view delta equals
+        # the old whole-row sum diff)
         m_done = sig.closed & is_client
         state = state.replace(
             streams_done=state.streams_done + m_done,
             bytes_down=state.bytes_down
-            + jnp.where(is_client, jnp.sum(ts.delivered, axis=1) - bytes_before, 0),
+            + jnp.where(is_client & touched, v.delivered - delivered_open, 0),
             resets=state.resets + sig.reset,
             tcp=ts,
         )

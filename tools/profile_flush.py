@@ -1,0 +1,141 @@
+"""Decompose flush_outbox's ~140 ms/round device cost (the dominant
+round cost per tools/profile_while.py's F≈140ms fit): full flush vs the
+argsort/rank stage vs the five 2D scatters vs scatters with
+sorted+unique hints. Each variant runs as a length-N scan with the
+outbox restored every iteration (so every iteration pays the full-outbox
+cost), one dispatch per timing.
+
+  python tools/profile_flush.py [hosts] [N]
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def main():
+    hosts = int(sys.argv[1]) if len(sys.argv) > 1 else 10240
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench import _build
+    from shadow_tpu.engine.round import flush_outbox, run_round
+    from shadow_tpu.simtime import TIME_MAX
+
+    cfg, model, tables, st0 = _build(hosts)
+    we = jnp.asarray(40_000_000, jnp.int64)
+
+    print("warming one round (fills the outbox)...", flush=True)
+
+    # run iterations but NOT the flush, so the outbox carries a real load
+    from shadow_tpu.engine.round import handle_one_iteration
+
+    def fill(s):
+        def body(s, _):
+            return handle_one_iteration(s, we, model, tables, cfg), None
+        s, _ = jax.lax.scan(body, s, None, length=24)
+        return s
+
+    st = jax.jit(fill)(st0)
+    jax.block_until_ready(st.events_handled)
+    filled = int(np.asarray(st.outbox.fill).sum())
+    print(f"outbox holds {filled} packets", flush=True)
+
+    results = {"backend": jax.default_backend(), "hosts": hosts,
+               "outbox_packets": filled, "n": n}
+
+    def scanned(body_fn):
+        def f(s):
+            def body(s, _):
+                s2 = body_fn(s)
+                return s2.replace(outbox=s.outbox), None  # restore load
+            s, _ = jax.lax.scan(body, s, None, length=n)
+            return s
+        return f
+
+    # A: the real flush
+    fa = jax.jit(scanned(lambda s: flush_outbox(s, None, cfg)))
+
+    # B: sort/rank stage only (result folded into head_time to keep it live)
+    def sort_only(s):
+        ob = s.outbox
+        h_local, o_cap = ob.valid.shape
+        m = h_local * o_cap
+        valid = ob.valid.reshape(m)
+        dst = ob.dst.reshape(m)
+        key = jnp.where(valid, dst, h_local).astype(jnp.int32)
+        order = jnp.argsort(key, stable=True)
+        key_s = key[order]
+        pos = jnp.arange(m)
+        seg = jnp.concatenate([jnp.ones((1,), bool), key_s[1:] != key_s[:-1]])
+        start = jax.lax.cummax(jnp.where(seg, pos, -1))
+        rank = pos - start
+        probe = jnp.sum(rank) + jnp.sum(order)
+        return s.replace(now=s.now + (probe % 1).astype(jnp.int64))
+
+    fb = jax.jit(scanned(sort_only))
+
+    # C: the five 2D scatters with trivial precomputed indices (no sort)
+    def scatter_only(s):
+        ob = s.outbox
+        q = s.queue
+        h_local, o_cap = ob.valid.shape
+        m = h_local * o_cap
+        sdst = (jnp.arange(m, dtype=jnp.int32) * 37) % h_local
+        sslot = (jnp.arange(m, dtype=jnp.int32) * 11) % q.capacity
+        q2 = q.replace(
+            time=q.time.at[sdst, sslot].set(ob.time.reshape(m), mode="drop"),
+            tie=q.tie.at[sdst, sslot].set(ob.tie.reshape(m), mode="drop"),
+            kind=q.kind.at[sdst, sslot].set(
+                jnp.zeros((m,), jnp.int32), mode="drop"),
+            data=q.data.at[sdst, sslot].set(
+                ob.data.reshape(m, -1), mode="drop"),
+            aux=q.aux.at[sdst, sslot].set(ob.aux.reshape(m), mode="drop"),
+        )
+        return s.replace(queue=q2)
+
+    fc = jax.jit(scanned(scatter_only))
+
+    # D: same scatters with sorted + unique hints (iota indices: unique
+    # when m <= h*qcap and strides coprime — use plain iota to be exact)
+    def scatter_hinted(s):
+        ob = s.outbox
+        q = s.queue
+        h_local, o_cap = ob.valid.shape
+        m = h_local * o_cap
+        sdst = jnp.arange(m, dtype=jnp.int32) // o_cap
+        sslot = jnp.arange(m, dtype=jnp.int32) % o_cap
+        kw = dict(mode="drop", indices_are_sorted=True, unique_indices=True)
+        q2 = q.replace(
+            time=q.time.at[sdst, sslot].set(ob.time.reshape(m), **kw),
+            tie=q.tie.at[sdst, sslot].set(ob.tie.reshape(m), **kw),
+            kind=q.kind.at[sdst, sslot].set(jnp.zeros((m,), jnp.int32), **kw),
+            data=q.data.at[sdst, sslot].set(ob.data.reshape(m, -1), **kw),
+            aux=q.aux.at[sdst, sslot].set(ob.aux.reshape(m), **kw),
+        )
+        return s.replace(queue=q2)
+
+    fd = jax.jit(scanned(scatter_hinted))
+
+    for name, f in (("flush_full", fa), ("sort_rank", fb),
+                    ("scatters_plain", fc), ("scatters_hinted", fd)):
+        print(f"compiling {name}...", flush=True)
+        out = f(st)
+        jax.block_until_ready(out.events_handled)
+        t0 = time.perf_counter()
+        out = f(st)
+        jax.block_until_ready(out.events_handled)
+        dt = (time.perf_counter() - t0) / n * 1e3
+        results[f"{name}_ms"] = round(dt, 3)
+        print(name, round(dt, 3), "ms", flush=True)
+
+    print(json.dumps(results), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+"""Isolate the lax.while_loop penalty in run_round (the 59us-scan vs
+34ms-while discrepancy): time 8 real rounds of the bench world three
+ways on an ACTIVE state —
+
+  while:   the current run_round (while_loop until drained)
+  block:   while(any eligible) over a scan of K iterations (amortizes
+           whatever per-while-iteration cost exists K-fold)
+  scan:    fixed scan of T iterations per round, no while at all
+           (extra iterations are masked no-ops; correctness-neutral)
+
+  python tools/profile_while.py [hosts] [rounds] [K] [T]
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def main():
+    hosts = int(sys.argv[1]) if len(sys.argv) > 1 else 10240
+    nrounds = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    k = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+    t_fixed = int(sys.argv[4]) if len(sys.argv) > 4 else 48
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench import _build
+    from shadow_tpu import equeue
+    from shadow_tpu.engine.round import (
+        _next_window_end,
+        flush_outbox,
+        handle_one_iteration,
+        run_round,
+    )
+
+    cfg, model, tables, st0 = _build(hosts)
+
+    def rounds_while(s):
+        def one(s, _):
+            we = _next_window_end(s, jnp.asarray(10**18, jnp.int64), cfg, None)
+            return run_round(s, we, model, tables, cfg), None
+        s, _ = jax.lax.scan(one, s, None, length=nrounds)
+        return s
+
+    def round_block(s, we):
+        def cond(c):
+            s, it = c
+            return jnp.any(equeue.next_time(s.queue) < we) & (
+                it < 100_000
+            )
+
+        def body(c):
+            s, it = c
+            def inner(s, _):
+                return handle_one_iteration(s, we, model, tables, cfg), None
+            s, _ = jax.lax.scan(inner, s, None, length=k)
+            return s, it + k
+
+        (s, it), = (jax.lax.while_loop(cond, body, (s, jnp.int32(0))),)
+        s = flush_outbox(s, None, cfg)
+        return s.replace(
+            now=jnp.maximum(s.now, we), iters_done=s.iters_done.at[0].add(it)
+        )
+
+    def rounds_block(s):
+        def one(s, _):
+            we = _next_window_end(s, jnp.asarray(10**18, jnp.int64), cfg, None)
+            return round_block(s, we), None
+        s, _ = jax.lax.scan(one, s, None, length=nrounds)
+        return s
+
+    def rounds_scan(s):
+        def one(s, _):
+            we = _next_window_end(s, jnp.asarray(10**18, jnp.int64), cfg, None)
+            def inner(s, _):
+                return handle_one_iteration(s, we, model, tables, cfg), None
+            s, _ = jax.lax.scan(inner, s, None, length=t_fixed)
+            s = flush_outbox(s, None, cfg)
+            return s.replace(now=jnp.maximum(s.now, we)), None
+        s, _ = jax.lax.scan(one, s, None, length=nrounds)
+        return s
+
+    results = {"backend": jax.default_backend(), "hosts": hosts,
+               "rounds": nrounds, "k": k, "t_fixed": t_fixed}
+    for name, fn in (("while", rounds_while), ("block", rounds_block),
+                     ("scan", rounds_scan)):
+        print(f"compiling {name}...", flush=True)
+        f = jax.jit(fn)
+        out = f(st0)
+        jax.block_until_ready(out.events_handled)
+        t0 = time.perf_counter()
+        out = f(st0)
+        jax.block_until_ready(out.events_handled)
+        dt = time.perf_counter() - t0
+        ev = int(np.asarray(out.events_handled).sum())
+        it = int(np.asarray(out.iters_done).sum())
+        results[name] = {"s": round(dt, 4), "events": ev, "iters": it}
+        print(name, results[name], flush=True)
+
+    print(json.dumps(results), flush=True)
+
+
+if __name__ == "__main__":
+    main()
