@@ -159,9 +159,18 @@ def push_self(
 
     One-hot where writes (fusable on TPU), not scatters; see pop_min.
     Targets the first free (tombstoned) slot of each row.
+
+    Invariant (load-bearing): time == TIME_MAX marks a FREE slot, so no
+    live event may be pushed at TIME_MAX. Such a push would increment
+    count while the slot still reads free, silently desyncing occupancy —
+    it is instead rejected and counted into overflow (loud via
+    check_capacity). A "never" sentinel event is semantically an event
+    that does not exist; schedule real events strictly below TIME_MAX.
     """
     if aux is None:
         aux = jnp.zeros_like(kind)
+    sentinel = valid & (time >= TIME_MAX)
+    valid = valid & ~sentinel
     free = q.time == TIME_MAX  # [H, Q]
     has_room = q.count < q.capacity
     write = valid & has_room
@@ -174,7 +183,9 @@ def push_self(
         data=jnp.where(at[:, :, None], data[:, None, :], q.data),
         aux=jnp.where(at, aux[:, None], q.aux),
         count=q.count + write.astype(jnp.int32),
-        overflow=q.overflow + (valid & ~has_room).astype(jnp.int32),
+        overflow=q.overflow
+        + (valid & ~has_room).astype(jnp.int32)
+        + sentinel.astype(jnp.int32),
         head_time=jnp.minimum(q.head_time, jnp.where(write, time, TIME_MAX)),
     )
 
@@ -191,11 +202,17 @@ def push_self_lanes(
     """Each host pushes up to L events into its *own* queue, in lane order —
     semantically identical to L sequential push_self calls, but the slot
     writes collapse into one fused where-chain per array (one pass on TPU
-    instead of L). Lane l lands in the row's l-th free (tombstoned) slot."""
+    instead of L). Lane l lands in the row's l-th free (tombstoned) slot.
+
+    Same TIME_MAX invariant as push_self: a push at TIME_MAX (the
+    free-slot marker) is rejected and counted into overflow, never
+    silently admitted."""
     if valid.shape[1] == 0:
         return q  # no lanes: the sequential-push contract is a no-op
     if aux is None:
         aux = jnp.zeros_like(kind)
+    sentinel = valid & (time >= TIME_MAX)
+    valid = valid & ~sentinel
     free = q.time == TIME_MAX  # [H, Q]
     fr = jnp.cumsum(free, axis=1) - free  # rank among free slots
     ranks = jnp.cumsum(valid.astype(jnp.int32), axis=1) - valid.astype(jnp.int32)
@@ -220,7 +237,8 @@ def push_self_lanes(
         aux=new_aux,
         # explicit int32: jnp.sum promotes int under x64 (see _lane_seqs)
         count=q.count + jnp.sum(write, axis=1).astype(jnp.int32),
-        overflow=q.overflow + jnp.sum(valid & ~write, axis=1).astype(jnp.int32),
+        overflow=q.overflow
+        + jnp.sum((valid & ~write) | sentinel, axis=1).astype(jnp.int32),
         head_time=jnp.minimum(q.head_time, head_new),
     )
 
@@ -281,12 +299,37 @@ def push_many_sorted(
     order within a destination equals arrival order of the stable sort —
     the same order plain push_many produced; pop order is key-driven
     anyway.
+
+    Overflow safety: when a destination receives more than D entries the
+    filler enumeration can run short (fewer invalid entries than unfilled
+    grid slots), which would shift later fitting entries onto earlier grid
+    positions. Two defenses (round-4 advisor, high):
+
+      * the S2 key switches, via lax.cond on the exact shortfall
+        predicate, to a repair assignment that hands every grid slot to
+        exactly one entry (fitting entries to their target slots via a
+        permutation sort of the slots by source position; non-fitting
+        entries claim the unfilled slots). The repair needs two m-wide
+        gathers, paid ONLY on the (always loud, check_capacity-fatal)
+        overflow path — the common path is the plain filler arithmetic;
+      * belt-and-braces, the destination id rides through S2 (it IS the
+        S1 key, one extra sort operand) and the grid rejects any entry
+        whose carried destination differs from the row it landed on.
+
+    Net: a delivery is either on its correct host with its exact payload
+    or counted in overflow; hosts within their lane budget receive
+    everything even while another destination overflows. Within-row lane
+    shifts are harmless (pop order is key-driven, lane position carries
+    no meaning).
     """
     if aux is None:
         aux = jnp.zeros_like(kind)
     m = dst.shape[0]
     h = q.num_hosts
-    d = deliver_lanes
+    # a destination can receive at most M entries, so the grid never needs
+    # to be wider than M (keeps the exact push_many path — deliver_lanes ==
+    # capacity — at traffic scale for small-M callers like hybrid uploads)
+    d = min(deliver_lanes, m)
     grid = h * d
     big = jnp.int32(1 << 30)
 
@@ -358,13 +401,48 @@ def push_many_sorted(
 
     fits = real & (rank < d)
     target = key1_s * d + rank
-    key2 = jnp.where(
-        fits, target, jnp.where(real, big + pos, fill_for_pos)
-    ).astype(jnp.int32)
 
-    # S2: place into grid order; the first H*D entries are the grid
-    _, time_g, tie_g, kind_g, aux_g, used_g, *data_g = jax.lax.sort(
-        (key2, time_s, tie_s, kind_s, aux_s, fits)
+    def _key2_common(_):
+        # fillers exactly cover the unfilled slots (no overflow anywhere)
+        return jnp.where(
+            fits, target, jnp.where(real, big + pos, fill_for_pos)
+        ).astype(jnp.int32)
+
+    def _key2_repair(_):
+        # Exact slot assignment via a slot-permutation: sort grid slots by
+        # the S1 position they want to read (filled slot (dst, lane) wants
+        # position bounds[dst] + lane; unfilled slots sort after, in slot
+        # order), then entry with fitting-rank j takes pi[j] and the k-th
+        # non-fitting entry claims pi[n_fit + k] — every slot claimed
+        # exactly once, so no entry can shift rows even under overflow.
+        src_pos = jnp.where(
+            lane_r < cnt[:, None], bounds[:-1][:, None] + lane_r, 0
+        ).reshape(grid)
+        src_key = jnp.where(
+            unfilled, big + jnp.arange(grid, dtype=jnp.int32), src_pos
+        )
+        _, pi = jax.lax.sort(
+            (src_key, jnp.arange(grid, dtype=jnp.int32)), num_keys=1,
+            is_stable=True,
+        )
+        pi_pad = jnp.concatenate([pi, big + jnp.arange(mp, dtype=jnp.int32)])
+        fits_i = fits.astype(jnp.int32)
+        rank_fit = jnp.cumsum(fits_i) - fits_i
+        n_fit = jnp.sum(fits_i)
+        rank_nonfit = pos - rank_fit
+        idx = jnp.where(fits, rank_fit, n_fit + rank_nonfit)
+        return pi_pad[jnp.minimum(idx, grid + mp - 1)]
+
+    # fillers run short iff total overflow exceeds the padding slack —
+    # only then pay the repair gathers (the run is already doomed loudly)
+    shortfall = (grid - jnp.sum(cnt)) - (mp - n_valid)
+    key2 = jax.lax.cond(shortfall > 0, _key2_repair, _key2_common, None)
+
+    # S2: place into grid order; the first H*D entries are the grid.
+    # key1_s (== dst for valid entries) rides along so landing rows can be
+    # validated below — see the overflow-safety note in the docstring.
+    _, time_g, tie_g, kind_g, aux_g, used_g, dst_g, *data_g = jax.lax.sort(
+        (key2, time_s, tie_s, kind_s, aux_s, fits, key1_s)
         + tuple(data_cols),
         num_keys=1,
         is_stable=True,
@@ -373,14 +451,18 @@ def push_many_sorted(
     def to_grid(x):
         return x[:grid].reshape(h, d)
 
-    g_valid = to_grid(used_g)
+    g_valid = to_grid(used_g) & (
+        to_grid(dst_g) == jnp.arange(h, dtype=jnp.int32)[:, None]
+    )
     g_time = to_grid(time_g)
     g_tie = to_grid(tie_g)
     g_kind = to_grid(kind_g)
     g_aux = to_grid(aux_g)
     g_data = jnp.stack([to_grid(c) for c in data_g], axis=-1)
 
-    overflow_extra = n_valid - jnp.sum(g_valid.astype(jnp.int32))
+    overflow_extra = (n_valid - jnp.sum(g_valid.astype(jnp.int32))).astype(
+        jnp.int32
+    )
 
     q2 = push_self_lanes(
         q, valid=g_valid, time=g_time, tie=g_tie, kind=g_kind,

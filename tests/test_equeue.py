@@ -128,3 +128,102 @@ def test_pop_respects_want_mask_and_empty_hosts():
     assert bool(ev.valid[0]) and not bool(ev.valid[1]) and not bool(ev.valid[2])
     assert int(ev.time[0]) == 5
     assert [int(c) for c in q.count] == [0, 0, 1]
+
+
+def test_push_many_sorted_overflow_never_misroutes():
+    """Regression (round-4 advisor, high): when one destination receives
+    more than deliver_lanes entries, other hosts' deliveries must be
+    unaffected and no entry may land on a wrong host with valid=True —
+    overflow is dropped and counted, never misrouted."""
+    H, Q, D = 4, 16, 2
+    q = equeue.create(H, Q)
+    # 4 entries to host 1 (two beyond D), 2 to host 0, 1 to host 3;
+    # m=7 <= H*D=8, the exact regime the advisor flagged
+    dst = [1, 1, 0, 1, 1, 0, 3]
+    evs = _mk_events(random.Random(11), len(dst), H)
+    ties = [pack_tie(k, s, sq) for (_, k, s, sq, _) in evs]
+    q = equeue.push_many_sorted(
+        q,
+        dst=jnp.array(dst, jnp.int32),
+        valid=jnp.ones((len(dst),), bool),
+        time=jnp.array([e[0] for e in evs], jnp.int64),
+        tie=jnp.array(ties, jnp.int64),
+        kind=jnp.array([e[1] for e in evs], jnp.int32),
+        data=jnp.array([e[4] for e in evs], jnp.int32),
+        deliver_lanes=D,
+    )
+    sent = {h: [] for h in range(H)}
+    for i, d in enumerate(dst):
+        t, k, _, _, payload = evs[i]
+        sent[d].append((t, ties[i], k, tuple(payload)))
+    total_delivered = 0
+    for h in range(H):
+        got = equeue.debug_sorted_events(q, h)
+        # every delivered event must be one this host was actually sent
+        for item in got:
+            assert item in sent[h], f"host {h} received a misrouted event {item}"
+        total_delivered += len(got)
+    # hosts within their lane budget receive everything, even while
+    # another destination overflows
+    assert len(equeue.debug_sorted_events(q, 0)) == 2
+    assert len(equeue.debug_sorted_events(q, 3)) == 1
+    # host 1 keeps exactly D of its 4 (arrival order); the rest are loud
+    assert len(equeue.debug_sorted_events(q, 1)) == D
+    assert int(jnp.sum(q.overflow)) == len(dst) - total_delivered == 2
+
+
+def test_push_many_sorted_overflow_m_gt_grid_property():
+    """The repair path's other static regime: m > H*D (no padding; filler
+    slack comes only from invalid entries). Deliveries must equal exactly
+    the first D entries per destination in arrival order."""
+    rng = random.Random(23)
+    H, Q, D, M = 3, 64, 2, 20
+    for trial in range(8):
+        q = equeue.create(H, Q)
+        dst = [rng.randrange(H) for _ in range(M)]
+        valid = [rng.random() < 0.7 for _ in range(M)]
+        evs = _mk_events(rng, M, H, seq_base=trial * M)
+        ties = [pack_tie(k, s, sq) for (_, k, s, sq, _) in evs]
+        q = equeue.push_many_sorted(
+            q,
+            dst=jnp.array(dst, jnp.int32),
+            valid=jnp.array(valid),
+            time=jnp.array([e[0] for e in evs], jnp.int64),
+            tie=jnp.array(ties, jnp.int64),
+            kind=jnp.array([e[1] for e in evs], jnp.int32),
+            data=jnp.array([e[4] for e in evs], jnp.int32),
+            deliver_lanes=D,
+        )
+        sent = {h: [] for h in range(H)}
+        for i in range(M):
+            if valid[i]:
+                t, k, _, _, payload = evs[i]
+                sent[dst[i]].append((t, ties[i], k, tuple(payload)))
+        delivered = 0
+        for h in range(H):
+            got = equeue.debug_sorted_events(q, h)
+            # multiset/order-exact: the first D arrivals for h, sorted
+            assert got == sorted(sent[h][:D]), f"trial {trial} host {h}"
+            delivered += len(got)
+        n_sent = sum(len(v) for v in sent.values())
+        assert int(jnp.sum(q.overflow)) == n_sent - delivered
+
+
+def test_push_at_time_max_rejected_loudly():
+    """The TIME_MAX free-slot invariant: a push at the sentinel time is
+    rejected and counted into overflow instead of desyncing occupancy."""
+    H, Q = 2, 4
+    q = equeue.create(H, Q)
+    q = equeue.push_self(
+        q,
+        valid=jnp.array([True, True]),
+        time=jnp.array([5, TIME_MAX], jnp.int64),
+        tie=jnp.array([pack_tie(1, h, 0) for h in range(H)], jnp.int64),
+        kind=jnp.full((H,), 1, jnp.int32),
+        data=jnp.zeros((H, PAYLOAD_LANES), jnp.int32),
+    )
+    assert [int(c) for c in q.count] == [1, 0]
+    assert [int(o) for o in q.overflow] == [0, 1]
+    # occupancy stays consistent: free slots == capacity - count
+    free = np.asarray(q.time) == TIME_MAX
+    assert free.sum(axis=1).tolist() == [Q - 1, Q]

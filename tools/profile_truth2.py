@@ -1,0 +1,222 @@
+"""Stage-level ground truth (fresh inputs per call; see profile_truth.py).
+
+Splits the two dominant costs found by profile_truth:
+  flush (~16 ms): S1 sort vs S2 sort vs the deliver_lanes-wide
+      push_self_lanes merge, at deliver_lanes {32, 64}
+  body (~2-3.5 ms): full model vs identity handler (queue mechanics
+      only) vs compacted widths {512, 2048}
+
+Also times the call floor with a scalar-only argument (is the 116 ms
+floor per-call or per-argument-bytes?).
+
+  python tools/profile_truth2.py [hosts] [reps]
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def main():
+    hosts = int(sys.argv[1]) if len(sys.argv) > 1 else 10240
+    reps = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench import _build
+    from shadow_tpu import equeue
+    from shadow_tpu.engine.round import (
+        _next_window_end,
+        flush_outbox,
+        handle_one_iteration,
+        handle_one_iteration_compact,
+        run_round,
+    )
+    from shadow_tpu.events import KIND_PACKET
+    from shadow_tpu.simtime import TIME_MAX
+
+    cfg, model, tables, st0 = _build(hosts)
+    we_far = jnp.asarray(10**18, jnp.int64)
+
+    warm = jax.jit(
+        lambda s: run_round(
+            s, _next_window_end(s, we_far, cfg, None), model, tables, cfg
+        )
+    )
+    st = st0
+    for _ in range(3):
+        st = warm(st)
+    jax.block_until_ready(st.events_handled)
+    results = {"backend": jax.default_backend(), "hosts": hosts}
+
+    def timed(name, fn, n_inner=1):
+        f = jax.jit(fn)
+        out = f(st, jnp.uint32(999))
+        jax.block_until_ready(out)
+        ts = []
+        for r in range(reps):
+            t0 = time.perf_counter()
+            out = f(st, jnp.uint32(r))
+            jax.block_until_ready(out)
+            ts.append(time.perf_counter() - t0)
+        best = min(ts)
+        results[name] = round(best * 1e3, 3)
+        print(name, results[name], "ms", flush=True)
+
+    # --- call floor with scalar-only args ---
+    g = jax.jit(lambda r: r * 2 + 1)
+    jax.block_until_ready(g(jnp.uint32(1)))
+    ts = []
+    for r in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(g(jnp.uint32(r + 2)))
+        ts.append(time.perf_counter() - t0)
+    results["call_floor_scalar"] = round(min(ts) * 1e3, 3)
+    print("call_floor_scalar", results["call_floor_scalar"], "ms", flush=True)
+
+    # --- flush internals (N=8 inner reps inside one call) ---
+    N = 8
+
+    ob = st.outbox
+    h_local, o_cap = ob.valid.shape
+    m = h_local * o_cap
+
+    def flat(x):
+        return x.reshape((m,) + x.shape[2:])
+
+    def mk_flush(lanes):
+        c2 = dataclasses.replace(cfg, deliver_lanes=lanes)
+
+        def f(s, r):
+            s = s.replace(seq=s.seq + r * 0)
+
+            def step(q, _):
+                s2 = flush_outbox(s.replace(queue=q), None, c2)
+                return s2.queue, None
+
+            q, _ = jax.lax.scan(step, s.queue, None, length=N)
+            return q.count.sum() + r
+
+        return f
+
+    timed("flush8_d64", mk_flush(64), n_inner=N)
+    timed("flush8_d32", mk_flush(32), n_inner=N)
+
+    def s1_only(s, r):
+        ob = s.outbox
+        valid, dst = flat(ob.valid), flat(ob.dst)
+        time_, tie = flat(ob.time), flat(ob.tie)
+        data, aux = flat(ob.data), flat(ob.aux)
+        kind = jnp.full(valid.shape, KIND_PACKET, jnp.int32)
+
+        def step(c, _):
+            key1 = jnp.where(valid, dst + c * 0, hosts).astype(jnp.int32)
+            outs = jax.lax.sort(
+                (key1, time_, tie, kind, aux, valid)
+                + tuple(data[:, i] for i in range(data.shape[1])),
+                num_keys=1,
+                is_stable=True,
+            )
+            return c + outs[0][0], None
+
+        c, _ = jax.lax.scan(step, r.astype(jnp.int32), None, length=N)
+        return c
+
+    timed("sort15op_m8", s1_only, n_inner=N)
+
+    def mk_merge(lanes):
+        d = lanes
+        gshape = (h_local, d)
+
+        def f(s, r):
+            g_valid = jnp.zeros(gshape, bool).at[:, 0].set(True)
+            g_time = jnp.full(gshape, 5, jnp.int64)
+            g_tie = jnp.zeros(gshape, jnp.int64)
+            g_kind = jnp.full(gshape, KIND_PACKET, jnp.int32)
+            g_aux = jnp.zeros(gshape, jnp.int32)
+            g_data = jnp.zeros(gshape + (data_lanes,), jnp.int32)
+
+            def step(q, _):
+                q2 = equeue.push_self_lanes(
+                    q, valid=g_valid, time=g_time + q.count[0], tie=g_tie,
+                    kind=g_kind, data=g_data, aux=g_aux,
+                )
+                return q2, None
+
+            q, _ = jax.lax.scan(step, s.queue, None, length=N)
+            return q.count.sum() + q.tie.sum() + q.time.sum() + r
+
+        return f
+
+    data_lanes = st.queue.data.shape[2]
+    timed("merge8_d64", mk_merge(64), n_inner=N)
+    timed("merge8_d32", mk_merge(32), n_inner=N)
+
+    # --- body internals ---
+    we = jnp.asarray(int(np.asarray(st.now)) + 10**15, jnp.int64)
+
+    class _IdModel:
+        """Identity handler: pops happen, nothing is emitted."""
+
+        DRAWS_PER_EVENT = 0
+        BOOTSTRAP_DRAWS = 0
+        LOCAL_EMITS = 1
+        PACKET_EMITS = 1
+        LOSS_COUNTER_LANE = None
+
+        def handle(self, mstate, ev, draw, c, host_id):
+            from shadow_tpu.engine.state import (
+                empty_local_emits,
+                empty_packet_emits,
+            )
+
+            h = host_id.shape[0]
+            return mstate, empty_local_emits(h, 1), empty_packet_emits(h, 1)
+
+    idm = _IdModel()
+
+    def mk_body(n, fn):
+        def f(s, r):
+            s = s.replace(seq=s.seq + r * 0)
+
+            def inner(s, _):
+                return fn(s), None
+
+            s, _ = jax.lax.scan(inner, s, None, length=n)
+            return s.events_handled.sum() + r
+
+        return f
+
+    timed(
+        "body8_full",
+        mk_body(8, lambda s: handle_one_iteration(s, we, model, tables, cfg)),
+        n_inner=8,
+    )
+    timed(
+        "body8_idmodel",
+        mk_body(8, lambda s: handle_one_iteration(s, we, idm, tables, cfg)),
+        n_inner=8,
+    )
+    for lanes in (512, 2048):
+        timed(
+            f"body8_compact{lanes}",
+            mk_body(
+                8,
+                lambda s, L=lanes: handle_one_iteration_compact(
+                    s, we, model, tables, cfg, L
+                ),
+            ),
+            n_inner=8,
+        )
+
+    print(json.dumps(results), flush=True)
+
+
+if __name__ == "__main__":
+    main()
