@@ -118,6 +118,8 @@ static const int TRAPPED[] = {
     203 /*sched_setaffinity*/, 204 /*sched_getaffinity*/,
     97 /*getrlimit*/,  160 /*setrlimit*/,  302 /*prlimit64*/,
     157 /*prctl*/,     17 /*pread64*/,     18 /*pwrite64*/,
+    295 /*preadv*/,    296 /*pwritev*/,
+    327 /*preadv2*/,   328 /*pwritev2*/,
     262 /*newfstatat*/, 332 /*statx*/,     100 /*times*/,
     98 /*getrusage*/,  309 /*getcpu*/,
     307 /*sendmmsg*/,  299 /*recvmmsg*/,

@@ -41,6 +41,7 @@
 #include <string.h>
 #include <linux/futex.h>
 #include <sched.h>
+#include <sys/ioctl.h>
 #include <sys/mman.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
@@ -1638,6 +1639,61 @@ ssize_t writev(int fd, const struct iovec *iov, int iovcnt) {
     return (ssize_t)done;
 }
 
+/* Positioned vectored IO: virtual fds are sockets/pipes/anon inodes —
+ * not seekable, so Linux semantics are ESPIPE (matching the raw
+ * pread64/pwrite64 trap below); sandbox files pass through natively.
+ * All four glibc name variants resolve here. */
+/* the raw p*v syscalls split the position into (pos_l, pos_h) halves */
+#define POS_LO(off) ((long)(uint32_t)(uint64_t)(off))
+#define POS_HI(off) ((long)((uint64_t)(off) >> 32))
+
+ssize_t preadv(int fd, const struct iovec *iov, int iovcnt, off_t off) {
+    if (!g_active || !is_vfd(fd))
+        return rsyscall(SYS_preadv, fd, iov, iovcnt, POS_LO(off), POS_HI(off));
+    errno = ESPIPE;
+    return -1;
+}
+ssize_t preadv64(int fd, const struct iovec *iov, int iovcnt, off_t off) {
+    return preadv(fd, iov, iovcnt, off);
+}
+ssize_t preadv2(int fd, const struct iovec *iov, int iovcnt, off_t off,
+                int flags) {
+    if (!g_active || !is_vfd(fd))
+        return rsyscall(SYS_preadv2, fd, iov, iovcnt, POS_LO(off),
+                        POS_HI(off), flags);
+    if (off == (off_t)-1) /* -1 = current position: valid on sockets/pipes */
+        return readv(fd, iov, iovcnt);
+    errno = ESPIPE;
+    return -1;
+}
+ssize_t preadv64v2(int fd, const struct iovec *iov, int iovcnt, off_t off,
+                   int flags) {
+    return preadv2(fd, iov, iovcnt, off, flags);
+}
+ssize_t pwritev(int fd, const struct iovec *iov, int iovcnt, off_t off) {
+    if (!g_active || !is_vfd(fd))
+        return rsyscall(SYS_pwritev, fd, iov, iovcnt, POS_LO(off), POS_HI(off));
+    errno = ESPIPE;
+    return -1;
+}
+ssize_t pwritev64(int fd, const struct iovec *iov, int iovcnt, off_t off) {
+    return pwritev(fd, iov, iovcnt, off);
+}
+ssize_t pwritev2(int fd, const struct iovec *iov, int iovcnt, off_t off,
+                 int flags) {
+    if (!g_active || !is_vfd(fd))
+        return rsyscall(SYS_pwritev2, fd, iov, iovcnt, POS_LO(off),
+                        POS_HI(off), flags);
+    if (off == (off_t)-1)
+        return writev(fd, iov, iovcnt);
+    errno = ESPIPE;
+    return -1;
+}
+ssize_t pwritev64v2(int fd, const struct iovec *iov, int iovcnt, off_t off,
+                    int flags) {
+    return pwritev2(fd, iov, iovcnt, off, flags);
+}
+
 ssize_t sendmsg(int fd, const struct msghdr *msg, int flags) {
     if (!g_active || !is_vfd(fd))
         return rsyscall(SYS_sendmsg, fd, msg, flags);
@@ -2183,12 +2239,18 @@ int ioctl(int fd, unsigned long req, ...) {
     if (!g_active || !is_vfd(fd))
         return (int)rsyscall(SYS_ioctl, fd, req, argp);
     ShimMsg reply;
-    int64_t r = vsys(VSYS_IOCTL, fd, (int64_t)req, 0, NULL, 0, &reply);
+    /* Input-int requests ship *argp in a3 (FIONBIO: nonblocking toggle);
+     * only output-int requests (FIONREAD) may write argp back — a blind
+     * write-back would clobber the caller's input int with 0. */
+    int64_t a3 = 0;
+    if (req == FIONBIO && argp)
+        a3 = (int64_t)*(int *)argp;
+    int64_t r = vsys(VSYS_IOCTL, fd, (int64_t)req, a3, NULL, 0, &reply);
     if (r < 0) {
         errno = (int)-r;
         return -1;
     }
-    if (argp)
+    if (req == FIONREAD && argp)
         *(int *)argp = (int)reply.a[2];
     return 0;
 }
@@ -3670,8 +3732,20 @@ long shim_route_syscall(long nr, long a1, long a2, long a3, long a4, long a5,
             return shim_raw_syscall(nr, a1, a2, a3, a4, a5, a6);
         }
 
+    case SYS_preadv2:
+    case SYS_pwritev2:
+        /* pos_l == pos_h == -1: "use current position" — valid on
+         * sockets/pipes, equivalent to readv/writev */
+        if (is_vfd((int)a1) && (long)a4 == -1 && (long)a5 == -1)
+            return KR(nr == SYS_preadv2
+                          ? readv((int)a1, (const struct iovec *)a2, (int)a3)
+                          : writev((int)a1, (const struct iovec *)a2,
+                                   (int)a3));
+        /* fall through */
     case SYS_pread64:
     case SYS_pwrite64:
+    case SYS_preadv:
+    case SYS_pwritev:
         if (is_vfd((int)a1))
             return -ESPIPE; /* sockets/pipes are not seekable */
         return shim_raw_syscall(nr, a1, a2, a3, a4, a5, a6);

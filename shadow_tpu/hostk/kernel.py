@@ -93,6 +93,7 @@ O_NONBLOCK = 0x800
 F_GETFL = 3
 F_SETFL = 4
 FIONREAD = 0x541B
+FIONBIO = 0x5421
 SOL_SOCKET = 1
 SO_ERROR = 4
 
@@ -1954,6 +1955,12 @@ class NetKernel:
             else:
                 n = 0
             proc._reply(0, a=(0, 0, n))
+            return True
+        if req == FIONBIO:
+            # the int value rides in a[3] (the shim reads *argp; CPython's
+            # settimeout/setblocking path uses FIONBIO when available)
+            f.nonblock = bool(int(msg.a[3]))
+            proc._reply(0)
             return True
         proc._reply(-EINVAL)
         return True

@@ -1,0 +1,133 @@
+"""Multi-process-server grade: socketserver.ForkingTCPServer (stock
+CPython) forks one child per connection and serves N distro-curl clients
+in-sim, run-twice deterministic — the round-4 verdict's acceptance bar
+for syscall breadth (Next #3). Exercises per-connection fork, parent
+wait4/SIGCHLD reaping, inherited virtual sockets across fork, and
+ioctl(FIONBIO) (CPython's settimeout path).
+
+Reference analogue: preforking servers under
+/root/reference/src/main/host/syscall_handler.c dispatch breadth (fork
+rows) + the nginx/curl example matrix (src/test/examples/)."""
+
+import json
+import os
+
+import pytest
+
+from shadow_tpu.runtime.cli_run import run_from_config
+
+PY = "/usr/bin/python3"
+CURL = "/usr/bin/curl"
+
+pytestmark = pytest.mark.skipif(
+    not (os.access(PY, os.X_OK) and os.access(CURL, os.X_OK)),
+    reason="system python3/curl missing",
+)
+
+SERVER_PY = r"""
+import http.server, socketserver, sys
+
+class H(http.server.BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    def do_GET(self):
+        import os
+        body = ("forked pid=%d path=%s\n" % (os.getpid(), self.path)).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+    def log_message(self, fmt, *args):
+        sys.stderr.write("%s - %s\n" % (self.address_string(), fmt % args))
+
+class Srv(socketserver.ForkingTCPServer):
+    allow_reuse_address = True
+
+with Srv(("0.0.0.0", 80), H) as srv:
+    sys.stdout.write("ready\n"); sys.stdout.flush()
+    srv.serve_forever()
+"""
+
+CONFIG = """
+general:
+  stop_time: 12 s
+  seed: 1
+  data_directory: {data_dir}
+network:
+  graph:
+    type: 1_gbit_switch
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+      - path: {py}
+        args: ["-u", "{server_py}"]
+        expected_final_state: running
+  client1:
+    network_node_id: 0
+    processes:
+      - path: {curl}
+        args: ["-sS", "--max-time", "5", "-o", "page.txt", "http://server/c1"]
+        start_time: 3 s
+  client2:
+    network_node_id: 0
+    processes:
+      - path: {curl}
+        args: ["-sS", "--max-time", "5", "-o", "page.txt", "http://server/c2"]
+        start_time: 3500 ms
+  client3:
+    network_node_id: 0
+    processes:
+      - path: {curl}
+        args: ["-sS", "--max-time", "5", "-o", "page.txt", "http://server/c3"]
+        start_time: 4 s
+"""
+
+
+def _run(tmp_path, sub):
+    d = tmp_path / sub
+    d.mkdir(parents=True)
+    server_py = d / "forksrv.py"
+    server_py.write_text(SERVER_PY)
+    cfg = d / "shadow.yaml"
+    cfg.write_text(
+        CONFIG.format(data_dir=d / "data", py=PY, curl=CURL, server_py=server_py)
+    )
+    rc = run_from_config(str(cfg))
+    return rc, d / "data"
+
+
+def _transcript(data):
+    """The determinism-relevant transcript of one run."""
+    out = {}
+    for c in ("client1", "client2", "client3"):
+        out[c] = (data / c / "page.txt").read_bytes()
+    out["server_stdout"] = next((data / "server").glob("*.stdout")).read_bytes()
+    return out
+
+
+def test_forking_server_serves_three_curls(tmp_path):
+    rc, data = _run(tmp_path, "a")
+    assert rc == 0
+    pids = set()
+    for c in ("client1", "client2", "client3"):
+        body = (data / c / "page.txt").read_text()
+        assert f"path=/c{c[-1]}" in body
+        pids.add(body.split("pid=")[1].split()[0])
+    # each connection was handled by a DIFFERENT forked child
+    assert len(pids) == 3
+    # the parent reaped its children (wait4 path) and kept serving
+    stats = json.loads((data / "sim-stats.json").read_text())
+    assert stats["syscall_counts"].get("wait4", 0) >= 3
+    assert stats["syscall_counts"].get("fork", 0) == 3
+
+
+def test_forking_server_deterministic(tmp_path):
+    t1 = None
+    for sub in ("r1", "r2"):
+        rc, data = _run(tmp_path, sub)
+        assert rc == 0
+        t = _transcript(data)
+        if t1 is None:
+            t1 = t
+        else:
+            assert t == t1

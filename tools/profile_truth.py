@@ -74,20 +74,18 @@ def main():
         }
         print(name, results[name], flush=True)
 
-    def bump(s, r):
-        return s.replace(rng_counter=s.rng_counter + 0 * r + 0)
-
     # tunnel + dispatch floor: return a scalar derived from the state
     timed("call_floor", lambda s, r: s.events_handled.sum() + r)
 
-    # while_loop overhead with a trivial body
+    # while_loop overhead with a trivial body (r keeps inputs fresh
+    # without changing the 64-iteration trip count)
     def while_trivial(s, r):
         def cond(c):
             return c[0] < 64
         def body(c):
             return (c[0] + 1, c[1] + c[0])
-        i, acc = jax.lax.while_loop(cond, body, (r, jnp.uint32(0)))
-        return acc + s.events_handled[0]
+        i, acc = jax.lax.while_loop(cond, body, (r * 0, jnp.uint32(0)))
+        return acc + s.events_handled[0] + r
     timed("while_trivial_64", while_trivial, n_inner=64)
 
     we = jnp.asarray(int(np.asarray(st.now)) + 10**15, jnp.int64)
