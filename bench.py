@@ -243,7 +243,12 @@ def main():
     num_hosts = int(os.environ.get("SHADOW_TPU_BENCH_HOSTS", 10240))
     sim_sec = float(os.environ.get("SHADOW_TPU_BENCH_SIMSEC", 0.5))
     cpu_sim_sec = float(os.environ.get("SHADOW_TPU_BENCH_CPU_SIMSEC", 0.1))
-    rpc = int(os.environ.get("SHADOW_TPU_BENCH_RPC", 16))
+    # 128 rounds/chunk: the tunnel charges a large fixed cost per device
+    # CALL (measured 13-117 ms depending on the day, tools/profile_truth.py)
+    # and the whole bench is only ~20-40 busy rounds — one or two calls
+    # should cover it. The retry ladder drops back to short chunks first
+    # in case a long-running execution trips the tunnel (round-1 crash).
+    rpc = int(os.environ.get("SHADOW_TPU_BENCH_RPC", 128))
 
     if role == "measure":
         print(json.dumps(_measure(num_hosts, sim_sec, rounds_per_chunk=rpc)))
@@ -258,6 +263,7 @@ def main():
     # then progressively smaller worlds. (hosts, sim_sec, rounds_per_chunk)
     ladder = [
         (num_hosts, sim_sec, rpc),
+        (num_hosts, sim_sec, 16),
         (num_hosts // 2, sim_sec, 16),
         (num_hosts // 4, sim_sec, 32),
         (num_hosts // 8, sim_sec, 32),
@@ -341,6 +347,63 @@ def main():
     except Exception as e:  # noqa: BLE001 — report, never die
         base, base_rate = {"error": f"native baseline failed: {e}"}, None
 
+    # ---- host-scaling crossover (round-4 verdict Next #2): the TPU's
+    # per-iteration cost is ~flat in H while the single-core C baseline is
+    # linear in events — measure both at larger worlds to locate the
+    # crossover. Each size runs in a disposable subprocess; failures are
+    # recorded, never fatal. SHADOW_TPU_BENCH_SCALING="" disables. -------
+    scaling = []
+    scaling_sizes = os.environ.get("SHADOW_TPU_BENCH_SCALING", "40960,163840")
+    if tpu_up and main_res and not main_res.get("partial"):
+        for hs in [int(x) for x in scaling_sizes.split(",") if x.strip()]:
+            row = {"hosts": hs}
+            att = _run_attempt(
+                _child_env(
+                    SHADOW_TPU_BENCH_ROLE="measure",
+                    SHADOW_TPU_BENCH_HOSTS=hs,
+                    SHADOW_TPU_BENCH_SIMSEC=sim_sec,
+                    SHADOW_TPU_BENCH_RPC=rpc,
+                ),
+                timeout_s=900,
+            )
+            if att.get("ok"):
+                row["tpu"] = {
+                    k: att["result"][k] for k in ("rate", "wall_s", "events")
+                }
+            elif "partial" in att:
+                row["tpu"] = {"rate": att["partial"]["rate"], "partial": True}
+            else:
+                row["tpu"] = {"error": att.get("error", "?")[:200]}
+            try:
+                r = subprocess.run(
+                    [
+                        sys.executable,
+                        os.path.join(
+                            os.path.dirname(os.path.abspath(__file__)),
+                            "tools", "native_baseline", "run_native_baseline.py",
+                        ),
+                        str(hs),
+                        str(sim_sec),
+                    ],
+                    env=_cpu_env(),
+                    capture_output=True,
+                    text=True,
+                    timeout=900,
+                )
+                nb = json.loads(r.stdout.strip().splitlines()[-1])
+                row["native"] = {
+                    k: nb[k] for k in ("rate", "wall_s", "events")
+                }
+            except Exception as e:  # noqa: BLE001
+                row["native"] = {"error": str(e)[:200]}
+            if "rate" in row.get("tpu", {}) and "rate" in row.get("native", {}):
+                row["tpu_over_native"] = round(
+                    row["tpu"]["rate"] / row["native"]["rate"], 3
+                )
+            scaling.append(row)
+            if "error" in row.get("tpu", {}):
+                break  # don't burn the remaining sizes on a dead tunnel
+
     # optional: the old JAX-on-CPU measurement, for the record only
     cpu_xla = None
     if os.environ.get("SHADOW_TPU_BENCH_CPU_XLA") == "1":
@@ -368,6 +431,7 @@ def main():
                     "config": {"hosts": used[0], "sim_sec": used[1], "rounds_per_chunk": used[2]},
                     "main": main_res,
                     "native_baseline": base,
+                    **({"scaling": scaling} if scaling else {}),
                     **({"cpu_xla": cpu_xla} if cpu_xla else {}),
                     "attempts": [
                         {k: v for k, v in a.items() if k != "result"} for a in attempts_log

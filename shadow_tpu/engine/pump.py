@@ -1,0 +1,625 @@
+"""Packet-pump microscan: break the one-event-per-host-per-iteration bound.
+
+The round engine's iteration count equals the max per-host backlog in a
+window (engine/round.py), and profiling shows busy hosts pop runs of
+10-25 *consecutive packet events* (shaping defer/completion chains and
+in-order data/ACK streams) with the full ~4k-op handler re-dispatched per
+event — the exact economics the reference avoids with its per-host drain
+loop (reference: src/main/host/host.rs:697-752). This stage drains up to
+K such events per host per iteration through three narrowly-conditioned
+vectorized fast paths, each a few hundred ops per step instead of the
+whole handler:
+
+  P1  ingress defer/drop: an unshaped arrival that the rx token-bucket
+      defers (or CoDel drops) — pure netstack arithmetic, no TCP.
+  P2  in-seq data completion at a receiver: ESTABLISHED, no flags beyond
+      ACK, no OOO buffer, no scoreboard, no piggy-backed ACK advance, and
+      the send side fully flushed — effects are rcv_nxt/delivered
+      advance + one ACK out.
+  P3  clean cumulative ACK at a sender: ESTABLISHED, not in recovery, no
+      SACK info, no FIN involvement — effects are snd_una advance, Reno
+      ss/ca step, RTO re-arm, RTT sample, and the send-engine lane loop
+      releasing up to segs_per_flush new segments.
+
+Anything else (handshakes, FINs, RSTs, OOO arrivals, dupacks, recovery,
+timer events, model triggers like "request complete -> respond") falls
+through to the unchanged full handler in the same iteration, so the pump
+is a pure accelerator: the per-host event *sequence* — state updates,
+emissions, draws, sequence numbers, byte counters — is bit-identical to
+running the full handler per event (proven against the independent scalar
+oracle by tests/test_pump.py and the tests/test_cpu_ref_* suites).
+
+Ordering correctness: each microstep re-selects the host's true next
+event by the total-order key, comparing the queue head against a small
+pending-defer FIFO (deferred re-enqueues have monotonically increasing
+ready times per host, so the FIFO stays sorted). This preserves the exact
+scalar interleaving of defers and completions — including CoDel's
+backlog-sensitive decisions. Pump emissions are packets only (delivery
+clamped to the next round); a step that would emit a *local* event (flush
+continuation, timer maintenance) is rejected and left to the full
+handler, so nothing the pump produces can sort before a later pump step.
+
+Models opt in by exposing `pump_spec` (see TcpPumpSpec); the spec's
+`block` hook vetoes steps where the embedding model itself would act on
+the new state (e.g. tgen's request-complete -> respond trigger).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from shadow_tpu import equeue, netstack, rng
+from shadow_tpu.engine.state import EngineConfig, SimState
+from shadow_tpu.events import KIND_PACKET, pack_tie, tie_src_host
+from shadow_tpu.graph.routing import RoutingTables
+from shadow_tpu.netstack import AUX_SHAPED_BIT, AUX_SIZE_MASK
+from shadow_tpu.simtime import TIME_MAX
+from shadow_tpu.transport import tcp as T
+from shadow_tpu.transport.header import (
+    FLAG_ACK,
+    FLAG_FIN,
+    FLAG_RST,
+    FLAG_SYN,
+    LANE_ACK,
+    LANE_FLAGS_LEN,
+    LANE_PORTS,
+    LANE_SACK_E,
+    LANE_SACK_S,
+    LANE_SEQ,
+    LANE_WND,
+    unpack_flags_len,
+    unpack_ports,
+    unwrap32,
+)
+
+_I64_MAX = jnp.iinfo(jnp.int64).max
+
+
+@dataclasses.dataclass(frozen=True)
+class TcpPumpSpec:
+    """Model-side pump contract for models embedding transport/tcp.py.
+
+    get_tcp/set_tcp map between the model-state pytree and its TcpState;
+    `block(mstate, host_id, v2, delivered_delta)` returns hosts where the
+    model would react to the candidate post-event view `v2` (those steps
+    fall back to the full handler); `apply(mstate, take, host_id,
+    delivered_delta)` applies the model's passive per-event bookkeeping
+    (e.g. tgen byte counters) for taken steps.
+    """
+
+    params: T.TcpParams
+    get_tcp: Callable[[Any], T.TcpState]
+    set_tcp: Callable[[Any, T.TcpState], Any]
+    block: Callable[..., jax.Array]
+    apply: Callable[..., Any]
+
+
+def _fifo_peek(f_time, f_tie, f_head, f_cnt):
+    k = f_time.shape[1]
+    oh = jnp.arange(k)[None, :] == f_head[:, None]
+    has = f_head < f_cnt
+    t = jnp.where(
+        has, jnp.sum(jnp.where(oh, f_time, 0), axis=1), TIME_MAX
+    )
+    tie = jnp.where(
+        has, jnp.sum(jnp.where(oh, f_tie, 0), axis=1), _I64_MAX
+    )
+    return has, t, tie, oh
+
+
+def pump_stage(
+    st: SimState,
+    window_end: jax.Array,
+    model,
+    tables: RoutingTables,
+    cfg: EngineConfig,
+    debug_out: "list | None" = None,
+) -> SimState:
+    """Run up to cfg.pump_k pump microsteps per host; see module docstring.
+
+    `debug_out` (eager/tests only): appends per-step mask tallies so
+    rejected classifications can be diagnosed."""
+    spec: TcpPumpSpec = model.pump_spec
+    p = spec.params
+    k = cfg.pump_k
+    h = st.seq.shape[0]
+    host_ids = st.host_id
+    mss = jnp.int64(p.mss)
+    draws = jnp.uint32(model.DRAWS_PER_EVENT)
+    ep = model.PACKET_EMITS
+    stride = jnp.uint32(model.DRAWS_PER_EVENT + ep)
+    nseg = p.segs_per_flush
+
+    q = st.queue
+    net = st.net
+    mstate = st.model
+    ts = spec.get_tcp(mstate)
+    ob = st.outbox
+    o_cap = ob.valid.shape[1]
+    lane_idx_ob = jnp.arange(o_cap)[None, :]
+
+    seq = st.seq
+    rng_counter = st.rng_counter
+    events_handled = st.events_handled
+    packets_sent = st.packets_sent
+    packets_dropped = st.packets_dropped
+    packets_unroutable = st.packets_unroutable
+    min_used = st.min_used_lat
+
+    obv, obd, obt, obtie = ob.valid, ob.dst, ob.time, ob.tie
+    obdata, obaux, obfill, obover = ob.data, ob.aux, ob.fill, ob.overflow
+
+    # pending-defer FIFO (ready times are monotone per host -> sorted)
+    f_time = jnp.full((h, k), TIME_MAX, jnp.int64)
+    f_tie = jnp.full((h, k), _I64_MAX, jnp.int64)
+    f_kind = jnp.zeros((h, k), jnp.int32)
+    f_data = jnp.zeros((h, k, equeue.PAYLOAD_LANES), jnp.int32)
+    f_aux = jnp.zeros((h, k), jnp.int32)
+    f_head = jnp.zeros((h,), jnp.int32)
+    f_cnt = jnp.zeros((h,), jnp.int32)
+
+    alive = jnp.ones((h,), bool)
+    src_node = tables.host_node[host_ids]  # [H]
+
+    for _step in range(k):
+        # ---- select each host's true next event: queue vs defer FIFO ----
+        qv, q_slot = equeue.peek_min(q, alive)
+        fh_has, fh_t, fh_tie, fh_oh = _fifo_peek(f_time, f_tie, f_head, f_cnt)
+        use_f = (
+            alive
+            & fh_has
+            & (
+                ~qv.valid
+                | (fh_t < qv.time)
+                | ((fh_t == qv.time) & (fh_tie < qv.tie))
+            )
+        )
+        ev_valid = alive & (use_f | qv.valid)
+        ev_time = jnp.where(use_f, fh_t, qv.time)
+        ev_valid = ev_valid & (ev_time < window_end)
+        ev_tie = jnp.where(use_f, fh_tie, qv.tie)
+        # explicit int32: jnp.sum promotes int under x64
+        ev_kind = jnp.where(
+            use_f,
+            jnp.sum(jnp.where(fh_oh, f_kind, 0), axis=1).astype(jnp.int32),
+            qv.kind,
+        )
+        ev_data = jnp.where(
+            use_f[:, None],
+            jnp.sum(jnp.where(fh_oh[:, :, None], f_data, 0), axis=1).astype(
+                jnp.int32
+            ),
+            qv.data,
+        )
+        ev_aux = jnp.where(
+            use_f,
+            jnp.sum(jnp.where(fh_oh, f_aux, 0), axis=1).astype(jnp.int32),
+            qv.aux,
+        )
+        ev_src = tie_src_host(ev_tie).astype(jnp.int32)
+        now = ev_time
+
+        is_pkt = ev_valid & (ev_kind == KIND_PACKET)
+        size_in = (ev_aux & AUX_SIZE_MASK).astype(jnp.int64)
+        shaped = (ev_aux & AUX_SHAPED_BIT) != 0
+        loopback = ev_src == host_ids
+        in_bootstrap = ev_time < cfg.bootstrap_end_ns
+
+        # ---- ingress relay/CoDel (tentative; committed only where taken)
+        if cfg.use_netstack:
+            need = (
+                is_pkt & ~shaped & ~loopback & ~in_bootstrap & (net.rx_refill > 0)
+            )
+            ready, rx_tok, rx_last = netstack.tb_depart(
+                net.rx_tokens, net.rx_last, net.rx_refill, ev_time, size_in, need
+            )
+            sojourn = ready - ev_time
+            codel_drop, net_c = netstack.codel_dequeue(net, ready, sojourn, need)
+            keep_in = need & ~codel_drop
+            defer = keep_in & (ready > ev_time)
+            p1_take = is_pkt & ~shaped & (defer | codel_drop)
+            arrived = is_pkt & ~(defer | codel_drop)
+        else:
+            need = jnp.zeros((h,), bool)
+            ready = ev_time
+            codel_drop = jnp.zeros((h,), bool)
+            defer = jnp.zeros((h,), bool)
+            p1_take = jnp.zeros((h,), bool)
+            arrived = is_pkt
+            net_c = net
+
+        # ---- TCP classification on arrived packets ----------------------
+        sport, dport = unpack_ports(ev_data[:, LANE_PORTS])
+        exact = (
+            (ts.st != T.CLOSED)
+            & (ts.st != T.LISTEN)
+            & (ts.lport == dport[:, None])
+            & (ts.rhost == ev_src[:, None])
+            & (ts.rport == sport[:, None])
+        )
+        rx_slot = jnp.argmax(exact, axis=1).astype(jnp.int32)
+        rx_exact = arrived & jnp.any(exact, axis=1)
+        v = T.gather_slot(ts, rx_slot)
+
+        flags, plen = unpack_flags_len(ev_data[:, LANE_FLAGS_LEN])
+        f_ackf = (flags & FLAG_ACK) != 0
+        clean_flags = (
+            f_ackf
+            & ((flags & (FLAG_SYN | FLAG_FIN | FLAG_RST)) == 0)
+        )
+        wnd = ev_data[:, LANE_WND].astype(jnp.int64)
+        abs_seq = unwrap32(v.rcv_nxt, ev_data[:, LANE_SEQ])
+        abs_ack = unwrap32(v.snd_una, ev_data[:, LANE_ACK])
+        sack_present = ev_data[:, LANE_SACK_S] != ev_data[:, LANE_SACK_E]
+
+        sacked_empty = jnp.all(v.sacked[:, :, 0] < 0, axis=1)
+        quiet = (
+            rx_exact
+            & (v.st == T.ESTABLISHED)
+            & clean_flags
+            & (v.rcv_fin < 0)
+            & ~v.fin_sent
+            & ~v.fin_pending
+            # timer-event invariant: nothing for the output pass to re-arm
+            & (v.rto_expire >= v.tev_time)
+        )
+
+        # P2: data at a receiver (in-order, out-of-order — the shaping
+        # relay's closed-form bucket legitimately lets a later packet pass
+        # while an earlier one is deferred, so OOO arrivals are the NORM
+        # in backlogged rounds — or stale duplicate), no piggy-backed ACK
+        # advance, send side fully flushed so the output pass is a proven
+        # no-op. Receive path = the handler's accept/absorb/insert flow.
+        seg_s = abs_seq
+        seg_e = abs_seq + plen.astype(jnp.int64)
+        p2 = (
+            quiet
+            & (plen > 0)
+            & (seg_s <= v.rcv_nxt + p.rcv_wnd)
+            & (abs_ack <= v.snd_una)
+            & (v.snd_end <= v.snd_nxt)
+            & ~v.in_rec
+            & (v.dupacks == 0)
+            & ~sack_present
+            & sacked_empty
+        )
+        acceptable = p2 & (seg_e > v.rcv_nxt)
+        in_order = acceptable & (seg_s <= v.rcv_nxt)
+        ooo_seg = acceptable & ~in_order
+        rcv1 = jnp.where(in_order, seg_e, v.rcv_nxt)
+        rcv1, ooo1 = T._ooo_absorb(rcv1, v.ooo, in_order)
+        ooo1 = T._ooo_insert(ooo1, ooo_seg, seg_s, seg_e)
+        delivered_delta = jnp.where(p2, rcv1 - v.rcv_nxt, 0)
+
+        # P3: pure cumulative ACK advancing snd_una, outside recovery
+        p3 = (
+            quiet
+            & (plen == 0)
+            & ~v.in_rec
+            & (abs_ack > v.snd_una)
+            & (abs_ack <= v.snd_max)
+        )
+
+        # model veto on the candidate outcome (e.g. tgen's respond trigger)
+        v2_delivered = v.delivered + delivered_delta
+        blocked = spec.block(
+            mstate, host_ids, v, v2_delivered, delivered_delta
+        )
+        p2 = p2 & ~blocked
+        p3 = p3 & ~blocked
+
+        # ---- P3 state update + send-engine lane loop ---------------------
+        m_rtt = p3 & v.rtt_pending & (abs_ack >= v.rtt_seq)
+        ss = p3 & (v.cwnd < v.ssthresh)
+        ca = p3 & ~ss
+        acked = jnp.where(p3, abs_ack - v.snd_una, 0)
+        cwnd1 = jnp.where(ss, v.cwnd + jnp.minimum(acked, mss), v.cwnd)
+        cwnd1 = jnp.where(
+            ca, cwnd1 + jnp.maximum((mss * mss) // jnp.maximum(cwnd1, 1), 1), cwnd1
+        )
+        una1 = jnp.where(p3, abs_ack, v.snd_una)
+        nxt1 = jnp.where(p3, jnp.maximum(v.snd_nxt, abs_ack), v.snd_nxt)
+        outstanding = una1 < v.snd_max
+        expire1 = jnp.where(
+            p3, jnp.where(outstanding, now + v.rto, TIME_MAX), v.rto_expire
+        )
+        # sender-side SACK scoreboard: merge the advertised block (unwrap
+        # relative to the post-advance snd_una), drop ranges the cumulative
+        # ACK covers — the handler's exact sequence for a valid_ack
+        if p.use_sack:
+            has_sack = p3 & sack_present
+            abs_ss = unwrap32(una1, ev_data[:, LANE_SACK_S])
+            abs_se = unwrap32(una1, ev_data[:, LANE_SACK_E])
+            sacked1 = T._ooo_insert(v.sacked, has_sack, abs_ss, abs_se)
+            dropm = (
+                p3[:, None]
+                & (sacked1[:, :, 0] >= 0)
+                & (sacked1[:, :, 1] <= una1[:, None])
+            )
+            sacked2 = jnp.where(dropm[:, :, None], jnp.int64(-1), sacked1)
+        else:
+            sacked2 = v.sacked
+        v2 = v.replace(
+            snd_una=una1,
+            snd_nxt=nxt1,
+            cwnd=cwnd1,
+            dupacks=jnp.where(p3, 0, v.dupacks),
+            backoff=jnp.where(p3, 0, v.backoff),
+            rto_expire=expire1,
+            peer_wnd=jnp.where(p2 | p3, wnd, v.peer_wnd),
+            rcv_nxt=rcv1,
+            ooo=ooo1,
+            sacked=sacked2,
+            delivered=v.delivered + delivered_delta,
+            segs_in=v.segs_in + (p2 | p3),
+        )
+        v2 = T._rtt_update(v2, m_rtt, now - v2.rtt_ts, p)
+
+        # send engine (the handler's lane loop with rtx_hole/SYN/FIN lanes
+        # provably inactive under the P3 conditions)
+        wnd_lim = v2.snd_una + jnp.minimum(v2.cwnd, v2.peer_wnd)
+        cursor = v2.snd_nxt
+        can_send = p3
+        new_rtt_pending = v2.rtt_pending
+        new_rtt_seq = v2.rtt_seq
+        new_rtt_ts = v2.rtt_ts
+        sent_any = jnp.zeros((h,), bool)
+        rtx_count = jnp.zeros((h,), jnp.int64)
+        lane_valid = []
+        lane_seq_w = []
+        lane_len = []
+        for _i in range(nseg):
+            room = jnp.minimum(jnp.minimum(v2.snd_end, wnd_lim), cursor + mss)
+            dlen = jnp.maximum(room - cursor, 0)
+            send_data = can_send & (dlen > 0)
+            lane_valid.append(send_data)
+            lane_seq_w.append(cursor)
+            lane_len.append(jnp.where(send_data, dlen, 0).astype(jnp.int32))
+            is_rtx = send_data & (cursor < v2.snd_max)
+            rtx_count = rtx_count + is_rtx
+            fresh = send_data & (cursor >= v2.snd_max)
+            start_rtt = fresh & ~new_rtt_pending
+            new_rtt_pending = new_rtt_pending | start_rtt
+            new_rtt_seq = jnp.where(start_rtt, cursor + dlen, new_rtt_seq)
+            new_rtt_ts = jnp.where(start_rtt, now, new_rtt_ts)
+            cursor = cursor + jnp.where(send_data, dlen, 0)
+            sent_any = sent_any | send_data
+        new_nxt = jnp.where(can_send, jnp.maximum(v2.snd_nxt, cursor), v2.snd_nxt)
+        new_max = jnp.maximum(v2.snd_max, new_nxt)
+        arm = p3 & (v2.snd_una < new_max) & (v2.rto_expire >= TIME_MAX) & sent_any
+        new_expire = jnp.where(arm, now + v2.rto, v2.rto_expire)
+        more = can_send & (jnp.minimum(v2.snd_end, wnd_lim) > cursor)
+        need_tev = (p2 | p3) & (new_expire < v2.tev_time)
+        # a step that would emit a local event falls back to the handler
+        p3 = p3 & ~more & ~need_tev
+        p2 = p2 & ~need_tev
+
+        take_tcp = p2 | p3
+        take = p1_take | take_tcp
+        if debug_out is not None:
+            q_ = quiet
+            debug_out.append(
+                {
+                    k_: int(jnp.sum(v_))
+                    for k_, v_ in dict(
+                        ev_valid=ev_valid, is_pkt=is_pkt, shaped=shaped & ev_valid,
+                        p1=p1_take, arrived=arrived, rx_exact=rx_exact,
+                        quiet=quiet, p2=p2, p3=p3, blocked=blocked & arrived,
+                        more=more & arrived, need_tev=need_tev,
+                        take=take, use_f=use_f,
+                        d_len=q_ & (plen > 0),
+                        d_inorder=q_ & (abs_seq <= v.rcv_nxt),
+                        d_ackle=q_ & (abs_ack <= v.snd_una),
+                        d_flushed=q_ & (v.snd_end <= v.snd_nxt),
+                        d_norec=q_ & ~v.in_rec,
+                        d_dup0=q_ & (v.dupacks == 0),
+                        d_ackadv=q_ & (abs_ack > v.snd_una),
+                        d_ackmax=q_ & (abs_ack <= v.snd_max),
+                    ).items()
+                }
+            )
+        # consume the event from its source
+        q = equeue.clear_slot(q, q_slot, take & ~use_f)
+        f_head = f_head + (take & use_f).astype(jnp.int32)
+
+        # ---- commit netstack state -------------------------------------
+        if cfg.use_netstack:
+            commit_n = take & need
+            net = net.replace(
+                rx_tokens=jnp.where(commit_n & keep_in, rx_tok, net.rx_tokens),
+                rx_last=jnp.where(commit_n & keep_in, rx_last, net.rx_last),
+                codel_first_above=jnp.where(
+                    commit_n, net_c.codel_first_above, net.codel_first_above
+                ),
+                codel_drop_next=jnp.where(
+                    commit_n, net_c.codel_drop_next, net.codel_drop_next
+                ),
+                codel_count=jnp.where(
+                    commit_n, net_c.codel_count, net.codel_count
+                ),
+                codel_dropping=jnp.where(
+                    commit_n, net_c.codel_dropping, net.codel_dropping
+                ),
+                codel_dropped=net.codel_dropped + (commit_n & codel_drop),
+                rx_backlog_bytes=net.rx_backlog_bytes
+                + jnp.where(take & defer, size_in, 0)
+                - jnp.where(take_tcp & shaped, size_in, 0),
+                bytes_recv=net.bytes_recv + jnp.where(take_tcp, size_in, 0),
+            )
+            # deferred re-enqueue -> FIFO (ready is monotone per host)
+            ins = take & defer
+            ins_oh = (jnp.arange(k)[None, :] == f_cnt[:, None]) & ins[:, None]
+            f_time = jnp.where(ins_oh, ready[:, None], f_time)
+            f_tie = jnp.where(ins_oh, ev_tie[:, None], f_tie)
+            f_kind = jnp.where(ins_oh, ev_kind[:, None], f_kind)
+            f_data = jnp.where(ins_oh[:, :, None], ev_data[:, None, :], f_data)
+            f_aux = jnp.where(
+                ins_oh,
+                (size_in.astype(jnp.int32) | jnp.int32(AUX_SHAPED_BIT))[:, None],
+                f_aux,
+            )
+            f_cnt = f_cnt + ins.astype(jnp.int32)
+
+        # ---- commit TCP state ------------------------------------------
+        v2 = v2.replace(
+            snd_nxt=jnp.where(p3, new_nxt, v2.snd_nxt),
+            snd_max=jnp.where(p3, new_max, v2.snd_max),
+            rtt_pending=jnp.where(p3, new_rtt_pending, v2.rtt_pending),
+            rtt_seq=jnp.where(p3, new_rtt_seq, v2.rtt_seq),
+            rtt_ts=jnp.where(p3, new_rtt_ts, v2.rtt_ts),
+            rto_expire=jnp.where(p3, new_expire, v2.rto_expire),
+            retransmits=v2.retransmits + jnp.where(p3, rtx_count, 0),
+            # data lanes only — the handler's segs_out counts pv[:, :nseg],
+            # never the control-lane ACK
+            segs_out=v2.segs_out
+            + jnp.where(p3, sum(lv.astype(jnp.int64) for lv in lane_valid), 0),
+        )
+        ts = T.scatter_slot(ts, rx_slot, take_tcp, v2)
+        mstate = spec.apply(mstate, take_tcp, host_ids, delivered_delta)
+
+        # ---- emissions: P3 data lanes + P2 ACK, in handler lane order ---
+        dst = jnp.clip(v2.rhost, 0, tables.num_global_hosts - 1)
+        dst_node = tables.host_node[dst]
+        lat = tables.lat_ns[src_node, dst_node]
+        rel = tables.rel[src_node, dst_node]
+        loopb = dst == host_ids
+        in_btx = now < cfg.bootstrap_end_ns
+
+        # lane emissions: indices 0..nseg-1 = P3 data, index nseg = P2 ACK.
+        # The ACK advertises the lowest buffered out-of-order range,
+        # exactly like the handler's control lane.
+        if p.use_sack:
+            starts = v2.ooo[:, :, 0]
+            present = starts >= 0
+            min_start = jnp.min(
+                jnp.where(present, starts, jnp.int64(1) << 62), axis=1
+            )
+            at_min = present & (starts == min_start[:, None])
+            blk_e = jnp.max(
+                jnp.where(at_min, v2.ooo[:, :, 1], jnp.int64(-1)), axis=1
+            )
+            has_blk = jnp.any(present, axis=1)
+            sack_s = jnp.where(has_blk, min_start, jnp.int64(0))
+            sack_e = jnp.where(has_blk, blk_e, jnp.int64(0))
+        else:
+            sack_s = sack_e = jnp.zeros((h,), jnp.int64)
+        ack_data = T._mk_seg(
+            v2.lport,
+            v2.rport,
+            v2.snd_nxt,
+            v2.rcv_nxt,
+            jnp.full((h,), FLAG_ACK, jnp.int32),
+            jnp.zeros((h,), jnp.int32),
+            jnp.full((h,), p.rcv_wnd, jnp.int64),
+            sack_s=sack_s,
+            sack_e=sack_e,
+        )
+
+        tx_tok, tx_last = net.tx_tokens, net.tx_last
+        new_seq = seq
+        for lane in range(nseg + 1):
+            if lane < nseg:
+                lv = lane_valid[lane] & p3
+                ldata = T._mk_seg(
+                    v2.lport,
+                    v2.rport,
+                    lane_seq_w[lane],
+                    v2.rcv_nxt,
+                    jnp.full((h,), FLAG_ACK, jnp.int32),
+                    lane_len[lane],
+                    jnp.full((h,), p.rcv_wnd, jnp.int64),
+                )
+                lsize = lane_len[lane] + p.header_bytes
+            else:
+                lv = p2
+                ldata = ack_data
+                lsize = jnp.full((h,), p.header_bytes, jnp.int32)
+            unroutable = lv & (lat >= TIME_MAX)
+            loss_u = rng.uniform_f32(
+                st.rng_key, rng_counter + draws + jnp.uint32(lane)
+            )
+            kept = lv & ~unroutable & (loss_u < rel)
+            dropped = lv & ~unroutable & ~(loss_u < rel)
+            if cfg.use_netstack:
+                charge = (lv & ~unroutable) & ~loopb & ~in_btx
+                dep, tx_tok, tx_last = netstack.tb_depart(
+                    tx_tok, tx_last, net.tx_refill, now, lsize.astype(jnp.int64),
+                    charge,
+                )
+                deliver = jnp.maximum(dep + lat, window_end)
+                net = net.replace(
+                    bytes_sent=net.bytes_sent
+                    + jnp.where(kept, lsize.astype(jnp.int64), 0)
+                )
+            else:
+                deliver = jnp.maximum(now + lat, window_end)
+            # outbox append
+            has_room = obfill < o_cap
+            write = kept & has_room
+            at = (lane_idx_ob == obfill[:, None]) & write[:, None]
+            ptie = pack_tie(
+                jnp.full((h,), KIND_PACKET, jnp.int32),
+                host_ids,
+                new_seq.astype(jnp.uint32),
+            )
+            obv = obv | at
+            obd = jnp.where(at, dst[:, None], obd)
+            obt = jnp.where(at, deliver[:, None], obt)
+            obtie = jnp.where(at, ptie[:, None], obtie)
+            obdata = jnp.where(at[:, :, None], ldata[:, None, :], obdata)
+            obaux = jnp.where(at, (lsize & AUX_SIZE_MASK)[:, None], obaux)
+            obfill = obfill + write.astype(jnp.int32)
+            obover = obover + (kept & ~has_room).astype(jnp.int32)
+            new_seq = new_seq + kept.astype(jnp.uint32)
+            packets_sent = packets_sent + kept
+            packets_dropped = packets_dropped + dropped
+            packets_unroutable = packets_unroutable + unroutable
+            if cfg.use_dynamic_runahead:
+                cross = (dst != host_ids) & kept & (lat < TIME_MAX)
+                min_used = jnp.minimum(
+                    min_used, jnp.min(jnp.where(cross, lat, TIME_MAX))
+                )
+        if cfg.use_netstack:
+            net = net.replace(tx_tokens=tx_tok, tx_last=tx_last)
+        seq = new_seq
+
+        events_handled = events_handled + take_tcp
+        rng_counter = rng_counter + stride * take_tcp.astype(jnp.uint32)
+        alive = alive & take
+
+    # flush remaining pending defers into the queue (one batched push)
+    lanes_live = (jnp.arange(k)[None, :] >= f_head[:, None]) & (
+        jnp.arange(k)[None, :] < f_cnt[:, None]
+    )
+    q = equeue.push_self_lanes(
+        q,
+        valid=lanes_live,
+        time=f_time,
+        tie=f_tie,
+        kind=f_kind,
+        data=f_data,
+        aux=f_aux,
+    )
+
+    ob = ob.replace(
+        valid=obv, dst=obd, time=obt, tie=obtie, data=obdata, aux=obaux,
+        fill=obfill, overflow=obover,
+    )
+    mstate = spec.set_tcp(mstate, ts)
+    return st.replace(
+        queue=q,
+        net=net,
+        model=mstate,
+        outbox=ob,
+        seq=seq,
+        rng_counter=rng_counter,
+        events_handled=events_handled,
+        packets_sent=packets_sent,
+        packets_dropped=packets_dropped,
+        packets_unroutable=packets_unroutable,
+        min_used_lat=min_used,
+    )

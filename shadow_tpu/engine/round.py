@@ -107,6 +107,17 @@ def handle_one_iteration(
     `tables.host_node` is the replicated global host->node map, so packet
     destinations are global host ids everywhere.
     """
+    if (
+        cfg.pump_k > 0
+        and getattr(model, "pump_spec", None) is not None
+        and getattr(model, "LOSS_COUNTER_LANE", None) is None
+        and not hasattr(model, "on_packet_outcomes")
+        and not hasattr(model, "on_codel_drop")
+    ):
+        from shadow_tpu.engine.pump import pump_stage
+
+        st = pump_stage(st, window_end, model, tables, cfg)
+
     host_ids = st.host_id
 
     want = equeue.next_time(st.queue) < window_end
@@ -383,6 +394,32 @@ def flush_outbox(
     Either way the destination pops by the (time, tie) key, so delivery
     slot order — which differs between the modes — cannot affect results.
     """
+    ob = st.outbox
+    h_local, o_cap = ob.valid.shape
+    m = h_local * o_cap
+
+    # Empty rounds skip the exchange sorts entirely (lax.cond on a scalar
+    # any-reduce). Sharded: the predicate is made mesh-uniform with a
+    # psum, because the all_to_all/all_gather inside must be entered by
+    # every shard or none.
+    has_traffic = jnp.any(ob.valid)
+    if axis_name is not None:
+        has_traffic = (
+            jax.lax.psum(has_traffic.astype(jnp.int32), axis_name) > 0
+        )
+
+    def _skip(st):
+        return st
+
+    def _do_flush(st):
+        return _flush_outbox_traffic(st, axis_name, cfg)
+
+    return jax.lax.cond(has_traffic, _do_flush, _skip, st)
+
+
+def _flush_outbox_traffic(
+    st: SimState, axis_name: Optional[str], cfg: "EngineConfig | None" = None
+) -> SimState:
     ob = st.outbox
     h_local, o_cap = ob.valid.shape
     m = h_local * o_cap

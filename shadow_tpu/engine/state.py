@@ -83,6 +83,12 @@ class EngineConfig:
     # conservative window, so subset scheduling cannot reorder any host's
     # event sequence.
     active_lanes: int = 0
+    # Packet-pump microscan (engine/pump.py): drain up to pump_k
+    # consecutive pump-class events per host per iteration through
+    # vectorized fast paths before the full handler runs. 0 = off.
+    # Requires the model to expose `pump_spec`; results are bit-identical
+    # to the unpumped engine (tests/test_pump.py).
+    pump_k: int = 0
     # draws consumed per handled event = model.DRAWS_PER_EVENT + PACKET_EMITS
     # (one loss draw per packet lane), fixed-stride for determinism.
 

@@ -96,17 +96,12 @@ class Popped:
         return tie_src_host(self.tie).astype(jnp.int32)
 
 
-def pop_min(q: EventQueue, want: jax.Array) -> tuple[Popped, EventQueue]:
-    """Pop each host's minimum event where `want[h]` and the host is non-empty.
-
-    Ordering follows the reference's total order: min by time, ties broken by
-    the packed (variant, src_host, seq) key (event.rs:104-155). The freed
-    slot becomes a tombstone (time=TIME_MAX): rows are NOT kept compact —
-    pushes fill free slots by rank over the free mask — so a pop only
-    rewrites the two key arrays instead of back-filling all five
-    (data alone is [H, Q, 8] i32, the single biggest traffic term of the
-    per-iteration cost at bench scale).
-    """
+def peek_min(q: EventQueue, want: jax.Array) -> tuple[Popped, jax.Array]:
+    """Read each host's minimum event where `want[h]` and the host is
+    non-empty, WITHOUT removing it. Returns (event, slot); pass the slot
+    to clear_slot to consume. Ordering follows the reference's total
+    order: min by time, ties broken by the packed (variant, src_host,
+    seq) key (event.rs:104-155)."""
     tmin = q.head_time  # [H]
     at_min = q.time == tmin[:, None]
     tie_masked = jnp.where(at_min, q.tie, _I64_MAX)
@@ -134,16 +129,33 @@ def pop_min(q: EventQueue, want: jax.Array) -> tuple[Popped, EventQueue]:
         data=pick(q.data),
         aux=pick(q.aux),
     )
+    return ev, slot
 
+
+def clear_slot(q: EventQueue, slot: jax.Array, mask: jax.Array) -> EventQueue:
+    """Tombstone q[h, slot[h]] where mask[h] (the consume half of a
+    peek_min/clear_slot pop; see pop_min). Only the two key arrays are
+    rewritten; kind/data/aux stay as stale slot contents."""
     slot_idx = jnp.arange(q.capacity)[None, :]
-    clear = (slot_idx == sl1) & valid[:, None]
+    clear = (slot_idx == slot[:, None]) & mask[:, None]
     new_time = jnp.where(clear, TIME_MAX, q.time)
-    return ev, q.replace(
+    return q.replace(
         time=new_time,
         tie=jnp.where(clear, _I64_MAX, q.tie),
-        count=q.count - valid.astype(jnp.int32),
+        count=q.count - mask.astype(jnp.int32),
         head_time=jnp.min(new_time, axis=1),
     )
+
+
+def pop_min(q: EventQueue, want: jax.Array) -> tuple[Popped, EventQueue]:
+    """Pop each host's minimum event where `want[h]` and the host is
+    non-empty (peek_min + clear_slot fused). The freed slot becomes a
+    tombstone (time=TIME_MAX): rows are NOT kept compact — pushes fill
+    free slots by rank over the free mask — so a pop only rewrites the
+    two key arrays instead of back-filling all five (data alone is
+    [H, Q, 8] i32, the single biggest traffic term at bench scale)."""
+    ev, slot = peek_min(q, want)
+    return ev, clear_slot(q, slot, ev.valid)
 
 
 def push_self(

@@ -94,6 +94,51 @@ class TgenModel:
         )
         return is_client, is_server
 
+    @property
+    def pump_spec(self):
+        """Opt in to the engine's packet-pump microscan (engine/pump.py).
+
+        block: the request-complete -> respond trigger (m_resp in handle)
+        re-checks on EVERY event touching an established server slot, so
+        any candidate state where it would fire must reach the full
+        handler. apply: the client download byte counter (the only
+        passive per-event model bookkeeping on pump-eligible events).
+        """
+        from shadow_tpu.engine.pump import TcpPumpSpec
+
+        req = self.req_bytes
+        nc, ns = self.num_clients, self.num_servers
+
+        def get_tcp(ms):
+            return ms.tcp
+
+        def set_tcp(ms, ts):
+            return ms.replace(tcp=ts)
+
+        def block(ms, host_id, v, delivered_new, delta):
+            is_server = (host_id >= nc) & (host_id < nc + ns)
+            return (
+                is_server
+                & (v.st == tcp.ESTABLISHED)
+                & (delivered_new >= req)
+                & (v.snd_end == 1)
+            )
+
+        def apply(ms, take, host_id, delta):
+            is_client = host_id < nc
+            return ms.replace(
+                bytes_down=ms.bytes_down
+                + jnp.where(is_client & take, delta, 0)
+            )
+
+        return TcpPumpSpec(
+            params=self.tcp_params,
+            get_tcp=get_tcp,
+            set_tcp=set_tcp,
+            block=block,
+            apply=apply,
+        )
+
     def init(self) -> TgenState:
         h = self.num_hosts
         ts = tcp.create(h, self.tcp_params)

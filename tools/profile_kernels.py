@@ -1,0 +1,56 @@
+"""Kernel-count vs width: compile the iteration body at several host
+widths on the live backend, print optimized-HLO fusion/kernel counts and
+fresh-input timings. If time is ~flat in width while kernel count is
+constant, the body is launch-bound and the lever is fewer kernels.
+
+  python tools/profile_kernels.py [reps]
+"""
+
+import re
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def main():
+    reps = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+
+    import jax
+    import jax.numpy as jnp
+
+    from bench import _build
+    from shadow_tpu.engine.round import handle_one_iteration
+
+    we = jnp.asarray(10**15, jnp.int64)
+    out = {}
+    for hosts in (1280, 10240):
+        cfg, model, tables, st0 = _build(hosts)
+        f = jax.jit(lambda s: handle_one_iteration(s, we, model, tables, cfg))
+        lowered = f.lower(st0)
+        compiled = lowered.compile()
+        txt = compiled.as_text()
+        kernels = len(re.findall(r"^\s*(fusion|%fusion)", txt, re.M))
+        ops = txt.count("\n")
+        # fresh-input timing
+        st = f(st0)
+        jax.block_until_ready(st.events_handled)
+        ts = []
+        for r in range(reps):
+            s_in = st0.replace(rng_counter=st0.rng_counter + r + 1)
+            jax.block_until_ready(s_in.rng_counter)
+            t0 = time.perf_counter()
+            o = f(s_in)
+            jax.block_until_ready(o.events_handled)
+            ts.append(time.perf_counter() - t0)
+        out[hosts] = {
+            "fusions": kernels,
+            "hlo_lines": ops,
+            "best_ms": round(min(ts) * 1e3, 2),
+        }
+        print(hosts, out[hosts], flush=True)
+    print(out, flush=True)
+
+
+if __name__ == "__main__":
+    main()
