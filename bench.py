@@ -63,14 +63,16 @@ def _device_probe_ok(timeout_s: int = 90) -> bool:
         return False
 
 
-def _build(num_hosts: int, seed: int = 7):
+def _build_world(num_hosts: int, seed: int = 7):
+    """The bench WORLD only (graph, routing tables, config, model) — no
+    device state. The native-C baseline consumes exactly this (it needs
+    the lat/rel tables and config scalars, never the [H, Q] JAX arrays,
+    which at 160k+ hosts are multi-GB allocations)."""
     import random
 
-    from shadow_tpu.engine import EngineConfig, init_state
-    from shadow_tpu.engine.round import bootstrap
+    from shadow_tpu.engine import EngineConfig
     from shadow_tpu.graph import NetworkGraph, compute_routing
     from shadow_tpu.models.tgen import TgenModel
-    from shadow_tpu.netstack import bw_bits_per_sec_to_refill
     from shadow_tpu.simtime import NS_PER_MS
 
     rng_py = random.Random(seed)
@@ -112,6 +114,11 @@ def _build(num_hosts: int, seed: int = 7):
         # free: the next window re-opens over the leftovers and per-host
         # pop order is unchanged.
         max_iters_per_round=256,
+        # packet-pump microscan (engine/pump.py): drain up to 8
+        # consecutive packet events per host per iteration; bit-identical
+        # to the unpumped engine (tests/test_pump.py), ~5x fewer
+        # iterations on this workload's defer/data/ACK chains.
+        pump_k=int(os.environ.get("SHADOW_TPU_BENCH_PUMP_K", 8)),
     )
     model = TgenModel(
         num_hosts=num_hosts,
@@ -120,6 +127,15 @@ def _build(num_hosts: int, seed: int = 7):
         resp_bytes=100_000,
         pause_ns=500 * NS_PER_MS,
     )
+    return cfg, model, tables
+
+
+def _build(num_hosts: int, seed: int = 7):
+    from shadow_tpu.engine import init_state
+    from shadow_tpu.engine.round import bootstrap
+    from shadow_tpu.netstack import bw_bits_per_sec_to_refill
+
+    cfg, model, tables = _build_world(num_hosts, seed)
     bw = bw_bits_per_sec_to_refill(HOST_BW_BITS)
     st = init_state(cfg, model.init(), tx_bytes_per_interval=bw, rx_bytes_per_interval=bw)
     st = bootstrap(st, model, cfg)

@@ -300,14 +300,22 @@ struct TidMapEnt {
 };
 static struct TidMapEnt g_tid_map[TID_MAP_MAX];
 
+/* slot claimed but rtid not yet stored — never matches a real vtid */
+#define TID_MAP_RESERVED ((int64_t)-1)
+
 static void tid_map_add(int64_t vtid, int rtid) {
     if (!vtid)
         return;
     for (int i = 0; i < TID_MAP_MAX; i++) {
         int64_t zero = 0;
-        if (__atomic_compare_exchange_n(&g_tid_map[i].vtid, &zero, vtid, 0,
+        /* claim with a sentinel, store rtid, then release-publish the
+         * real vtid — a concurrent tid_map_find can never observe the
+         * entry with rtid still unset (round-4 advisor) */
+        if (__atomic_compare_exchange_n(&g_tid_map[i].vtid, &zero,
+                                        TID_MAP_RESERVED, 0,
                                         __ATOMIC_ACQ_REL, __ATOMIC_RELAXED)) {
             g_tid_map[i].rtid = rtid;
+            __atomic_store_n(&g_tid_map[i].vtid, vtid, __ATOMIC_RELEASE);
             return;
         }
     }

@@ -12,16 +12,22 @@ whole handler:
 
   P1  ingress defer/drop: an unshaped arrival that the rx token-bucket
       defers (or CoDel drops) — pure netstack arithmetic, no TCP.
-  P2  in-seq data completion at a receiver: ESTABLISHED, no flags beyond
-      ACK, no OOO buffer, no scoreboard, no piggy-backed ACK advance, and
-      the send side fully flushed — effects are rcv_nxt/delivered
-      advance + one ACK out.
-  P3  clean cumulative ACK at a sender: ESTABLISHED, not in recovery, no
-      SACK info, no FIN involvement — effects are snd_una advance, Reno
-      ss/ca step, RTO re-arm, RTT sample, and the send-engine lane loop
-      releasing up to segs_per_flush new segments.
+  P2  data completion at a receiver: ESTABLISHED, no flags beyond ACK,
+      no piggy-backed ACK advance, empty sender-side scoreboard, send
+      side fully flushed and no FIN pending. In-order AND out-of-order
+      arrivals qualify (the shaping relay's closed-form bucket
+      legitimately lets a later packet pass while an earlier one is
+      deferred, so OOO is the NORM in backlogged rounds): effects are
+      the handler's accept/absorb/insert receive flow plus one ACK out
+      advertising the lowest buffered OOO range (SACK).
+  P3  cumulative ACK at a sender: ESTABLISHED, advancing snd_una, not
+      in recovery, FIN not yet sent — effects are snd_una advance, Reno
+      ss/ca step, RTO re-arm, RTT sample, SACK scoreboard merge/drop,
+      and the send-engine lane loop releasing up to segs_per_flush new
+      segments (including the FIN-after-data lane: tgen-style servers
+      run their whole response with fin_pending set).
 
-Anything else (handshakes, FINs, RSTs, OOO arrivals, dupacks, recovery,
+Anything else (handshakes, FIN/RST arrivals, dupacks, recovery,
 timer events, model triggers like "request complete -> respond") falls
 through to the unchanged full handler in the same iteration, so the pump
 is a pure accelerator: the per-host event *sequence* — state updates,
@@ -84,11 +90,12 @@ class TcpPumpSpec:
     """Model-side pump contract for models embedding transport/tcp.py.
 
     get_tcp/set_tcp map between the model-state pytree and its TcpState;
-    `block(mstate, host_id, v2, delivered_delta)` returns hosts where the
-    model would react to the candidate post-event view `v2` (those steps
-    fall back to the full handler); `apply(mstate, take, host_id,
-    delivered_delta)` applies the model's passive per-event bookkeeping
-    (e.g. tgen byte counters) for taken steps.
+    `block(mstate, host_id, v_st, v_snd_end, delivered_new, delta)`
+    returns hosts where the model would react to the candidate post-event
+    slot state (those steps fall back to the full handler);
+    `apply(mstate, take, host_id, delivered_delta)` applies the model's
+    passive per-event bookkeeping (e.g. tgen byte counters) for taken
+    steps.
     """
 
     params: T.TcpParams
@@ -111,6 +118,7 @@ def _fifo_peek(f_time, f_tie, f_head, f_cnt):
     return has, t, tie, oh
 
 
+
 def pump_stage(
     st: SimState,
     window_end: jax.Array,
@@ -118,11 +126,21 @@ def pump_stage(
     tables: RoutingTables,
     cfg: EngineConfig,
     debug_out: "list | None" = None,
-) -> SimState:
-    """Run up to cfg.pump_k pump microsteps per host; see module docstring.
+) -> tuple[SimState, jax.Array]:
+    """Run up to cfg.pump_k pump microsteps per host.
 
-    `debug_out` (eager/tests only): appends per-step mask tallies so
-    rejected classifications can be diagnosed."""
+    Returns (state, any_rejected): any_rejected is True when some host's
+    eligible head event failed classification this call — only then does
+    the caller need to run the full handler this iteration (hosts whose
+    chains simply exceeded pump_k keep pumping next iteration).
+
+    Cost shape: every per-step update is elementwise over [H] or [H, S]
+    with a slot-one-hot mask — no gather/scatter of the TcpState (the
+    round-5 first cut gathered/scattered a fused view per step, which was
+    ~720 of ~2900 eqns per step). Emission token-bucket charges use the
+    closed-form multi-lane tb (netstack.tb_depart_lanes). `debug_out`
+    (eager/tests only) collects per-step mask tallies.
+    """
     spec: TcpPumpSpec = model.pump_spec
     p = spec.params
     k = cfg.pump_k
@@ -163,21 +181,30 @@ def pump_stage(
     f_cnt = jnp.zeros((h,), jnp.int32)
 
     alive = jnp.ones((h,), bool)
+    rejected = jnp.zeros((h,), bool)
     src_node = tables.host_node[host_ids]  # [H]
 
     for _step in range(k):
-        # ---- select each host's true next event: queue vs defer FIFO ----
+        # ---- select each host's true next event: queue vs defer FIFO
+        # (the FIFO exists only under shaping; without the netstack no
+        # defer can ever be inserted, so the select is queue-only) ----
         qv, q_slot = equeue.peek_min(q, alive)
-        fh_has, fh_t, fh_tie, fh_oh = _fifo_peek(f_time, f_tie, f_head, f_cnt)
-        use_f = (
-            alive
-            & fh_has
-            & (
-                ~qv.valid
-                | (fh_t < qv.time)
-                | ((fh_t == qv.time) & (fh_tie < qv.tie))
+        if cfg.use_netstack:
+            fh_has, fh_t, fh_tie, fh_oh = _fifo_peek(f_time, f_tie, f_head, f_cnt)
+            use_f = (
+                alive
+                & fh_has
+                & (
+                    ~qv.valid
+                    | (fh_t < qv.time)
+                    | ((fh_t == qv.time) & (fh_tie < qv.tie))
+                )
             )
-        )
+        else:
+            use_f = jnp.zeros((h,), bool)
+            fh_t = jnp.full((h,), TIME_MAX, jnp.int64)
+            fh_tie = jnp.full((h,), _I64_MAX, jnp.int64)
+            fh_oh = jnp.zeros((h, k), bool)
         ev_valid = alive & (use_f | qv.valid)
         ev_time = jnp.where(use_f, fh_t, qv.time)
         ev_valid = ev_valid & (ev_time < window_end)
@@ -233,6 +260,9 @@ def pump_stage(
             net_c = net
 
         # ---- TCP classification on arrived packets ----------------------
+        # `oh` is the event's slot as a one-hot over [H, S]; every state
+        # read is a masked reduction, every write a masked where — the
+        # TcpState never round-trips through a gathered view.
         sport, dport = unpack_ports(ev_data[:, LANE_PORTS])
         exact = (
             (ts.st != T.CLOSED)
@@ -241,100 +271,150 @@ def pump_stage(
             & (ts.rhost == ev_src[:, None])
             & (ts.rport == sport[:, None])
         )
-        rx_slot = jnp.argmax(exact, axis=1).astype(jnp.int32)
         rx_exact = arrived & jnp.any(exact, axis=1)
-        v = T.gather_slot(ts, rx_slot)
+        oh = exact & arrived[:, None]  # [H, S] one-hot (zero row if none)
+
+        def rd(a):
+            if a.dtype == jnp.bool_:
+                return jnp.any(oh & a, axis=1)
+            return jnp.sum(jnp.where(oh, a, 0), axis=1).astype(a.dtype)
+
+        def rd4(a):  # [H, S, R, 2] -> [H, R, 2]
+            o4 = oh[:, :, None, None]
+            return jnp.sum(jnp.where(o4, a, 0), axis=1).astype(a.dtype)
+
+        v_st = rd(ts.st)
+        v_lport = rd(ts.lport)
+        v_rport = rd(ts.rport)
+        v_rhost = rd(ts.rhost)
+        v_snd_una = rd(ts.snd_una)
+        v_snd_nxt = rd(ts.snd_nxt)
+        v_snd_max = rd(ts.snd_max)
+        v_snd_end = rd(ts.snd_end)
+        v_fin_pending = rd(ts.fin_pending)
+        v_fin_sent = rd(ts.fin_sent)
+        v_rcv_nxt = rd(ts.rcv_nxt)
+        v_rcv_fin = rd(ts.rcv_fin)
+        v_cwnd = rd(ts.cwnd)
+        v_ssthresh = rd(ts.ssthresh)
+        v_dupacks = rd(ts.dupacks)
+        v_in_rec = rd(ts.in_rec)
+        v_srtt = rd(ts.srtt)
+        v_rttvar = rd(ts.rttvar)
+        v_rto = rd(ts.rto)
+        v_rtt_pending = rd(ts.rtt_pending)
+        v_rtt_seq = rd(ts.rtt_seq)
+        v_rtt_ts = rd(ts.rtt_ts)
+        v_rto_expire = rd(ts.rto_expire)
+        v_tev_time = rd(ts.tev_time)
+        v_ooo = rd4(ts.ooo)
+        v_sacked = rd4(ts.sacked)
 
         flags, plen = unpack_flags_len(ev_data[:, LANE_FLAGS_LEN])
         f_ackf = (flags & FLAG_ACK) != 0
-        clean_flags = (
-            f_ackf
-            & ((flags & (FLAG_SYN | FLAG_FIN | FLAG_RST)) == 0)
+        clean_flags = f_ackf & (
+            (flags & (FLAG_SYN | FLAG_FIN | FLAG_RST)) == 0
         )
         wnd = ev_data[:, LANE_WND].astype(jnp.int64)
-        abs_seq = unwrap32(v.rcv_nxt, ev_data[:, LANE_SEQ])
-        abs_ack = unwrap32(v.snd_una, ev_data[:, LANE_ACK])
+        abs_seq = unwrap32(v_rcv_nxt, ev_data[:, LANE_SEQ])
+        abs_ack = unwrap32(v_snd_una, ev_data[:, LANE_ACK])
         sack_present = ev_data[:, LANE_SACK_S] != ev_data[:, LANE_SACK_E]
 
-        sacked_empty = jnp.all(v.sacked[:, :, 0] < 0, axis=1)
+        sacked_empty = jnp.all(v_sacked[:, :, 0] < 0, axis=1)
         quiet = (
             rx_exact
-            & (v.st == T.ESTABLISHED)
+            & (v_st == T.ESTABLISHED)
             & clean_flags
-            & (v.rcv_fin < 0)
-            & ~v.fin_sent
-            & ~v.fin_pending
+            & (v_rcv_fin < 0)
+            & ~v_fin_sent
             # timer-event invariant: nothing for the output pass to re-arm
-            & (v.rto_expire >= v.tev_time)
+            & (v_rto_expire >= v_tev_time)
         )
 
         # P2: data at a receiver (in-order, out-of-order — the shaping
         # relay's closed-form bucket legitimately lets a later packet pass
-        # while an earlier one is deferred, so OOO arrivals are the NORM
-        # in backlogged rounds — or stale duplicate), no piggy-backed ACK
-        # advance, send side fully flushed so the output pass is a proven
-        # no-op. Receive path = the handler's accept/absorb/insert flow.
+        # while an earlier one is deferred — or stale duplicate), no
+        # piggy-backed ACK advance, send side fully flushed so the output
+        # pass is a proven no-op.
         seg_s = abs_seq
         seg_e = abs_seq + plen.astype(jnp.int64)
         p2 = (
             quiet
             & (plen > 0)
-            & (seg_s <= v.rcv_nxt + p.rcv_wnd)
-            & (abs_ack <= v.snd_una)
-            & (v.snd_end <= v.snd_nxt)
-            & ~v.in_rec
-            & (v.dupacks == 0)
+            & (seg_s <= v_rcv_nxt + p.rcv_wnd)
+            & (abs_ack <= v_snd_una)
+            & (v_snd_end <= v_snd_nxt)
+            & ~v_in_rec
+            & (v_dupacks == 0)
             & ~sack_present
             & sacked_empty
+            # a pending FIN could go out the output pass; receivers never
+            # half-close mid-stream, senders take P3's FIN-capable path
+            & ~v_fin_pending
         )
-        acceptable = p2 & (seg_e > v.rcv_nxt)
-        in_order = acceptable & (seg_s <= v.rcv_nxt)
+        acceptable = p2 & (seg_e > v_rcv_nxt)
+        in_order = acceptable & (seg_s <= v_rcv_nxt)
         ooo_seg = acceptable & ~in_order
-        rcv1 = jnp.where(in_order, seg_e, v.rcv_nxt)
-        rcv1, ooo1 = T._ooo_absorb(rcv1, v.ooo, in_order)
+        rcv1 = jnp.where(in_order, seg_e, v_rcv_nxt)
+        rcv1, ooo1 = T._ooo_absorb(rcv1, v_ooo, in_order)
         ooo1 = T._ooo_insert(ooo1, ooo_seg, seg_s, seg_e)
-        delivered_delta = jnp.where(p2, rcv1 - v.rcv_nxt, 0)
+        delivered_delta = jnp.where(p2, rcv1 - v_rcv_nxt, 0)
 
         # P3: pure cumulative ACK advancing snd_una, outside recovery
         p3 = (
             quiet
             & (plen == 0)
-            & ~v.in_rec
-            & (abs_ack > v.snd_una)
-            & (abs_ack <= v.snd_max)
+            & ~v_in_rec
+            & (abs_ack > v_snd_una)
+            & (abs_ack <= v_snd_max)
         )
 
         # model veto on the candidate outcome (e.g. tgen's respond trigger)
-        v2_delivered = v.delivered + delivered_delta
         blocked = spec.block(
-            mstate, host_ids, v, v2_delivered, delivered_delta
+            mstate, host_ids, v_st, v_snd_end,
+            rd(ts.delivered) + delivered_delta, delivered_delta,
         )
         p2 = p2 & ~blocked
         p3 = p3 & ~blocked
 
-        # ---- P3 state update + send-engine lane loop ---------------------
-        m_rtt = p3 & v.rtt_pending & (abs_ack >= v.rtt_seq)
-        ss = p3 & (v.cwnd < v.ssthresh)
+        # ---- P3 state update --------------------------------------------
+        m_rtt = p3 & v_rtt_pending & (abs_ack >= v_rtt_seq)
+        ss = p3 & (v_cwnd < v_ssthresh)
         ca = p3 & ~ss
-        acked = jnp.where(p3, abs_ack - v.snd_una, 0)
-        cwnd1 = jnp.where(ss, v.cwnd + jnp.minimum(acked, mss), v.cwnd)
+        acked = jnp.where(p3, abs_ack - v_snd_una, 0)
+        cwnd1 = jnp.where(ss, v_cwnd + jnp.minimum(acked, mss), v_cwnd)
         cwnd1 = jnp.where(
             ca, cwnd1 + jnp.maximum((mss * mss) // jnp.maximum(cwnd1, 1), 1), cwnd1
         )
-        una1 = jnp.where(p3, abs_ack, v.snd_una)
-        nxt1 = jnp.where(p3, jnp.maximum(v.snd_nxt, abs_ack), v.snd_nxt)
-        outstanding = una1 < v.snd_max
+        una1 = jnp.where(p3, abs_ack, v_snd_una)
+        nxt1 = jnp.where(p3, jnp.maximum(v_snd_nxt, abs_ack), v_snd_nxt)
+        outstanding = una1 < v_snd_max
         expire1 = jnp.where(
-            p3, jnp.where(outstanding, now + v.rto, TIME_MAX), v.rto_expire
+            p3, jnp.where(outstanding, now + v_rto, TIME_MAX), v_rto_expire
         )
-        # sender-side SACK scoreboard: merge the advertised block (unwrap
-        # relative to the post-advance snd_una), drop ranges the cumulative
-        # ACK covers — the handler's exact sequence for a valid_ack
+        # RFC 6298 sample (the handler's _rtt_update, scalar-field form)
+        rtt = now - v_rtt_ts
+        first = v_srtt < 0
+        rttvar1 = jnp.where(
+            first, rtt // 2, (3 * v_rttvar + jnp.abs(v_srtt - rtt)) // 4
+        )
+        srtt1 = jnp.where(first, rtt, (7 * v_srtt + rtt) // 8)
+        rto1 = jnp.clip(
+            srtt1 + jnp.maximum(p.granularity_ns, 4 * rttvar1),
+            p.rto_min_ns,
+            p.rto_max_ns,
+        )
+        n_srtt = jnp.where(m_rtt, srtt1, v_srtt)
+        n_rttvar = jnp.where(m_rtt, rttvar1, v_rttvar)
+        n_rto = jnp.where(m_rtt, rto1, v_rto)
+        n_rtt_pending = jnp.where(m_rtt, False, v_rtt_pending)
+
+        # sender-side SACK scoreboard merge + cumulative-ACK drop
         if p.use_sack:
             has_sack = p3 & sack_present
             abs_ss = unwrap32(una1, ev_data[:, LANE_SACK_S])
             abs_se = unwrap32(una1, ev_data[:, LANE_SACK_E])
-            sacked1 = T._ooo_insert(v.sacked, has_sack, abs_ss, abs_se)
+            sacked1 = T._ooo_insert(v_sacked, has_sack, abs_ss, abs_se)
             dropm = (
                 p3[:, None]
                 & (sacked1[:, :, 0] >= 0)
@@ -342,68 +422,71 @@ def pump_stage(
             )
             sacked2 = jnp.where(dropm[:, :, None], jnp.int64(-1), sacked1)
         else:
-            sacked2 = v.sacked
-        v2 = v.replace(
-            snd_una=una1,
-            snd_nxt=nxt1,
-            cwnd=cwnd1,
-            dupacks=jnp.where(p3, 0, v.dupacks),
-            backoff=jnp.where(p3, 0, v.backoff),
-            rto_expire=expire1,
-            peer_wnd=jnp.where(p2 | p3, wnd, v.peer_wnd),
-            rcv_nxt=rcv1,
-            ooo=ooo1,
-            sacked=sacked2,
-            delivered=v.delivered + delivered_delta,
-            segs_in=v.segs_in + (p2 | p3),
-        )
-        v2 = T._rtt_update(v2, m_rtt, now - v2.rtt_ts, p)
+            sacked2 = v_sacked
 
-        # send engine (the handler's lane loop with rtx_hole/SYN/FIN lanes
-        # provably inactive under the P3 conditions)
-        wnd_lim = v2.snd_una + jnp.minimum(v2.cwnd, v2.peer_wnd)
-        cursor = v2.snd_nxt
+        # ---- P3 send engine (rtx_hole/SYN lanes provably inactive; the
+        # FIN lane live — tgen-style servers run their whole response with
+        # fin_pending set) ------------------------------------------------
+        peer_wnd1 = jnp.where(p2 | p3, wnd, rd(ts.peer_wnd))
+        wnd_lim = una1 + jnp.minimum(cwnd1, peer_wnd1)
+        fin_lim = v_snd_end + v_fin_pending.astype(jnp.int64)
+        cursor = nxt1
         can_send = p3
-        new_rtt_pending = v2.rtt_pending
-        new_rtt_seq = v2.rtt_seq
-        new_rtt_ts = v2.rtt_ts
+        rp = n_rtt_pending
+        rs = v_rtt_seq
+        rt = v_rtt_ts
         sent_any = jnp.zeros((h,), bool)
+        fin_goes = jnp.zeros((h,), bool)
         rtx_count = jnp.zeros((h,), jnp.int64)
         lane_valid = []
         lane_seq_w = []
         lane_len = []
+        lane_fin = []
         for _i in range(nseg):
-            room = jnp.minimum(jnp.minimum(v2.snd_end, wnd_lim), cursor + mss)
+            room = jnp.minimum(jnp.minimum(v_snd_end, wnd_lim), cursor + mss)
             dlen = jnp.maximum(room - cursor, 0)
             send_data = can_send & (dlen > 0)
-            lane_valid.append(send_data)
+            send_fin = (
+                can_send
+                & ~send_data
+                & v_fin_pending
+                & (cursor == v_snd_end)
+                & (cursor + 1 <= wnd_lim)
+                & ~fin_goes
+            )
+            lane_valid.append(send_data | send_fin)
             lane_seq_w.append(cursor)
             lane_len.append(jnp.where(send_data, dlen, 0).astype(jnp.int32))
-            is_rtx = send_data & (cursor < v2.snd_max)
+            lane_fin.append(send_fin)
+            is_rtx = send_data & (cursor < v_snd_max)
             rtx_count = rtx_count + is_rtx
-            fresh = send_data & (cursor >= v2.snd_max)
-            start_rtt = fresh & ~new_rtt_pending
-            new_rtt_pending = new_rtt_pending | start_rtt
-            new_rtt_seq = jnp.where(start_rtt, cursor + dlen, new_rtt_seq)
-            new_rtt_ts = jnp.where(start_rtt, now, new_rtt_ts)
-            cursor = cursor + jnp.where(send_data, dlen, 0)
-            sent_any = sent_any | send_data
-        new_nxt = jnp.where(can_send, jnp.maximum(v2.snd_nxt, cursor), v2.snd_nxt)
-        new_max = jnp.maximum(v2.snd_max, new_nxt)
-        arm = p3 & (v2.snd_una < new_max) & (v2.rto_expire >= TIME_MAX) & sent_any
-        new_expire = jnp.where(arm, now + v2.rto, v2.rto_expire)
-        more = can_send & (jnp.minimum(v2.snd_end, wnd_lim) > cursor)
-        need_tev = (p2 | p3) & (new_expire < v2.tev_time)
+            fresh = send_data & (cursor >= v_snd_max)
+            start_rtt = fresh & ~rp
+            rp = rp | start_rtt
+            rs = jnp.where(start_rtt, cursor + dlen, rs)
+            rt = jnp.where(start_rtt, now, rt)
+            cursor = cursor + jnp.where(send_data, dlen, 0) + send_fin
+            fin_goes = fin_goes | send_fin
+            sent_any = sent_any | send_data | send_fin
+        new_nxt = jnp.where(can_send, jnp.maximum(nxt1, cursor), nxt1)
+        new_max = jnp.maximum(v_snd_max, new_nxt)
+        arm = p3 & (una1 < new_max) & (expire1 >= TIME_MAX) & sent_any
+        new_expire = jnp.where(arm, now + n_rto, expire1)
+        more = can_send & (jnp.minimum(fin_lim, wnd_lim) > cursor)
+        need_tev = (p2 | p3) & (new_expire < v_tev_time)
         # a step that would emit a local event falls back to the handler
         p3 = p3 & ~more & ~need_tev
         p2 = p2 & ~need_tev
 
         take_tcp = p2 | p3
         take = p1_take | take_tcp
+        rejected = rejected | (ev_valid & ~take)
         if debug_out is not None:
-            q_ = quiet
             debug_out.append(
                 {
+                    "_arrays": (take, ev_time, ev_tie, ev_kind, p1_take, p2, p3),
+                }
+                | {
                     k_: int(jnp.sum(v_))
                     for k_, v_ in dict(
                         ev_valid=ev_valid, is_pkt=is_pkt, shaped=shaped & ev_valid,
@@ -411,14 +494,6 @@ def pump_stage(
                         quiet=quiet, p2=p2, p3=p3, blocked=blocked & arrived,
                         more=more & arrived, need_tev=need_tev,
                         take=take, use_f=use_f,
-                        d_len=q_ & (plen > 0),
-                        d_inorder=q_ & (abs_seq <= v.rcv_nxt),
-                        d_ackle=q_ & (abs_ack <= v.snd_una),
-                        d_flushed=q_ & (v.snd_end <= v.snd_nxt),
-                        d_norec=q_ & ~v.in_rec,
-                        d_dup0=q_ & (v.dupacks == 0),
-                        d_ackadv=q_ & (abs_ack > v.snd_una),
-                        d_ackmax=q_ & (abs_ack <= v.snd_max),
                     ).items()
                 }
             )
@@ -464,100 +539,140 @@ def pump_stage(
             )
             f_cnt = f_cnt + ins.astype(jnp.int32)
 
-        # ---- commit TCP state ------------------------------------------
-        v2 = v2.replace(
-            snd_nxt=jnp.where(p3, new_nxt, v2.snd_nxt),
-            snd_max=jnp.where(p3, new_max, v2.snd_max),
-            rtt_pending=jnp.where(p3, new_rtt_pending, v2.rtt_pending),
-            rtt_seq=jnp.where(p3, new_rtt_seq, v2.rtt_seq),
-            rtt_ts=jnp.where(p3, new_rtt_ts, v2.rtt_ts),
-            rto_expire=jnp.where(p3, new_expire, v2.rto_expire),
-            retransmits=v2.retransmits + jnp.where(p3, rtx_count, 0),
+        # ---- commit TCP state (slot-one-hot wheres, no scatter) ---------
+        w2 = oh & p2[:, None]
+        w3 = oh & p3[:, None]
+        w23 = oh & take_tcp[:, None]
+
+        def wr(a, new, m):
+            return jnp.where(m, new[:, None], a)
+
+        def wr4(a, new, m):
+            return jnp.where(m[:, :, None, None], new[:, None], a)
+
+        fin3 = p3 & fin_goes
+        ts = ts.replace(
+            st=wr(ts.st, jnp.full((h,), T.FINWAIT1, jnp.int32), oh & fin3[:, None]),
+            fin_sent=ts.fin_sent | (oh & fin3[:, None]),
+            snd_una=wr(ts.snd_una, una1, w3),
+            snd_nxt=wr(ts.snd_nxt, new_nxt, w3),
+            snd_max=wr(ts.snd_max, new_max, w3),
+            cwnd=wr(ts.cwnd, cwnd1, w3),
+            dupacks=wr(ts.dupacks, jnp.zeros((h,), jnp.int32), w3),
+            backoff=wr(ts.backoff, jnp.zeros((h,), jnp.int32), w3),
+            rto_expire=wr(ts.rto_expire, new_expire, w3),
+            srtt=wr(ts.srtt, n_srtt, w3),
+            rttvar=wr(ts.rttvar, n_rttvar, w3),
+            rto=wr(ts.rto, n_rto, w3),
+            rtt_pending=jnp.where(w3, rp[:, None], ts.rtt_pending),
+            rtt_seq=wr(ts.rtt_seq, rs, w3),
+            rtt_ts=wr(ts.rtt_ts, rt, w3),
+            retransmits=ts.retransmits + jnp.where(w3, rtx_count[:, None], 0),
+            peer_wnd=wr(ts.peer_wnd, peer_wnd1, w23),
+            rcv_nxt=wr(ts.rcv_nxt, rcv1, w2),
+            ooo=wr4(ts.ooo, ooo1, w2),
+            sacked=wr4(ts.sacked, sacked2, w3),
+            delivered=ts.delivered + jnp.where(w2, delivered_delta[:, None], 0),
+            segs_in=ts.segs_in + w23,
             # data lanes only — the handler's segs_out counts pv[:, :nseg],
             # never the control-lane ACK
-            segs_out=v2.segs_out
-            + jnp.where(p3, sum(lv.astype(jnp.int64) for lv in lane_valid), 0),
+            segs_out=ts.segs_out
+            + jnp.where(
+                w3,
+                sum(lv.astype(jnp.int64) for lv in lane_valid)[:, None],
+                0,
+            ),
         )
-        ts = T.scatter_slot(ts, rx_slot, take_tcp, v2)
         mstate = spec.apply(mstate, take_tcp, host_ids, delivered_delta)
 
-        # ---- emissions: P3 data lanes + P2 ACK, in handler lane order ---
-        dst = jnp.clip(v2.rhost, 0, tables.num_global_hosts - 1)
+        # ---- emissions: P3 data/FIN lanes; the P2 ACK rides lane 0 (P2
+        # and P3 are disjoint per host, and for P2 the handler's data
+        # lanes are all invalid, so lane order — and therefore the
+        # relay-charge and draw order — is preserved either way. The P2
+        # loss draw index is remapped to the handler's control lane. ----
+        dst = jnp.clip(v_rhost, 0, tables.num_global_hosts - 1)
         dst_node = tables.host_node[dst]
         lat = tables.lat_ns[src_node, dst_node]
         rel = tables.rel[src_node, dst_node]
         loopb = dst == host_ids
         in_btx = now < cfg.bootstrap_end_ns
 
-        # lane emissions: indices 0..nseg-1 = P3 data, index nseg = P2 ACK.
-        # The ACK advertises the lowest buffered out-of-order range,
-        # exactly like the handler's control lane.
         if p.use_sack:
-            starts = v2.ooo[:, :, 0]
+            starts = ooo1[:, :, 0]
             present = starts >= 0
             min_start = jnp.min(
                 jnp.where(present, starts, jnp.int64(1) << 62), axis=1
             )
             at_min = present & (starts == min_start[:, None])
             blk_e = jnp.max(
-                jnp.where(at_min, v2.ooo[:, :, 1], jnp.int64(-1)), axis=1
+                jnp.where(at_min, ooo1[:, :, 1], jnp.int64(-1)), axis=1
             )
             has_blk = jnp.any(present, axis=1)
             sack_s = jnp.where(has_blk, min_start, jnp.int64(0))
             sack_e = jnp.where(has_blk, blk_e, jnp.int64(0))
         else:
             sack_s = sack_e = jnp.zeros((h,), jnp.int64)
-        ack_data = T._mk_seg(
-            v2.lport,
-            v2.rport,
-            v2.snd_nxt,
-            v2.rcv_nxt,
-            jnp.full((h,), FLAG_ACK, jnp.int32),
-            jnp.zeros((h,), jnp.int32),
-            jnp.full((h,), p.rcv_wnd, jnp.int64),
-            sack_s=sack_s,
-            sack_e=sack_e,
-        )
 
-        tx_tok, tx_last = net.tx_tokens, net.tx_last
-        new_seq = seq
-        for lane in range(nseg + 1):
-            if lane < nseg:
-                lv = lane_valid[lane] & p3
-                ldata = T._mk_seg(
-                    v2.lport,
-                    v2.rport,
-                    lane_seq_w[lane],
-                    v2.rcv_nxt,
-                    jnp.full((h,), FLAG_ACK, jnp.int32),
-                    lane_len[lane],
-                    jnp.full((h,), p.rcv_wnd, jnp.int64),
-                )
-                lsize = lane_len[lane] + p.header_bytes
-            else:
-                lv = p2
-                ldata = ack_data
-                lsize = jnp.full((h,), p.header_bytes, jnp.int32)
-            unroutable = lv & (lat >= TIME_MAX)
-            loss_u = rng.uniform_f32(
-                st.rng_key, rng_counter + draws + jnp.uint32(lane)
+        l_valid2 = []
+        l_data2 = []
+        l_size2 = []
+        for lane in range(nseg):
+            lv3 = lane_valid[lane] & p3
+            use_ack = p2 if lane == 0 else jnp.zeros((h,), bool)
+            lv = lv3 | use_ack
+            lflags = jnp.where(
+                lane_fin[lane],
+                FLAG_FIN | FLAG_ACK,
+                FLAG_ACK,
+            ).astype(jnp.int32)
+            ldata = T._mk_seg(
+                v_lport,
+                v_rport,
+                jnp.where(use_ack, new_nxt, lane_seq_w[lane]),
+                rcv1,
+                lflags,
+                jnp.where(use_ack, 0, lane_len[lane]),
+                jnp.full((h,), p.rcv_wnd, jnp.int64),
+                sack_s=jnp.where(use_ack, sack_s, 0),
+                sack_e=jnp.where(use_ack, sack_e, 0),
             )
-            kept = lv & ~unroutable & (loss_u < rel)
-            dropped = lv & ~unroutable & ~(loss_u < rel)
-            if cfg.use_netstack:
-                charge = (lv & ~unroutable) & ~loopb & ~in_btx
-                dep, tx_tok, tx_last = netstack.tb_depart(
-                    tx_tok, tx_last, net.tx_refill, now, lsize.astype(jnp.int64),
-                    charge,
-                )
-                deliver = jnp.maximum(dep + lat, window_end)
-                net = net.replace(
-                    bytes_sent=net.bytes_sent
-                    + jnp.where(kept, lsize.astype(jnp.int64), 0)
-                )
-            else:
-                deliver = jnp.maximum(now + lat, window_end)
-            # outbox append
+            l_valid2.append(lv)
+            l_data2.append(ldata)
+            l_size2.append(
+                jnp.where(use_ack, 0, lane_len[lane]) + p.header_bytes
+            )
+
+        lv_all = jnp.stack(l_valid2, axis=1)  # [H, nseg]
+        lsz_all = jnp.stack(l_size2, axis=1).astype(jnp.int64)
+        unroutable_l = lv_all & (lat >= TIME_MAX)[:, None]
+        # loss draws: handler lane index (P2's ACK is the control lane)
+        draw_lane = jnp.where(p2, jnp.uint32(nseg), jnp.uint32(0))[:, None] + (
+            jnp.arange(nseg, dtype=jnp.uint32)[None, :]
+            * (~p2[:, None]).astype(jnp.uint32)
+        )
+        ctrs = rng_counter[:, None] + draws + draw_lane
+        loss_u = rng.uniform_f32_grid(st.rng_key, ctrs)  # [H, nseg]
+        kept_l = lv_all & ~unroutable_l & (loss_u < rel[:, None])
+        dropped_l = lv_all & ~unroutable_l & ~(loss_u < rel[:, None])
+        if cfg.use_netstack:
+            charge_l = (lv_all & ~unroutable_l) & ~loopb[:, None] & ~in_btx[:, None]
+            deps, tx_tok, tx_last = netstack.tb_depart_lanes(
+                net.tx_tokens, net.tx_last, net.tx_refill, now, lsz_all, charge_l
+            )
+            deliver_l = jnp.maximum(deps + lat[:, None], window_end)
+            net = net.replace(
+                tx_tokens=tx_tok,
+                tx_last=tx_last,
+                bytes_sent=net.bytes_sent
+                + jnp.sum(jnp.where(kept_l, lsz_all, 0), axis=1),
+            )
+        else:
+            deliver_l = jnp.maximum(now[:, None] + lat[:, None], window_end)
+
+        # outbox append, lane order (per-host running fill)
+        new_seq = seq
+        for lane in range(nseg):
+            kept = kept_l[:, lane]
             has_room = obfill < o_cap
             write = kept & has_room
             at = (lane_idx_ob == obfill[:, None]) & write[:, None]
@@ -568,49 +683,52 @@ def pump_stage(
             )
             obv = obv | at
             obd = jnp.where(at, dst[:, None], obd)
-            obt = jnp.where(at, deliver[:, None], obt)
+            obt = jnp.where(at, deliver_l[:, lane][:, None], obt)
             obtie = jnp.where(at, ptie[:, None], obtie)
-            obdata = jnp.where(at[:, :, None], ldata[:, None, :], obdata)
-            obaux = jnp.where(at, (lsize & AUX_SIZE_MASK)[:, None], obaux)
+            obdata = jnp.where(at[:, :, None], l_data2[lane][:, None, :], obdata)
+            obaux = jnp.where(
+                at, (lsz_all[:, lane].astype(jnp.int32) & AUX_SIZE_MASK)[:, None],
+                obaux,
+            )
             obfill = obfill + write.astype(jnp.int32)
             obover = obover + (kept & ~has_room).astype(jnp.int32)
             new_seq = new_seq + kept.astype(jnp.uint32)
-            packets_sent = packets_sent + kept
-            packets_dropped = packets_dropped + dropped
-            packets_unroutable = packets_unroutable + unroutable
-            if cfg.use_dynamic_runahead:
-                cross = (dst != host_ids) & kept & (lat < TIME_MAX)
-                min_used = jnp.minimum(
-                    min_used, jnp.min(jnp.where(cross, lat, TIME_MAX))
-                )
-        if cfg.use_netstack:
-            net = net.replace(tx_tokens=tx_tok, tx_last=tx_last)
         seq = new_seq
+        packets_sent = packets_sent + jnp.sum(kept_l, axis=1)
+        packets_dropped = packets_dropped + jnp.sum(dropped_l, axis=1)
+        packets_unroutable = packets_unroutable + jnp.sum(unroutable_l, axis=1)
+        if cfg.use_dynamic_runahead:
+            cross = kept_l & (dst != host_ids)[:, None] & (lat < TIME_MAX)[:, None]
+            min_used = jnp.minimum(
+                min_used, jnp.min(jnp.where(cross, lat[:, None], TIME_MAX))
+            )
 
         events_handled = events_handled + take_tcp
         rng_counter = rng_counter + stride * take_tcp.astype(jnp.uint32)
         alive = alive & take
 
-    # flush remaining pending defers into the queue (one batched push)
-    lanes_live = (jnp.arange(k)[None, :] >= f_head[:, None]) & (
-        jnp.arange(k)[None, :] < f_cnt[:, None]
-    )
-    q = equeue.push_self_lanes(
-        q,
-        valid=lanes_live,
-        time=f_time,
-        tie=f_tie,
-        kind=f_kind,
-        data=f_data,
-        aux=f_aux,
-    )
+    # flush remaining pending defers into the queue (one batched push;
+    # without the netstack the FIFO is provably empty — skip the lanes)
+    if cfg.use_netstack:
+        lanes_live = (jnp.arange(k)[None, :] >= f_head[:, None]) & (
+            jnp.arange(k)[None, :] < f_cnt[:, None]
+        )
+        q = equeue.push_self_lanes(
+            q,
+            valid=lanes_live,
+            time=f_time,
+            tie=f_tie,
+            kind=f_kind,
+            data=f_data,
+            aux=f_aux,
+        )
 
     ob = ob.replace(
         valid=obv, dst=obd, time=obt, tie=obtie, data=obdata, aux=obaux,
         fill=obfill, overflow=obover,
     )
     mstate = spec.set_tcp(mstate, ts)
-    return st.replace(
+    st = st.replace(
         queue=q,
         net=net,
         model=mstate,
@@ -623,3 +741,4 @@ def pump_stage(
         packets_unroutable=packets_unroutable,
         min_used_lat=min_used,
     )
+    return st, jnp.any(rejected)

@@ -107,17 +107,6 @@ def handle_one_iteration(
     `tables.host_node` is the replicated global host->node map, so packet
     destinations are global host ids everywhere.
     """
-    if (
-        cfg.pump_k > 0
-        and getattr(model, "pump_spec", None) is not None
-        and getattr(model, "LOSS_COUNTER_LANE", None) is None
-        and not hasattr(model, "on_packet_outcomes")
-        and not hasattr(model, "on_codel_drop")
-    ):
-        from shadow_tpu.engine.pump import pump_stage
-
-        st = pump_stage(st, window_end, model, tables, cfg)
-
     host_ids = st.host_id
 
     want = equeue.next_time(st.queue) < window_end
@@ -394,15 +383,11 @@ def flush_outbox(
     Either way the destination pops by the (time, tie) key, so delivery
     slot order — which differs between the modes — cannot affect results.
     """
-    ob = st.outbox
-    h_local, o_cap = ob.valid.shape
-    m = h_local * o_cap
-
     # Empty rounds skip the exchange sorts entirely (lax.cond on a scalar
     # any-reduce). Sharded: the predicate is made mesh-uniform with a
     # psum, because the all_to_all/all_gather inside must be entered by
     # every shard or none.
-    has_traffic = jnp.any(ob.valid)
+    has_traffic = jnp.any(st.outbox.valid)
     if axis_name is not None:
         has_traffic = (
             jax.lax.psum(has_traffic.astype(jnp.int32), axis_name) > 0
@@ -414,6 +399,10 @@ def flush_outbox(
     def _do_flush(st):
         return _flush_outbox_traffic(st, axis_name, cfg)
 
+    if not isinstance(has_traffic, jax.core.Tracer):
+        # eager path (round_body_debug/tests): concrete predicate — an
+        # eager lax.cond over this state is pathological for the tracer
+        return _do_flush(st) if bool(has_traffic) else st
     return jax.lax.cond(has_traffic, _do_flush, _skip, st)
 
 
@@ -539,18 +528,44 @@ def run_round(
     if compact:
         max_iters *= -(-h_local // lanes)
 
+    # The packet-pump microscan (engine/pump.py) runs on the FULL state
+    # before each iteration's handler — above the compact path, whose
+    # sentinel-row head_time neutralization must not be disturbed by the
+    # pump's queue mutations.
+    use_pump = (
+        cfg.pump_k > 0
+        and getattr(model, "pump_spec", None) is not None
+        and getattr(model, "LOSS_COUNTER_LANE", None) is None
+        and not hasattr(model, "on_packet_outcomes")
+        and not hasattr(model, "on_codel_drop")
+    )
+    if use_pump:
+        from shadow_tpu.engine.pump import pump_stage
+
     def cond(carry):
         s, iters = carry
         return jnp.any(equeue.next_time(s.queue) < window_end) & (
             iters < max_iters
         )
 
+    def _handler(s):
+        if compact:
+            return handle_one_iteration_compact(
+                s, window_end, model, tables, cfg, lanes
+            )
+        return handle_one_iteration(s, window_end, model, tables, cfg)
+
     def body(carry):
         s, iters = carry
-        if compact:
-            s = handle_one_iteration_compact(s, window_end, model, tables, cfg, lanes)
+        if use_pump:
+            s, rej = pump_stage(s, window_end, model, tables, cfg)
+            # the full handler only runs when some host's head event
+            # failed pump classification — pump-only iterations cover the
+            # steady packet streams (chains longer than pump_k keep
+            # pumping next iteration without a handler pass)
+            s = jax.lax.cond(rej, _handler, lambda x: x, s)
         else:
-            s = handle_one_iteration(s, window_end, model, tables, cfg)
+            s = _handler(s)
         return s, iters + 1
 
     st, iters = jax.lax.while_loop(cond, body, (st, jnp.asarray(0, jnp.int32)))

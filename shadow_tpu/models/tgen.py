@@ -115,13 +115,13 @@ class TgenModel:
         def set_tcp(ms, ts):
             return ms.replace(tcp=ts)
 
-        def block(ms, host_id, v, delivered_new, delta):
+        def block(ms, host_id, v_st, v_snd_end, delivered_new, delta):
             is_server = (host_id >= nc) & (host_id < nc + ns)
             return (
                 is_server
-                & (v.st == tcp.ESTABLISHED)
+                & (v_st == tcp.ESTABLISHED)
                 & (delivered_new >= req)
-                & (v.snd_end == 1)
+                & (v_snd_end == 1)
             )
 
         def apply(ms, take, host_id, delta):

@@ -173,6 +173,54 @@ def tb_depart(tokens, last, refill, now, size, charge):
     return depart, tokens_out, last_out
 
 
+def tb_depart_lanes(tokens, last, refill, now, sizes, charge):
+    """Closed-form multi-lane conforming-remove: serve L packets at the
+    same instant `now` in lane order. EXACTLY equals L sequential
+    tb_depart calls (the nested ceil telescopes: the k-th lane's total
+    extra intervals is ceil((prefix_k - cur)/refill)), in one prefix-sum
+    pass instead of L dependent chains.
+
+    sizes/charge are [H, L]; returns (departs [H, L], tokens', last').
+    Rows with refill == 0 or all-False charge are unchanged and depart
+    at `now` (the unlimited/exempt path, as tb_depart).
+    """
+    tokens = jnp.asarray(tokens, jnp.int64)
+    now = jnp.asarray(now, jnp.int64)
+    sizes = jnp.asarray(sizes, jnp.int64)
+    limited = charge & (refill > 0)[:, None]
+    safe_refill = jnp.maximum(refill, 1)
+    cap = refill + MTU_BYTES
+
+    intervals = jnp.maximum(now - last, 0) // REFILL_INTERVAL_NS
+    cur = jnp.minimum(cap, tokens + intervals * safe_refill)
+    cur_last = last + intervals * REFILL_INTERVAL_NS
+
+    pref = jnp.cumsum(jnp.where(limited, sizes, 0), axis=1)
+    deficit = jnp.maximum(pref - cur[:, None], 0)
+    k = (deficit + (safe_refill - 1)[:, None]) // safe_refill[:, None]
+    # "departs at now" follows the SEQUENTIAL deficit — tokens left over
+    # from an earlier lane's interval refill can cover a later lane
+    # immediately (tb_depart returns `now` whenever the running balance
+    # suffices), even though the raw prefix deficit is positive
+    k_prev = jnp.concatenate([jnp.zeros_like(k[:, :1]), k[:, :-1]], axis=1)
+    seq_deficit = pref - cur[:, None] - k_prev * safe_refill[:, None]
+    departs = jnp.where(
+        limited & (seq_deficit > 0),
+        cur_last[:, None] + k * REFILL_INTERVAL_NS,
+        now[:, None] if jnp.ndim(now) else jnp.broadcast_to(now, sizes.shape),
+    )
+    any_charged = jnp.any(limited, axis=1)
+    k_last = jnp.max(jnp.where(limited, k, 0), axis=1)
+    p_last = jnp.max(jnp.where(limited, pref, 0), axis=1)
+    tokens_out = jnp.where(any_charged, cur + k_last * safe_refill - p_last, tokens)
+    last_out = jnp.where(
+        any_charged,
+        jnp.where(k_last > 0, cur_last + k_last * REFILL_INTERVAL_NS, cur_last),
+        last,
+    )
+    return departs, tokens_out, last_out
+
+
 def codel_dequeue(net: NetDevState, now, sojourn, active):
     """One CoDel dequeue step per host (codel_queue.rs:23-540, RFC 8289).
 

@@ -235,3 +235,49 @@ def test_bootstrap_period_exempt_from_shaping():
     _, trace_b = _engine_trace_run(st_b, end, model, tables, cfg_b)
     _, trace_u = _engine_trace_run(st_u, end, model, tables, cfg_u)
     assert trace_b == trace_u
+
+
+def test_tb_depart_lanes_equals_sequential():
+    """The closed-form multi-lane conforming-remove must EXACTLY equal L
+    sequential tb_depart calls — including the subtle case where an
+    earlier lane's interval refill leaves enough balance for a later
+    lane to depart at `now` despite a positive raw prefix deficit."""
+    import random
+
+    import numpy as np
+
+    from shadow_tpu.netstack import tb_depart, tb_depart_lanes
+
+    rng = random.Random(5)
+    H, L = 16, 5
+    for trial in range(20):
+        tokens = jnp.asarray([rng.randrange(0, 4000) for _ in range(H)], jnp.int64)
+        last = jnp.asarray([rng.randrange(0, 3) * 1_000_000 for _ in range(H)], jnp.int64)
+        refill = jnp.asarray(
+            [rng.choice([0, 1250, 2500, 12500]) for _ in range(H)], jnp.int64
+        )
+        now = jnp.asarray(
+            [rng.randrange(2, 9) * 1_000_000 + rng.randrange(0, 999_999) for _ in range(H)],
+            jnp.int64,
+        )
+        sizes = jnp.asarray(
+            [[rng.choice([40, 590, 1500, 1540]) for _ in range(L)] for _ in range(H)],
+            jnp.int64,
+        )
+        charge = jnp.asarray(
+            [[rng.random() < 0.7 for _ in range(L)] for _ in range(H)], bool
+        )
+        # sequential reference
+        tok, la = tokens, last
+        seq_dep = []
+        for i in range(L):
+            d, tok, la = tb_depart(tok, la, refill, now, sizes[:, i], charge[:, i])
+            seq_dep.append(d)
+        deps, tok2, la2 = tb_depart_lanes(tokens, last, refill, now, sizes, charge)
+        np.testing.assert_array_equal(
+            np.stack([np.asarray(x) for x in seq_dep], axis=1),
+            np.asarray(deps),
+            err_msg=f"departs trial {trial}",
+        )
+        np.testing.assert_array_equal(np.asarray(tok), np.asarray(tok2), f"tokens {trial}")
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(la2), f"last {trial}")

@@ -72,14 +72,27 @@ def _run(cfg, model, tables, st, end_ns):
 
 
 def _normalize(st):
-    """Mask semantically-dead queue slot contents: pops tombstone only the
-    (time, tie) keys, leaving stale kind/data/aux behind, and pumped runs
-    consume/refill different slots — live content is what must match."""
-    dead = st.queue.time >= jnp.int64((1 << 62) - 1)
+    """Canonicalize the queue: slot PLACEMENT is semantically irrelevant
+    (pops are key-driven; pumped runs interleave pushes differently), and
+    pops tombstone only the (time, tie) keys, leaving stale kind/data/aux
+    behind. Rows are sorted by (time, tie) with dead-slot content zeroed,
+    so only the live event *sets* must match."""
+    import numpy as np
+
+    dead = np.asarray(st.queue.time) >= (1 << 62) - 1
+    time = np.asarray(st.queue.time)
+    tie = np.where(dead, np.iinfo(np.int64).max, np.asarray(st.queue.tie))
+    kind = np.where(dead, 0, np.asarray(st.queue.kind))
+    aux = np.where(dead, 0, np.asarray(st.queue.aux))
+    data = np.where(dead[:, :, None], 0, np.asarray(st.queue.data))
+    order = np.lexsort((tie, time), axis=1)
+    oi = np.arange(time.shape[0])[:, None]
     q = st.queue.replace(
-        kind=jnp.where(dead, 0, st.queue.kind),
-        aux=jnp.where(dead, 0, st.queue.aux),
-        data=jnp.where(dead[:, :, None], 0, st.queue.data),
+        time=jnp.asarray(time[oi, order]),
+        tie=jnp.asarray(tie[oi, order]),
+        kind=jnp.asarray(kind[oi, order]),
+        aux=jnp.asarray(aux[oi, order]),
+        data=jnp.asarray(data[oi, order]),
     )
     return st.replace(queue=q, iters_done=st.iters_done * 0)
 

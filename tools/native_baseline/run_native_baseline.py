@@ -55,9 +55,11 @@ def dump_tables(path: pathlib.Path, num_hosts: int, seed: int = 7):
     config pieces the C binary needs (runahead, bandwidth refill) — all
     read from bench._build's world, never duplicated here."""
     sys.path.insert(0, str(REPO))
-    from bench import HOST_BW_BITS, _build
+    from bench import HOST_BW_BITS, _build_world
 
-    cfg, model, tables, _st = _build(num_hosts, seed=seed)
+    # world only — never init_state/bootstrap (at 160k+ hosts the device
+    # state is multi-GB and the C binary needs none of it)
+    cfg, model, tables = _build_world(num_hosts, seed=seed)
     write_tables(path, tables)
     from shadow_tpu.netstack import bw_bits_per_sec_to_refill
 
