@@ -132,6 +132,15 @@ enum {
                               * a[2]=addr a[3]=len, buf = 4 x i64
                               * (prot, flags, fd, offset-or-old-addr) */
     VSYS_FD_NATIVE = 67,     /* a[1]=op(1 opened, 2 closed) a[2]=native fd */
+    /* bulk-memory IO tier (reference: memory_copier.rs:64-170 — the
+     * kernel reads/writes guest memory directly via process_vm_readv/
+     * writev instead of copying payload through the 64 KB shm channel;
+     * the kernel replies -ENOSYS when unavailable and the shim falls
+     * back to the chunked shm path) */
+    VSYS_WRITE_BULK = 68,    /* a[1]=fd a[2]=guest addr a[3]=len
+                                a[5]=dontwait -> bytes written */
+    VSYS_READ_BULK = 69,     /* a[1]=fd a[2]=guest addr a[3]=len
+                                a[5]=dontwait -> bytes read */
     VSYS_SIGMASK = 65,       /* a[1]=new 64-bit blocked mask (kernel-side
                                 delivery honors it; syscall/signal.c) */
 };

@@ -2263,9 +2263,24 @@ int ioctl(int fd, unsigned long req, ...) {
     return 0;
 }
 
+/* bulk-memory IO tier threshold (see the tier comment above write()) */
+#define BULK_IO_THRESHOLD (2 * SHIM_BUF_SIZE)
+
 ssize_t read(int fd, void *buf, size_t n) {
     if (!g_active || !is_vfd(fd))
         return rsyscall(SYS_read, fd, buf, n);
+    if (n > BULK_IO_THRESHOLD) { /* bulk tier; see write() */
+        ShimMsg reply0;
+        int64_t r0 = vsys_ex(VSYS_READ_BULK, fd, (int64_t)(uintptr_t)buf,
+                             (int64_t)n, 0, NULL, 0, &reply0);
+        if (r0 != -ENOSYS) {
+            if (r0 < 0) {
+                errno = (int)-r0;
+                return -1;
+            }
+            return (ssize_t)r0;
+        }
+    }
     ShimMsg reply;
     int64_t r = vsys(VSYS_READ, fd, (int64_t)n, 0, NULL, 0, &reply);
     if (r < 0) {
@@ -2305,9 +2320,27 @@ static ssize_t vfd_write_chunked(int code, int fd, int64_t a2, int64_t a3,
     return (ssize_t)done;
 }
 
+/* Bulk-memory IO tier (kernel-side process_vm_readv/writev, reference
+ * memory_copier.rs:64-170): payloads above the threshold skip the 64 KB
+ * shm channel entirely — ONE IPC round trip, the kernel copies straight
+ * from/into guest memory. -ENOSYS (old kernel, no CAP, exotic fd type)
+ * falls back to the chunked shm path. */
+
 ssize_t write(int fd, const void *buf, size_t n) {
     if (!g_active || !is_vfd(fd))
         return rsyscall(SYS_write, fd, buf, n);
+    if (n > BULK_IO_THRESHOLD) {
+        ShimMsg reply;
+        int64_t r = vsys_ex(VSYS_WRITE_BULK, fd, (int64_t)(uintptr_t)buf,
+                            (int64_t)n, 0, NULL, 0, &reply);
+        if (r != -ENOSYS) {
+            if (r < 0) {
+                errno = (int)-r;
+                return -1;
+            }
+            return (ssize_t)r;
+        }
+    }
     return vfd_write_chunked(VSYS_WRITE, fd, 0, 0, 0, buf, n);
 }
 

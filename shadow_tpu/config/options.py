@@ -117,6 +117,10 @@ class ExperimentalOptions:
     interface_qdisc: str = "fifo"  # "fifo" | "rr" (reference QDiscMode)
     use_tcp_sack: bool = True  # SACK scoreboard retransmission
     use_tcp_autotune: bool = True  # receive-window/send-buffer autotuning
+    # bulk-memory IO tier (reference use_memory_manager,
+    # memory_copier.rs:64-170): large stream IO copies guest memory
+    # directly via process_vm_readv/writev instead of the shm channel
+    use_memory_manager: bool = True
     use_pcap: bool = False
     syscall_latency_ns: int = 1_000
     vdso_latency_ns: int = 10
@@ -147,6 +151,7 @@ class ExperimentalOptions:
             "use_pcap",
             "use_tcp_sack",
             "use_tcp_autotune",
+            "use_memory_manager",
             "interface_qdisc",
         ):
             if k in d:

@@ -484,9 +484,6 @@ def pump_stage(
         if debug_out is not None:
             debug_out.append(
                 {
-                    "_arrays": (take, ev_time, ev_tie, ev_kind, p1_take, p2, p3),
-                }
-                | {
                     k_: int(jnp.sum(v_))
                     for k_, v_ in dict(
                         ev_valid=ev_valid, is_pkt=is_pkt, shaped=shaped & ev_valid,
@@ -667,7 +664,9 @@ def pump_stage(
                 + jnp.sum(jnp.where(kept_l, lsz_all, 0), axis=1),
             )
         else:
-            deliver_l = jnp.maximum(now[:, None] + lat[:, None], window_end)
+            deliver_l = jnp.broadcast_to(
+                jnp.maximum(now + lat, window_end)[:, None], (h, nseg)
+            )
 
         # outbox append, lane order (per-host running fill)
         new_seq = seq

@@ -24,6 +24,7 @@ VFD_BASE = 1000
 
 # errno values we return (negated over the wire)
 EINTR = 4
+EFAULT = 14
 EPERM = 1
 EBADF = 9
 EAGAIN = 11

@@ -93,6 +93,8 @@ VSYS_FUTEX_REQUEUE = 64
 VSYS_SIGMASK = 65
 VSYS_MM_NOTE = 66  # a[1]=op(1 mmap,2 munmap,3 brk,4 mremap) a[2]=addr a[3]=len, buf=[prot,flags,fd,off] i64
 VSYS_FD_NATIVE = 67  # a[1]=op(1 opened, 2 closed) a[2]=native fd
+VSYS_WRITE_BULK = 68  # a[1]=fd a[2]=guest addr a[3]=len a[5]=dontwait
+VSYS_READ_BULK = 69  # a[1]=fd a[2]=guest addr a[3]=len a[5]=dontwait
 
 # message kind for a new thread announcing itself on its own channel
 MSG_THREAD_START = 6
@@ -167,6 +169,8 @@ VSYS_NAMES = {
     VSYS_SIGMASK: "rt_sigprocmask",
     VSYS_MM_NOTE: "mmap",
     VSYS_FD_NATIVE: "fd_native",
+    VSYS_WRITE_BULK: "write",
+    VSYS_READ_BULK: "read",
 }
 
 

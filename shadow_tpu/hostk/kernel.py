@@ -61,6 +61,7 @@ from shadow_tpu.hostk.descriptor import (
     EISCONN,
     EMSGSIZE,
     ENOSYS,
+    EFAULT,
     ENOTCONN,
     ENOTSOCK,
     EPOLLIN,
@@ -677,6 +678,7 @@ class NetKernel:
         tcp_sack: bool = True,
         tcp_autotune: bool = True,
         qdisc: str = "fifo",
+        use_memory_manager: bool = True,
         owned_hosts: "Optional[list[int]]" = None,
         data_dir_prepared: bool = False,
         manager_heartbeat: bool = True,
@@ -704,6 +706,9 @@ class NetKernel:
         # configuration.rs:930): fifo = charge order is send order (no
         # queue needed); rr = NicQueue round-robins across sockets
         self.qdisc = qdisc
+        # bulk-memory IO tier (VSYS_{WRITE,READ}_BULK): off -> -ENOSYS,
+        # the shim falls back to the chunked shm path
+        self.use_memory_manager = use_memory_manager
         # Host sharding (the parallel managed tier, runtime/hybrid.py
         # ParallelHybridScheduler): this kernel knows the *whole* world
         # (names, ips, routing — guests resolve any host) but executes
@@ -2063,6 +2068,131 @@ class NetKernel:
         dontwait = bool(int(msg.a[3]))
         return self._do_write(proc, f, data, dontwait)
 
+    # --- bulk-memory IO tier (reference memory_copier.rs:64-170): the
+    # payload never rides the 64 KB shm channel — the kernel copies
+    # straight out of / into the frozen guest's address space. Byte
+    # semantics mirror the chunked shm path exactly (64 KB rounds, short
+    # round ends the write, blocking waits between rounds); the shim
+    # falls back to the chunked path on -ENOSYS. ------------------------
+
+    def _bulk_pid(self, proc):
+        for owner in (proc, getattr(proc, "process", None)):
+            if owner is None:
+                continue
+            if getattr(owner, "real_pid", None) is not None:
+                return owner.real_pid  # forked children
+            popen = getattr(owner, "popen", None)
+            if popen is not None:
+                return popen.pid
+        return None
+
+    def _sys_write_bulk(self, proc, msg):
+        from shadow_tpu.hostk import guestmem
+
+        if not self.use_memory_manager:
+            proc._reply(-ENOSYS)
+            return True
+        fd, addr, n = int(msg.a[1]), int(msg.a[2]), int(msg.a[3])
+        dontwait = bool(int(msg.a[5]))
+        f = self._file(proc, fd)
+        if f is None:
+            proc._reply(-EBADF)
+            return True
+        pid = self._bulk_pid(proc)
+        if (
+            pid is None
+            or not guestmem.AVAILABLE
+            or not isinstance(f, (T.TcpSocket, PipeEnd))
+        ):
+            proc._reply(-ENOSYS)  # shim retraces the chunked shm path
+            return True
+        state = {"done": 0}
+
+        def check() -> bool:
+            while state["done"] < n:
+                want = min(I.SHIM_BUF_SIZE, n - state["done"])
+                data = guestmem.read_guest(pid, addr + state["done"], want)
+                if data is None:
+                    proc._reply(state["done"] if state["done"] else -EFAULT)
+                    return True
+                r = f.send(data) if isinstance(f, T.TcpSocket) else f.write(data)
+                if r == -EAGAIN:
+                    if f.nonblock or dontwait:
+                        proc._reply(state["done"] if state["done"] else -EAGAIN)
+                        return True
+                    return False  # Waiter retries this round
+                if r < 0:
+                    proc._reply(state["done"] if state["done"] else r)
+                    return True
+                state["done"] += r
+                if r < want:  # short round ends the write (chunked-path parity)
+                    proc._reply(state["done"])
+                    return True
+            proc._reply(state["done"])
+            return True
+
+        if check():
+            return True
+        Waiter(self, proc, [f], check)
+        return False
+
+    def _sys_read_bulk(self, proc, msg):
+        from shadow_tpu.hostk import guestmem
+
+        if not self.use_memory_manager:
+            proc._reply(-ENOSYS)
+            return True
+        fd, addr, n = int(msg.a[1]), int(msg.a[2]), int(msg.a[3])
+        dontwait = bool(int(msg.a[5]))
+        f = self._file(proc, fd)
+        if f is None:
+            proc._reply(-EBADF)
+            return True
+        pid = self._bulk_pid(proc)
+        if (
+            pid is None
+            or not guestmem.AVAILABLE
+            or not isinstance(f, (T.TcpSocket, PipeEnd))
+        ):
+            proc._reply(-ENOSYS)
+            return True
+
+        def check() -> bool:
+            # peek, copy into guest memory, THEN consume — a guest buffer
+            # fault must not lose stream bytes (Linux only consumes what
+            # it actually copied)
+            if isinstance(f, T.TcpSocket):
+                r = f.peek(n)
+            elif f.buf.data:
+                r = bytes(f.buf.data[:n])
+            elif not f.buf.write_open:
+                r = b""  # EOF
+            else:
+                r = -EAGAIN
+            if isinstance(r, int):
+                if r == -EAGAIN:
+                    if f.nonblock or dontwait:
+                        proc._reply(-EAGAIN)
+                        return True
+                    return False
+                proc._reply(r)
+                return True
+            if not r:
+                proc._reply(0)
+                return True
+            if not guestmem.write_guest(pid, addr, r):
+                proc._reply(-EFAULT)  # nothing consumed
+                return True
+            consumed = f.recv(len(r)) if isinstance(f, T.TcpSocket) else f.read(len(r))
+            assert not isinstance(consumed, int) and len(consumed) == len(r)
+            proc._reply(len(r))
+            return True
+
+        if check():
+            return True
+        Waiter(self, proc, [f], check)
+        return False
+
     def _do_write(self, proc, f: File, data: bytes, dontwait: bool) -> bool:
         if isinstance(f, T.TcpSocket):
             return self._tcp_send(proc, f, data, dontwait)
@@ -3286,6 +3416,8 @@ _DISPATCH = {
     I.VSYS_GETSOCKOPT: NetKernel._sys_getsockopt,
     I.VSYS_FCNTL: NetKernel._sys_fcntl,
     I.VSYS_IOCTL: NetKernel._sys_ioctl,
+    I.VSYS_WRITE_BULK: NetKernel._sys_write_bulk,
+    I.VSYS_READ_BULK: NetKernel._sys_read_bulk,
     I.VSYS_PIPE2: NetKernel._sys_pipe2,
     I.VSYS_READ: NetKernel._sys_read,
     I.VSYS_WRITE: NetKernel._sys_write,

@@ -362,6 +362,7 @@ class Manager:
             tcp_sack=cfgo.experimental.use_tcp_sack,
             tcp_autotune=cfgo.experimental.use_tcp_autotune,
             qdisc=cfgo.experimental.interface_qdisc,
+            use_memory_manager=cfgo.experimental.use_memory_manager,
             cpu_freq_hz=[h.cpu_freq_hz for h in self.hosts],
         )
         for s in specs:
