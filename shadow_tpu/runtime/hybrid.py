@@ -335,6 +335,9 @@ class ParallelHybridScheduler:
             rx_bytes_per_interval=rx_bytes_per_interval,
         )
         self.inflight = 0
+        # wall-time decomposition (verdict r4 Next #4): worker_execute vs
+        # device_pass vs upload/drain serialization; stats() publishes it
+        self.phase_wall: dict = {}
         self.device_passes = 0
         self._horizon: "int | None" = None
         # (src, seq) -> (dst, payload-or-None) for records in flight
@@ -444,21 +447,41 @@ class ParallelHybridScheduler:
 
     # --- device interaction (same math as HybridScheduler) ---------------
 
+    def _phase(self, name, t0):
+        import time as _time
+
+        self.phase_wall[name] = self.phase_wall.get(name, 0.0) + (
+            _time.perf_counter() - t0
+        )
+
     def _upload_sends(self, sends: "list[tuple]") -> None:
+        import time as _time
+
+        t0 = _time.perf_counter()
         valid, src, time, tie, data = _pack_sends(sends)
         self.st = self._upload_jit(self.st, valid, src, time, tie, data)
         self.inflight += len(sends)
+        self._phase("upload", t0)
 
     def _run_pass(self, window_end: int) -> None:
+        import time as _time
+
+        t0 = _time.perf_counter()
         self.st = self._pass_jit(self.st, jnp.asarray(window_end, jnp.int64))
+        jax.block_until_ready(self.st.now)
         self.device_passes += 1
+        self._phase("device_pass", t0)
 
     def _drain_records(self) -> None:
         """Fetch outcome records from the device, route each half to the
         worker(s) owning the src / dst host, preserving the serial global
         application order within every worker."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         recs = _fetch_records(self.st)
         if recs is None:
+            self._phase("drain_records", t0)
             return
         t, srcs, seqs, flags, order = recs
         batches = [[] for _ in self._workers]
@@ -478,13 +501,18 @@ class ParallelHybridScheduler:
         for (_p, conn), _b in zip(self._workers, batches):
             self._expect(conn.recv(), "ok")
         self.inflight -= len(order)
+        self._phase("drain_records", t0)
 
     def _run_windows(self, end_ns: int, inclusive: bool) -> "list[tuple]":
         """All workers execute [.., end_ns) concurrently; returns the
         merged send list (metadata only; payloads cached for routing)."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         replies = self._broadcast(
             ("run_window", end_ns, inclusive, self._horizon), "sends"
         )
+        self._phase("worker_execute", t0)
         sends = []
         for (worker_sends,) in replies:
             for (t, src, seq, ctr, dst, size, payload) in worker_sends:

@@ -120,6 +120,7 @@ def main():
                 "syscalls": stats["syscalls_handled"],
                 "packets": stats["packets_sent"],
                 "device_passes": sched.device_passes,
+                "phase_wall": {k: round(v, 3) for k, v in getattr(sched, "phase_wall", {}).items()},
                 "wall_s": round(wall, 2),
                 "syscalls_per_s": int(stats["syscalls_handled"] / wall),
                 "sim_s_per_wall_s": round(sim_sec / wall, 4),
