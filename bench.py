@@ -463,6 +463,10 @@ def main():
                 SHADOW_TPU_BENCH_HOSTS=bh,
                 SHADOW_TPU_BENCH_SIMSEC=cpu_sim_sec,
                 SHADOW_TPU_BENCH_RPC=64,
+                # the known XLA-CPU winner; keeps this for-the-record
+                # number comparable across rounds and skips the dual
+                # compile of the auto-select
+                SHADOW_TPU_BENCH_PUMP_K=0,
             ),
             timeout_s=1500,
         )
