@@ -528,19 +528,41 @@ def run_round(
     if compact:
         max_iters *= -(-h_local // lanes)
 
-    # The packet-pump microscan (engine/pump.py) runs on the FULL state
-    # before each iteration's handler — above the compact path, whose
-    # sentinel-row head_time neutralization must not be disturbed by the
-    # pump's queue mutations.
-    use_pump = (
-        cfg.pump_k > 0
-        and getattr(model, "pump_spec", None) is not None
+    # Engine selection. The pump microscan / megakernel stage runs on the
+    # FULL state before each iteration's handler — above the compact path,
+    # whose sentinel-row head_time neutralization must not be disturbed by
+    # the stage's queue mutations. Models without a pump_spec (or with
+    # hooks the fast paths can't honor) always take the plain handler, so
+    # every engine value is bit-identical on every model.
+    pump_capable = (
+        getattr(model, "pump_spec", None) is not None
         and getattr(model, "LOSS_COUNTER_LANE", None) is None
         and not hasattr(model, "on_packet_outcomes")
         and not hasattr(model, "on_codel_drop")
     )
-    if use_pump:
+    stage, stage_cfg = None, cfg
+    if cfg.engine == "megakernel" and pump_capable:
+        from shadow_tpu.engine.megakernel import (
+            megakernel_stage,
+            resolve_stage_cfg,
+        )
+
+        stage_cfg = resolve_stage_cfg(cfg)
+        if axis_name is None:
+            stage = megakernel_stage
+        else:
+            # sharded runs keep the XLA pump for now (pallas_call under
+            # shard_map is untested here); same microsteps, same results
+            from shadow_tpu.engine.pump import pump_stage
+
+            stage = pump_stage
+    elif (
+        cfg.engine == "pump" or (cfg.engine == "auto" and cfg.pump_k > 0)
+    ) and pump_capable:
         from shadow_tpu.engine.pump import pump_stage
+
+        stage = pump_stage
+    use_pump = stage is not None
 
     def cond(carry):
         s, iters = carry
@@ -558,7 +580,7 @@ def run_round(
     def body(carry):
         s, iters = carry
         if use_pump:
-            s, rej = pump_stage(s, window_end, model, tables, cfg)
+            s, rej = stage(s, window_end, model, tables, stage_cfg)
             # the full handler only runs when some host's head event
             # failed pump classification — pump-only iterations cover the
             # steady packet streams (chains longer than pump_k keep

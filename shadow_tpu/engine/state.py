@@ -89,6 +89,25 @@ class EngineConfig:
     # Requires the model to expose `pump_spec`; results are bit-identical
     # to the unpumped engine (tests/test_pump.py).
     pump_k: int = 0
+    # Engine selection for the round drain loop:
+    #   "auto"       — current behavior: the pump microscan when pump_k > 0
+    #                  and the model is pump-capable, else the plain
+    #                  one-event-per-host handler loop.
+    #   "plain"      — always the full handler, even with pump_k set.
+    #   "pump"       — the XLA pump microscan (requires pump_k > 0).
+    #   "megakernel" — the fused Pallas round megakernel
+    #                  (engine/megakernel.py): the SAME pump microsteps,
+    #                  executed over VMEM-resident host-state tiles inside
+    #                  one kernel launch per iteration (pump_k defaults to
+    #                  8 when unset). Falls back to the plain handler for
+    #                  models without a pump_spec. Bit-identical results
+    #                  across all four values (tests/test_megakernel.py).
+    engine: str = "auto"
+    # Megakernel host-tile rows per Pallas program (the VMEM working-set
+    # knob; see docs/megakernel.md for the byte budget). 0 = auto: the
+    # largest power-of-two divisor of the local host count whose carry
+    # tile fits the VMEM budget. Must divide num_hosts when set.
+    megakernel_tile: int = 0
     # draws consumed per handled event = model.DRAWS_PER_EVENT + PACKET_EMITS
     # (one loss draw per packet lane), fixed-stride for determinism.
 
@@ -97,6 +116,17 @@ class EngineConfig:
             raise ValueError(f"num_hosts must be in (0, {MAX_HOSTS}]")
         if self.runahead_ns <= 0:
             raise ValueError("runahead must be > 0")
+        if self.engine not in ("auto", "plain", "pump", "megakernel"):
+            raise ValueError(
+                f"unknown engine {self.engine!r} "
+                "(expected 'auto', 'plain', 'pump', or 'megakernel')"
+            )
+        if self.engine == "pump" and self.pump_k <= 0:
+            raise ValueError("engine='pump' requires pump_k > 0")
+        if self.megakernel_tile < 0 or (
+            self.megakernel_tile > 0 and self.num_hosts % self.megakernel_tile
+        ):
+            raise ValueError("megakernel_tile must be 0 or divide num_hosts")
 
 
 @flax.struct.dataclass

@@ -24,6 +24,12 @@ import jax  # noqa: E402
 # the env), so the env var alone is not enough:
 jax.config.update("jax_platforms", "cpu")
 
+# Pallas must import while the "tpu" platform is still registered (its
+# checkify import registers a tpu lowering rule and dies on an unknown
+# platform) — so pull it in BEFORE dropping the backend factories. The
+# engine's megakernel (engine/megakernel.py) then imports it freely.
+import jax.experimental.pallas  # noqa: E402,F401
+
 try:  # jax-internal, but the only seam that works post-registration
     from jax._src import xla_bridge as _xb
 

@@ -1,0 +1,144 @@
+"""The fused Pallas round megakernel (engine/megakernel.py) is a pure
+scheduling change: engine="megakernel" must produce BIT-IDENTICAL state to
+the plain engine AND to the XLA pump — same queue contents, TCP fields,
+relay/AQM state, RNG counters, sequence counters, byte/stream counters —
+because its kernel body executes the exact same pump_microstep function,
+just fused into one launch over VMEM-resident tiles. On CPU the kernel
+runs in Pallas interpret mode (discharged to ordinary XLA ops), which is
+the always-on conformance path these tests pin down.
+
+Quick tier: one-launch smoke (megakernel_stage vs pump_stage on the same
+state, leaf-for-leaf equal) — the kernel path can never silently rot on
+CPU-only boxes. Slow tier: full-run digests vs the plain engine on the
+tgen worlds of test_pump.py (shaped, lossy, unshaped), exact equality vs
+the pump including iteration counts and under host tiling (grid > 1),
+and the phold fallback contract (models without a pump_spec take the
+plain handler inside the megakernel engine, bit-identically).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from test_pump import _assert_states_equal, _run, _world
+
+from shadow_tpu.simtime import NS_PER_MS
+
+
+def _assert_leaves_exact(a, b):
+    """Stricter than _assert_states_equal: NO normalization — slot
+    placement, iters_done, everything must match leaf-for-leaf."""
+    fa = jax.tree_util.tree_leaves_with_path(a)
+    fb = jax.tree.leaves(b)
+    assert len(fa) == len(fb)
+    for (path, la), lb in zip(fa, fb):
+        assert jnp.array_equal(la, lb), f"mismatch at {jax.tree_util.keystr(path)}"
+
+
+def test_megakernel_one_launch_smoke():
+    """Tier-1-safe: construct and run ONE fused launch in interpret mode
+    (no TPU) against one XLA pump stage on the same state — leaf-exact."""
+    from shadow_tpu.engine.megakernel import megakernel_stage
+    from shadow_tpu.engine.pump import pump_stage
+
+    cfg0, model, tables, st0 = _world(8, 0.0, 20_000_000, seed=3)
+    cfg = dataclasses.replace(cfg0, pump_k=3)
+    we = jnp.asarray(10 * NS_PER_MS, jnp.int64)
+    a, rej_a = jax.jit(
+        lambda s: pump_stage(s, we, model, tables, cfg)
+    )(st0)
+    b, rej_b = jax.jit(
+        lambda s: megakernel_stage(s, we, model, tables, cfg)
+    )(st0)
+    # the bootstrap queue holds local stream-start events: not a pump
+    # class, so both stages must reject (and mutate nothing else)
+    assert bool(rej_a) and bool(rej_b)
+    _assert_leaves_exact(a, b)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("loss,bw", [(0.0, 20_000_000), (0.02, 20_000_000)])
+def test_megakernel_bit_identical_tgen(loss, bw):
+    """The engine-parametrized run of the pump equivalence worlds: full
+    tgen runs under shaping/loss, digests equal to the plain engine."""
+    cfg0, model, tables, st0 = _world(32, loss, bw)
+    end = 120 * NS_PER_MS
+    ref = _run(cfg0, model, tables, st0, end)
+    got = _run(
+        dataclasses.replace(cfg0, pump_k=6, engine="megakernel"),
+        model, tables, st0, end,
+    )
+    assert int(ref.model.streams_done.sum()) > 0  # real traffic flowed
+    # fused iterations must be fewer (the whole point) ...
+    assert int(got.iters_done.sum()) < int(ref.iters_done.sum())
+    # ... with identical simulation results.
+    _assert_states_equal(ref, got)
+
+
+@pytest.mark.slow
+def test_megakernel_unshaped_world_matches():
+    """No netstack shaping: only P2/P3 apply; defers never occur."""
+    cfg0, model, tables, st0 = _world(16, 0.0, 0)
+    cfg0 = dataclasses.replace(cfg0, use_netstack=False)
+    end = 80 * NS_PER_MS
+    ref = _run(cfg0, model, tables, st0, end)
+    got = _run(
+        dataclasses.replace(cfg0, pump_k=5, engine="megakernel"),
+        model, tables, st0, end,
+    )
+    assert int(ref.model.streams_done.sum()) > 0
+    _assert_states_equal(ref, got)
+
+
+@pytest.mark.slow
+def test_megakernel_matches_pump_exactly_tiled():
+    """Leaf-exact equality with the XLA pump — including iters_done (same
+    iteration structure) and slot placement — with the host axis split
+    over a grid of 2 Pallas programs (megakernel_tile=8 at 16 hosts):
+    tiling must be invisible."""
+    cfg0, model, tables, st0 = _world(16, 0.02, 20_000_000)
+    end = 80 * NS_PER_MS
+    cfgp = dataclasses.replace(cfg0, pump_k=5)
+    p = _run(cfgp, model, tables, st0, end)
+    m = _run(
+        dataclasses.replace(cfgp, engine="megakernel", megakernel_tile=8),
+        model, tables, st0, end,
+    )
+    _assert_leaves_exact(p, m)
+
+
+@pytest.mark.slow
+def test_megakernel_bit_identical_phold():
+    """Models without a pump_spec fall back to the plain handler inside
+    the megakernel engine — bit-identically (the documented deferral
+    contract for non-hot event kinds)."""
+    from shadow_tpu.engine import EngineConfig, init_state
+    from shadow_tpu.engine.round import bootstrap, run_until
+    from shadow_tpu.graph import NetworkGraph, compute_routing
+    from shadow_tpu.models.phold import PholdModel
+
+    g = NetworkGraph.from_gml(
+        """graph [
+  directed 0
+  node [ id 0 ]
+  node [ id 1 ]
+  edge [ source 0 target 0 latency "1 ms" ]
+  edge [ source 1 target 1 latency "1 ms" ]
+  edge [ source 0 target 1 latency "3 ms" ]
+]"""
+    )
+    tables = compute_routing(g).with_hosts([i % 2 for i in range(8)])
+    cfg = EngineConfig(
+        num_hosts=8, runahead_ns=g.min_latency_ns(), queue_capacity=32
+    )
+    model = PholdModel(num_hosts=8)
+    st = init_state(cfg, model.init())
+    st = bootstrap(st, model, cfg)
+    a = run_until(st, 200 * NS_PER_MS, model, tables, cfg)
+    b = run_until(
+        st, 200 * NS_PER_MS, model, tables,
+        dataclasses.replace(cfg, engine="megakernel"),
+    )
+    _assert_leaves_exact(a, b)

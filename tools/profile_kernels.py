@@ -1,11 +1,27 @@
-"""Kernel-count vs width: compile the iteration body at several host
+"""Kernel-count vs width, and measured per-iteration engine costs.
+
+Part 1 (width scan): compile the plain iteration body at several host
 widths on the live backend, print optimized-HLO fusion/kernel counts and
 fresh-input timings. If time is ~flat in width while kernel count is
 constant, the body is launch-bound and the lever is fewer kernels.
 
-  python tools/profile_kernels.py [reps]
+Part 2 (engine comparison, round-6 verdict Next #3): measure the
+per-iteration cost of all three round engines — plain (one-event-per-host
+handler), pump (XLA microscan, engine/pump.py) and megakernel (fused
+Pallas launch, engine/megakernel.py) — on the bench workload's burst
+phase. All three are bit-identical, so the comparison starts every
+engine from the same mid-burst state and divides wall time by the
+drain-loop iterations actually executed (SimState.iters_done). The
+resulting table is the one published in docs/megakernel.md.
+
+  python tools/profile_kernels.py [reps] [engine_hosts]
+
+Env knobs: SHADOW_TPU_PROFILE_WIDTHS (comma list, part 1),
+SHADOW_TPU_PROFILE_BURST_MS (start,end sim-ms for part 2, default 20,60).
 """
 
+import json
+import os
 import re
 import sys
 import time
@@ -13,25 +29,29 @@ import time
 sys.path.insert(0, ".")
 
 
-def main():
-    reps = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+def _fusion_count(compiled_text: str) -> int:
+    return len(re.findall(r"^\s*(fusion|%fusion)", compiled_text, re.M))
 
+
+def profile_widths(reps: int):
     import jax
     import jax.numpy as jnp
 
     from bench import _build
     from shadow_tpu.engine.round import handle_one_iteration
 
+    default_widths = (
+        "1280,10240" if jax.default_backend() == "tpu" else "640,1280"
+    )
+    widths_env = os.environ.get("SHADOW_TPU_PROFILE_WIDTHS", default_widths)
+    widths = [int(x) for x in widths_env.split(",") if x.strip()]
     we = jnp.asarray(10**15, jnp.int64)
     out = {}
-    for hosts in (1280, 10240):
+    for hosts in widths:
         cfg, model, tables, st0 = _build(hosts)
         f = jax.jit(lambda s: handle_one_iteration(s, we, model, tables, cfg))
-        lowered = f.lower(st0)
-        compiled = lowered.compile()
+        compiled = f.lower(st0).compile()
         txt = compiled.as_text()
-        kernels = len(re.findall(r"^\s*(fusion|%fusion)", txt, re.M))
-        ops = txt.count("\n")
         # fresh-input timing
         st = f(st0)
         jax.block_until_ready(st.events_handled)
@@ -44,12 +64,105 @@ def main():
             jax.block_until_ready(o.events_handled)
             ts.append(time.perf_counter() - t0)
         out[hosts] = {
-            "fusions": kernels,
-            "hlo_lines": ops,
+            "fusions": _fusion_count(txt),
+            "hlo_lines": txt.count("\n"),
             "best_ms": round(min(ts) * 1e3, 2),
         }
         print(hosts, out[hosts], flush=True)
-    print(out, flush=True)
+    return out
+
+
+def profile_engines(reps: int, hosts: int):
+    """Per-iteration cost of plain vs pump vs megakernel on the burst
+    phase: identical start state (the engines are bit-identical, so any
+    engine may produce it), wall divided by drain-loop iterations."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench import _build
+    from shadow_tpu.engine.round import run_round, run_until
+
+    burst_env = os.environ.get("SHADOW_TPU_PROFILE_BURST_MS", "20,60")
+    b0_ms, b1_ms = [int(x) for x in burst_env.split(",")]
+    b0, b1 = b0_ms * 1_000_000, b1_ms * 1_000_000
+
+    cfg0, model, tables, st0 = _build(hosts)
+    st_burst = run_until(st0, b0, model, tables, cfg0, rounds_per_chunk=32)
+    jax.block_until_ready(st_burst.events_handled)
+    iters0 = int(np.asarray(st_burst.iters_done).sum())
+    ev0 = int(np.asarray(st_burst.events_handled).sum())
+
+    variants = {
+        "plain": dataclasses.replace(cfg0, engine="plain", pump_k=0),
+        "pump": dataclasses.replace(cfg0, engine="pump", pump_k=8),
+        "megakernel": dataclasses.replace(
+            cfg0, engine="megakernel", pump_k=8
+        ),
+    }
+    out = {}
+    for name, cfg in variants.items():
+        row = {}
+        try:
+            we = jnp.asarray(b0 + cfg.runahead_ns, jnp.int64)
+            body = jax.jit(
+                lambda s, c=cfg: run_round(s, we, model, tables, c)
+            )
+            row["fusions"] = _fusion_count(
+                body.lower(st_burst).compile().as_text()
+            )
+            # warm the chunked executable, then time the burst window
+            s = run_until(
+                st_burst, b1, model, tables, cfg, rounds_per_chunk=32
+            )
+            jax.block_until_ready(s.events_handled)
+            walls = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                s = run_until(
+                    st_burst, b1, model, tables, cfg, rounds_per_chunk=32
+                )
+                jax.block_until_ready(s.events_handled)
+                walls.append(time.perf_counter() - t0)
+            wall = min(walls)
+            iters = int(np.asarray(s.iters_done).sum()) - iters0
+            events = int(np.asarray(s.events_handled).sum()) - ev0
+            row.update(
+                wall_s=round(wall, 3),
+                iters=iters,
+                events=events,
+                us_per_iter=round(wall / max(iters, 1) * 1e6, 1),
+                ns_per_event=round(wall / max(events, 1) * 1e9, 1),
+            )
+        except Exception as e:  # noqa: BLE001 — a backend that cannot
+            # lower one engine must not kill the comparison of the others
+            row["error"] = str(e)[:300]
+        out[name] = row
+        print(json.dumps({"engine": name, **row}), flush=True)
+    if "us_per_iter" in out.get("plain", {}):
+        for name in ("pump", "megakernel"):
+            if "us_per_iter" in out.get(name, {}):
+                out[name]["iter_cost_vs_plain"] = round(
+                    out[name]["us_per_iter"] / out["plain"]["us_per_iter"], 3
+                )
+    return out
+
+
+def main():
+    import jax
+
+    reps = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    eng_hosts = (
+        int(sys.argv[2])
+        if len(sys.argv) > 2
+        else (10240 if jax.default_backend() == "tpu" else 640)
+    )
+    out = {"backend": jax.default_backend()}
+    out["widths"] = profile_widths(reps)
+    out["engines"] = profile_engines(reps, eng_hosts)
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":
