@@ -219,11 +219,14 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
         cfg = trials[engine_choice][1]
     t0 = time.perf_counter()
 
-    def on_chunk(st):
+    def on_chunk(probe):
+        # probe is the driver's ChunkProbe (already-fetched ints): the
+        # progress line costs no device sync and never stalls the
+        # depth-2 dispatch pipeline
         print(
             json.dumps(
                 {
-                    "progress": int(np.asarray(st.now)),
+                    "progress": probe.now,
                     "wall": round(time.perf_counter() - t0, 3),
                 }
             ),
@@ -292,7 +295,7 @@ def _run_attempt(env: dict, timeout_s: float) -> dict:
         err_tail = f"timeout after {timeout_s}s; stderr: {_s(e.stderr)[-500:]}"
         timed_out = True
 
-    result, last_progress = None, None
+    result, last_progress, engine_trials = None, None, {}
     for ln in out_lines:
         try:
             obj = json.loads(ln)
@@ -302,6 +305,10 @@ def _run_attempt(env: dict, timeout_s: float) -> dict:
             last_progress = obj
         elif "backend" in obj:
             result = obj
+        elif "engine_trial" in obj and "wall" in obj:
+            # auto-select trial timings print before the main run starts,
+            # so even a timed-out attempt records which engine won
+            engine_trials[obj["engine_trial"]] = obj["wall"]
     if result is not None:
         return {"ok": True, "result": result}
     out = {
@@ -315,6 +322,8 @@ def _run_attempt(env: dict, timeout_s: float) -> dict:
             "wall_s": last_progress["wall"],
             "rate": last_progress["progress"] / NS_PER_SEC / last_progress["wall"],
         }
+    if engine_trials:
+        out["engine_trials"] = engine_trials
     return out
 
 
@@ -340,12 +349,17 @@ def main():
     tpu_up = not force_cpu and _device_probe_ok()
 
     if tpu_up:
-        # Retry ladder: same size with shorter device calls first (the
-        # likely failure is the tunnel's dislike of long-running device
-        # executions), then progressively smaller worlds.
-        # (hosts, sim_sec, rounds_per_chunk)
+        # Retry ladder: the full-scale world first shrinks
+        # rounds_per_chunk adaptively on timeout (128 -> 32 -> 16, the
+        # likely failure being the tunnel's dislike of long device
+        # executions) WITHIN one shared full-scale deadline budget — a
+        # timeout at the default rpc leaves the rest of the budget to a
+        # shorter-chunk retry of the SAME world instead of failing
+        # straight down to half-scale — then progressively smaller
+        # worlds. (hosts, sim_sec, rounds_per_chunk)
         ladder = [
             (num_hosts, sim_sec, rpc),
+            (num_hosts, sim_sec, 32),
             (num_hosts, sim_sec, 16),
             (num_hosts // 2, sim_sec, 16),
             (num_hosts // 4, sim_sec, 32),
@@ -387,6 +401,17 @@ def main():
 
     attempts_log, main_res, used = [], None, None
     best_partial = None
+    # wall budget shared by every full-scale rung: the old single
+    # full-scale attempt's 1100 s timeout stays intact for rung 0 (no
+    # regression for runs that fit it), plus a ~300 s reserve funding the
+    # adaptive rpc-shrink retries after a timeout — paid for by the
+    # smaller-world ladder being one rung shorter than the total wall the
+    # old ladder could burn, so the bench's overall worst case shrinks
+    full_budget = 1400.0
+    # engine auto-selected by a (possibly failed) earlier attempt: the
+    # trial lines print before the main run, so a timed-out full-scale
+    # attempt still tells the rpc-shrink retries which engine won there
+    chosen_engine = None
     for i, (h, s, r) in enumerate(attempts_cfg):
         env_extra = dict(
             SHADOW_TPU_BENCH_ROLE="measure",
@@ -397,10 +422,11 @@ def main():
         if i > 0 or not tpu_up:
             # retries and the CPU fallback compile ONE engine, not the
             # whole auto-select trial set: the user's explicit pin when
-            # set (ENGINE wins over a numeric PUMP_K), else the
-            # known-good plain engine — never re-auto-select, and never
-            # let an inherited env var silently re-run an engine the
-            # user didn't pin
+            # set (ENGINE wins over a numeric PUMP_K), else the engine a
+            # previous attempt's auto-select already measured fastest on
+            # this workload, else the known-good plain engine — never
+            # re-auto-select, and never let an inherited env var
+            # silently re-run an engine the user didn't pin
             user_engine = os.environ.get("SHADOW_TPU_BENCH_ENGINE", "auto")
             user_pump = os.environ.get("SHADOW_TPU_BENCH_PUMP_K", "auto")
             if user_engine != "auto":
@@ -408,15 +434,32 @@ def main():
             elif user_pump != "auto":
                 env_extra["SHADOW_TPU_BENCH_PUMP_K"] = user_pump
             else:
-                env_extra["SHADOW_TPU_BENCH_ENGINE"] = "plain"
+                env_extra["SHADOW_TPU_BENCH_ENGINE"] = chosen_engine or "plain"
         env = _child_env(**env_extra) if tpu_up else _cpu_env(**env_extra)
         if tpu_up:
-            timeout_s = 1100 if i == 0 else 700
+            if h == num_hosts:
+                if full_budget < 90:
+                    continue  # full-scale budget spent: drop to smaller worlds
+                # rung 0 keeps the old attempt's full 1100 s (anything
+                # that published before still publishes); a timeout
+                # leaves the shorter-chunk retries the ~300 s reserve —
+                # enough for a salvageable full-scale partial (the
+                # progress line goes out before compilation starts)
+                timeout_s = min(1100.0, full_budget) if i == 0 else full_budget
+            else:
+                timeout_s = 700
         else:
             timeout_s = min(420.0, max(_time_left(), 60.0))
+        t_att = time.perf_counter()
         att = _run_attempt(env, timeout_s=timeout_s)
+        if tpu_up and h == num_hosts:
+            full_budget -= time.perf_counter() - t_att
         att["config"] = {"hosts": h, "sim_sec": s, "rounds_per_chunk": r}
         attempts_log.append(att)
+        if att.get("engine_trials"):
+            chosen_engine = min(
+                att["engine_trials"], key=att["engine_trials"].get
+            )
         if att["ok"]:
             main_res, used = att["result"], (h, s, r)
             break

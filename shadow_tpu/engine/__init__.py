@@ -1,15 +1,18 @@
 from shadow_tpu.engine.state import EngineConfig, LocalEmits, PacketEmits, SimState, init_state
 from shadow_tpu.engine.round import (
+    ChunkProbe,
     bootstrap,
     round_body_debug,
     run_round,
     run_rounds_scan,
     run_until,
+    state_probe,
     validate_runahead,
 )
 from shadow_tpu.engine.sharded import ShardedRunner, shard_state, state_specs
 
 __all__ = [
+    "ChunkProbe",
     "EngineConfig",
     "LocalEmits",
     "PacketEmits",
@@ -22,6 +25,7 @@ __all__ = [
     "run_rounds_scan",
     "run_until",
     "shard_state",
+    "state_probe",
     "state_specs",
     "validate_runahead",
 ]

@@ -895,8 +895,24 @@ def pump_stage(
     eligible head event failed classification this call — only then does
     the caller need to run the full handler this iteration (hosts whose
     chains simply exceeded pump_k keep pumping next iteration).
+
+    Once every lane is dead (all chains ended before pump_k — the common
+    case: typical chains run 2-3 events), the remaining microsteps take an
+    identity `cond` branch that aliases the whole carry through unchanged
+    instead of paying the full microstep arithmetic. Bit-exact: a
+    microstep on an all-dead carry is the identity (every write is masked
+    by `take`/`alive`, both all-False). The eager debug path keeps the
+    plain loop — its per-step tallies need concrete values.
     """
     c = pump_carry_init(st, model, tables, cfg)
+    if debug_out is not None:
+        for _step in range(cfg.pump_k):
+            c = pump_microstep(c, window_end, model, tables, cfg, debug_out)
+        return pump_carry_finish(st, c, model, cfg)
+
+    def step(c):
+        return pump_microstep(c, window_end, model, tables, cfg)
+
     for _step in range(cfg.pump_k):
-        c = pump_microstep(c, window_end, model, tables, cfg, debug_out)
+        c = jax.lax.cond(jnp.any(c.alive), step, lambda c: c, c)
     return pump_carry_finish(st, c, model, cfg)

@@ -60,6 +60,15 @@ class Draw:
         return rng.exponential_ns(self.key, self.counter + jnp.uint32(i), mean_ns)
 
 
+def _axis_size(axis_name) -> int:
+    """Static size of a bound mesh axis. jax >= 0.5 exposes
+    jax.lax.axis_size; on older versions psum of a Python int
+    constant-folds to the same static value inside shard_map."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
 def _lane_seqs(valid: jax.Array, base: jax.Array):
     """Per-lane sequence numbers: base + (# valid lanes before this one).
     Kept in uint32 explicitly (jnp.sum/cumsum promote unsigned ints under
@@ -364,6 +373,24 @@ def handle_one_iteration_compact(
     return jax.tree.map(put, st, sub)
 
 
+def _has_traffic(st: SimState, axis_name: Optional[str]) -> jax.Array:
+    """Mesh-uniform "any packet staged in an outbox". Shared by
+    flush_outbox's skip-cond and run_rounds_scan's quiescence gate — the
+    two MUST agree, or the early-exit idle branch could skip a flush that
+    would have delivered traffic."""
+    t = jnp.any(st.outbox.valid)
+    if axis_name is not None:
+        t = jax.lax.psum(t.astype(jnp.int32), axis_name) > 0
+    return t
+
+
+def _overflow_total(st: SimState) -> jax.Array:
+    """Capacity accounting shared by check_capacity's peek and the
+    dispatch probe's overflow lane — one source of truth for what counts
+    as a dropped slot."""
+    return jnp.sum(st.queue.overflow) + jnp.sum(st.outbox.overflow)
+
+
 def flush_outbox(
     st: SimState, axis_name: Optional[str], cfg: "EngineConfig | None" = None
 ) -> SimState:
@@ -387,11 +414,7 @@ def flush_outbox(
     # any-reduce). Sharded: the predicate is made mesh-uniform with a
     # psum, because the all_to_all/all_gather inside must be entered by
     # every shard or none.
-    has_traffic = jnp.any(st.outbox.valid)
-    if axis_name is not None:
-        has_traffic = (
-            jax.lax.psum(has_traffic.astype(jnp.int32), axis_name) > 0
-        )
+    has_traffic = _has_traffic(st, axis_name)
 
     def _skip(st):
         return st
@@ -425,7 +448,7 @@ def _flush_outbox_traffic(
         mode = getattr(cfg, "exchange", "all_to_all") if cfg is not None else "all_gather"
         base = jax.lax.axis_index(axis_name) * h_local
         if mode == "all_to_all":
-            d = jax.lax.axis_size(axis_name)
+            d = _axis_size(axis_name)
             cap = getattr(cfg, "a2a_capacity", 0) or 0
             if cap <= 0:
                 # safe default: each peer bucket can hold the whole local
@@ -598,10 +621,11 @@ def run_round(
     )
 
 
-def _next_window_end(st: SimState, end_time, cfg: EngineConfig, axis_name):
-    start = jnp.min(equeue.next_time(st.queue))
-    if axis_name is not None:
-        start = jax.lax.pmin(start, axis_name)
+def _next_window_end(st: SimState, end_time, cfg: EngineConfig, axis_name, start=None):
+    if start is None:
+        start = jnp.min(equeue.next_time(st.queue))
+        if axis_name is not None:
+            start = jax.lax.pmin(start, axis_name)
     start = jnp.minimum(start, end_time)
     runahead = jnp.asarray(cfg.runahead_ns, jnp.int64)
     if cfg.use_dynamic_runahead:
@@ -626,11 +650,34 @@ def run_rounds_scan(
     axis_name: Optional[str] = None,
 ) -> SimState:
     """Run a fixed number of rounds fully on device (rounds past the end of
-    the simulation, or past the last pending event, are no-ops)."""
+    the simulation, or past the last pending event, are no-ops).
+
+    Quiescence early-exit: once no event remains before `end_time` (and no
+    packet is staged in an outbox), the remaining rounds of the scan take a
+    no-op `cond` branch — a single window-advance write — instead of paying
+    a full drain `while_loop` + flush per round. Bit-exact either way: on a
+    quiescent state the drain loop runs zero iterations and flush_outbox's
+    own empty-outbox cond returns the state untouched (this predicate's
+    `has_traffic` term guarantees the idle branch is only taken when that
+    cond would skip), so `run_round` reduces to exactly the idle branch's
+    write. tests/test_pipeline.py rerun-stability pins the equivalence.
+    Sharded, both predicates are made mesh-uniform (pmin/psum) because the
+    live branch contains the exchange collectives."""
 
     def one(s, _):
-        window_end = _next_window_end(s, end_time, cfg, axis_name)
-        return run_round(s, window_end, model, tables, cfg, axis_name), None
+        start = jnp.min(equeue.next_time(s.queue))
+        if axis_name is not None:
+            start = jax.lax.pmin(start, axis_name)
+        has_traffic = _has_traffic(s, axis_name)
+        window_end = _next_window_end(s, end_time, cfg, axis_name, start=start)
+
+        def live(s):
+            return run_round(s, window_end, model, tables, cfg, axis_name)
+
+        def idle(s):
+            return s.replace(now=jnp.maximum(s.now, window_end))
+
+        return jax.lax.cond((start < end_time) | has_traffic, live, idle, s), None
 
     st, _ = jax.lax.scan(one, st, None, length=num_rounds)
     return st
@@ -656,7 +703,59 @@ def _peek_next_time(st: SimState) -> jax.Array:
 
 @jax.jit
 def _peek_overflow(st: SimState) -> jax.Array:
-    return jnp.sum(st.queue.overflow) + jnp.sum(st.outbox.overflow)
+    return _overflow_total(st)
+
+
+# --- dispatch probe ----------------------------------------------------
+# Everything the host needs to decide whether to keep dispatching chunks,
+# packed into ONE small device array so the driver fetches a handful of
+# scalars per chunk instead of syncing any [H]-shaped state. Lanes:
+#   next_time  — min pending event time across all hosts (quiescence test)
+#   overflow   — queue+outbox slots dropped (capacity check, every chunk)
+#   now        — current window start (progress/heartbeats)
+#   events_handled / packets_sent — totals (heartbeat lines)
+
+PROBE_NEXT_TIME = 0
+PROBE_OVERFLOW = 1
+PROBE_NOW = 2
+PROBE_EVENTS = 3
+PROBE_PACKETS = 4
+PROBE_LANES = 5
+
+
+def state_probe(st: SimState, axis_name: Optional[str] = None) -> jax.Array:
+    """[PROBE_LANES] i64 summary of a chunk's outcome, computed on device
+    as part of the chunk itself (no separate peek dispatch). Sharded, the
+    lanes are reduced over the mesh axis so the probe is replicated."""
+    nt = jnp.min(equeue.next_time(st.queue))
+    ov = _overflow_total(st).astype(jnp.int64)
+    ev = jnp.sum(st.events_handled)
+    pk = jnp.sum(st.packets_sent)
+    now = st.now
+    if axis_name is not None:
+        nt = jax.lax.pmin(nt, axis_name)
+        ov = jax.lax.psum(ov, axis_name)
+        ev = jax.lax.psum(ev, axis_name)
+        pk = jax.lax.psum(pk, axis_name)
+        now = jax.lax.pmax(now, axis_name)
+    return jnp.stack([nt, ov, now, ev, pk]).astype(jnp.int64)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkProbe:
+    """Host-side view of one fetched probe (plain ints). This is what
+    `on_chunk` callbacks receive: progress/heartbeat lines read these
+    fields instead of forcing a device sync on the full state."""
+
+    next_time: int
+    overflow: int
+    now: int
+    events_handled: int
+    packets_sent: int
+
+    @classmethod
+    def from_array(cls, arr) -> "ChunkProbe":
+        return cls(*(int(x) for x in arr))
 
 
 class CapacityError(RuntimeError):
@@ -670,22 +769,85 @@ def check_capacity(st: SimState) -> None:
     unbounded queues never dropping)."""
     dropped = int(_peek_overflow(st))
     if dropped:
-        raise CapacityError(
-            f"event capacity exhausted: {dropped} events/packets dropped "
-            f"(queue.overflow/outbox.overflow); increase queue_capacity/"
-            f"outbox_capacity — or, for sharded all_to_all runs with "
-            f"pair-skewed destinations, set a2a_capacity=-1 (whole-outbox "
-            f"buckets, never overflow)"
-        )
+        raise _capacity_error(dropped)
 
 
 def _run_chunk(st, end, num_rounds, model, tables, cfg):
-    return run_rounds_scan(st, end, num_rounds, model, tables, cfg)
+    st = run_rounds_scan(st, end, num_rounds, model, tables, cfg)
+    return st, state_probe(st)
 
 
 # model/cfg are hashable frozen dataclasses -> proper jit cache keys, so
-# repeated run_until calls reuse the compiled chunk executable.
-_run_chunk_jit = jax.jit(_run_chunk, static_argnums=(2, 3, 5))
+# repeated run_until calls reuse the compiled chunk executable. The state
+# is DONATED: the O(hosts x queue_cap) HBM pytree is aliased in-place
+# across chunks instead of copied per chunk — drivers must feed this only
+# states they own (SimState.donatable()), never a caller's buffers.
+_run_chunk_jit = jax.jit(_run_chunk, static_argnums=(2, 3, 5), donate_argnums=(0,))
+
+
+def _capacity_error(dropped: int) -> CapacityError:
+    return CapacityError(
+        f"event capacity exhausted: {dropped} events/packets dropped "
+        f"(queue.overflow/outbox.overflow); increase queue_capacity/"
+        f"outbox_capacity — or, for sharded all_to_all runs with "
+        f"pair-skewed destinations, set a2a_capacity=-1 (whole-outbox "
+        f"buckets, never overflow)"
+    )
+
+
+def _drive(launch, st, end_time, max_chunks, on_chunk, pipeline, desc):
+    """The shared chunk-dispatch loop behind run_until and
+    ShardedRunner.run_until.
+
+    `launch(state) -> (state, probe)` dispatches one device chunk,
+    donating its input. With `pipeline` on (depth 2), chunk N+1 is
+    launched BEFORE chunk N's probe is fetched, so the device starts the
+    next chunk while the host is still blocked on (and then deciding
+    from) the previous probe; the probe transfer is a few scalars, never
+    the state. The probe's overflow lane is checked every chunk, so a
+    capacity blowup raises at the chunk it occurs. The driver hard-syncs
+    only at termination: quiescence (probe.next_time >= end_time),
+    capacity error, or max_chunks exhaustion.
+
+    On quiescence with a chunk already in flight, that extra chunk ran
+    entirely on a quiescent state — every round took run_rounds_scan's
+    idle branch — so its output is leaf-identical and is returned as-is.
+    """
+    pend_st, pend_probe = launch(st)
+    launched = 1
+    while True:
+        nxt = None
+        if pipeline and launched < max_chunks:
+            nxt = launch(pend_st)  # donates pend_st; device stays busy
+            launched += 1
+        probe = ChunkProbe.from_array(jax.device_get(pend_probe))
+        if probe.overflow:
+            raise _capacity_error(probe.overflow)
+        if on_chunk is not None:
+            on_chunk(probe)
+        if probe.next_time >= end_time:
+            if nxt is None:
+                return pend_st
+            # The extra in-flight chunk ran on a quiescent state, so every
+            # round took the idle branch: leaf-identical output, except
+            # that when quiescence landed exactly on the chunk boundary
+            # the idle rounds clamp `now` to end_time where the
+            # synchronous driver stopped at the last productive window.
+            # Restore chunk N's `now` (it rides the probe) so pipelined
+            # and synchronous results are leaf-exact in every case.
+            return nxt[0].replace(
+                now=jnp.asarray(probe.now, nxt[0].now.dtype)
+            )
+        if nxt is None:
+            if launched < max_chunks:  # synchronous mode: launch after probe
+                nxt = launch(pend_st)
+                launched += 1
+            else:
+                raise RuntimeError(
+                    f"simulation did not reach end_time={end_time} within "
+                    f"{desc}; raise max_chunks/rounds_per_chunk"
+                )
+        pend_st, pend_probe = nxt
 
 
 def run_until(
@@ -697,29 +859,38 @@ def run_until(
     rounds_per_chunk: int = 64,
     max_chunks: int = 10_000,
     on_chunk=None,
+    pipeline: bool = True,
 ) -> SimState:
     """Host-side driver: chunked device scans until no work remains before
-    end_time (one host<->device sync per chunk). Single-device variant; the
-    sharded driver lives in engine/sharded.py. `on_chunk(state)` is invoked
-    after every device chunk (heartbeats/progress)."""
-    validate_runahead(cfg, tables)
-    end = jnp.asarray(end_time, jnp.int64)
+    end_time. Single-device variant; the sharded driver lives in
+    engine/sharded.py.
 
-    for _ in range(max_chunks):
-        nt = int(_peek_next_time(st))
-        if nt >= end_time:
-            check_capacity(st)
-            return st
-        st = _run_chunk_jit(st, end, rounds_per_chunk, model, tables, cfg)
-        if on_chunk is not None:
-            on_chunk(st)
-    check_capacity(st)
-    if int(_peek_next_time(st)) < end_time:
-        raise RuntimeError(
-            f"simulation did not reach end_time={end_time} within "
-            f"{max_chunks}x{rounds_per_chunk} rounds; raise max_chunks/rounds_per_chunk"
-        )
-    return st
+    Chunks are dispatched through a depth-2 async pipeline with the state
+    donated between chunks (see _drive): the host never blocks on more
+    than the [PROBE_LANES] probe array, and the HBM state is aliased
+    in-place across chunks. `pipeline=False` keeps the same executable but
+    fetches each chunk's probe before launching the next — the synchronous
+    reference the equivalence tests pin the pipeline against.
+
+    `on_chunk(probe: ChunkProbe)` is invoked once per completed chunk
+    (heartbeats/progress); it receives the fetched probe, not the state.
+    """
+    validate_runahead(cfg, tables)
+    if int(_peek_next_time(st)) >= end_time:
+        # already quiescent: the zero-work fast path of the old driver —
+        # no copy, no chunk dispatch, caller's state returned untouched
+        check_capacity(st)
+        return st
+    end = jnp.asarray(end_time, jnp.int64)
+    st = st.donatable()  # the caller's buffers are never donated
+
+    def launch(s):
+        return _run_chunk_jit(s, end, rounds_per_chunk, model, tables, cfg)
+
+    return _drive(
+        launch, st, end_time, max_chunks, on_chunk, pipeline,
+        desc=f"{max_chunks}x{rounds_per_chunk} rounds",
+    )
 
 
 def round_body_debug(

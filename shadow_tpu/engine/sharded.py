@@ -29,10 +29,23 @@ try:  # stable alias in newer jax
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
+import inspect as _inspect
+
+# newer jax renamed the replication-check kwarg check_rep -> check_vma;
+# pass whichever this version accepts (the check stays off either way:
+# the chunk's probe output is made replicated by explicit collectives)
+_SHARD_MAP_CHECK_KW = (
+    "check_vma"
+    if "check_vma" in _inspect.signature(shard_map).parameters
+    else "check_rep"
+)
+
 from shadow_tpu.engine.round import (
+    _drive,
     _peek_next_time,
     check_capacity,
     run_rounds_scan,
+    state_probe,
     validate_runahead,
 )
 from shadow_tpu.engine.state import EngineConfig, SimState
@@ -112,7 +125,7 @@ class ShardedRunner:
         tspecs = jax.tree.map(lambda _: P(), self.tables)
 
         def chunk(st_local, tables_r, end):
-            return run_rounds_scan(
+            out = run_rounds_scan(
                 st_local,
                 end,
                 self.rounds_per_chunk,
@@ -121,34 +134,49 @@ class ShardedRunner:
                 self.cfg,
                 axis_name=AXIS,
             )
+            # probe lanes are reduced over the mesh axis inside the chunk,
+            # so the replicated [PROBE_LANES] output is the only thing the
+            # driver ever blocks on
+            return out, state_probe(out, axis_name=AXIS)
 
         f = shard_map(
             chunk,
             mesh=self.mesh,
             in_specs=(specs, tspecs, P()),
-            out_specs=specs,
-            check_vma=False,
+            out_specs=(specs, P()),
+            **{_SHARD_MAP_CHECK_KW: False},
         )
-        return jax.jit(f)
+        # the sharded state is donated chunk-to-chunk, same as the
+        # single-device driver (run_until feeds only its private copy)
+        return jax.jit(f, donate_argnums=(0,))
 
     def run_until(
-        self, st: SimState, end_time: int, max_chunks: int = 10_000, on_chunk=None
+        self,
+        st: SimState,
+        end_time: int,
+        max_chunks: int = 10_000,
+        on_chunk=None,
+        pipeline: bool = True,
     ) -> SimState:
+        """Sharded chunk driver: the same depth-2 async dispatch pipeline
+        as engine/round.py run_until (donated state, probe-only syncs,
+        per-chunk capacity checks); `on_chunk` receives a ChunkProbe."""
         st = shard_state(st, self.mesh)
+        if int(_peek_next_time(st)) >= end_time:
+            # already quiescent: zero-work fast path, state untouched
+            check_capacity(st)
+            return st
+        # shard_state is a no-op alias when the input is already laid out;
+        # donatable() guarantees the caller's buffers are never donated
+        st = st.donatable()
         if self._compiled is None:
             self._compiled = self._chunk_fn(st)
         end = jnp.asarray(end_time, jnp.int64)
-        for _ in range(max_chunks):
-            if int(_peek_next_time(st)) >= end_time:
-                check_capacity(st)
-                return st
-            st = self._compiled(st, self.tables, end)
-            if on_chunk is not None:
-                on_chunk(st)
-        check_capacity(st)
-        if int(_peek_next_time(st)) < end_time:
-            raise RuntimeError(
-                f"sharded simulation did not reach end_time={end_time} within "
-                f"{max_chunks}x{self.rounds_per_chunk} rounds"
-            )
-        return st
+
+        def launch(s):
+            return self._compiled(s, self.tables, end)
+
+        return _drive(
+            launch, st, end_time, max_chunks, on_chunk, pipeline,
+            desc=f"{max_chunks}x{self.rounds_per_chunk} rounds (sharded)",
+        )

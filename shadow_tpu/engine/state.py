@@ -187,6 +187,22 @@ class SimState:
     def num_hosts(self) -> int:
         return self.seq.shape[0]
 
+    def donatable(self) -> "SimState":
+        """A fresh private copy whose buffers a driver may donate into a
+        jitted chunk (`donate_argnums`), aliasing the O(hosts x queue_cap)
+        HBM state in-place instead of copying it every chunk.
+
+        Donation invalidates the donated buffers at dispatch: any stale
+        reuse of a donated state raises jax's "Array has been deleted"
+        RuntimeError instead of silently reading aliased memory — that is
+        the no-stale-reference assertion drivers rely on. Copying here
+        (jnp.copy preserves sharding) is what keeps the CALLER's SimState
+        valid: drivers call donatable() once on entry and donate only the
+        private copy, so run_until(st, ...) never destroys `st`. Note
+        device_put with an unchanged sharding returns the same aliased
+        buffers, which is why this must be a real copy."""
+        return jax.tree.map(jnp.copy, self)
+
 
 @flax.struct.dataclass
 class LocalEmits:

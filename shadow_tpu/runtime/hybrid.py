@@ -44,7 +44,12 @@ import numpy as np
 
 from shadow_tpu import equeue
 from shadow_tpu.engine import EngineConfig
-from shadow_tpu.engine.round import CapacityError, run_round
+from shadow_tpu.engine.round import (
+    PROBE_OVERFLOW,
+    CapacityError,
+    run_round,
+    state_probe,
+)
 from shadow_tpu.engine.state import init_state
 from shadow_tpu.events import pack_tie
 from shadow_tpu.graph.routing import RoutingTables
@@ -107,26 +112,22 @@ def _pack_sends(sends: "list[tuple]"):
     return valid, src, time, tie, data
 
 
-def _fetch_records(st):
+def _fetch_records(st, probe):
     """Pull outcome records off the device in the serial application order
-    (time, src, seq). Returns (t, srcs, seqs, flags, order) or None when
-    empty; raises CapacityError on any device-side overflow."""
+    (time, src, seq): ONE bulk jax.device_get of the record arrays plus
+    the pass probe, numpy slicing, then a single tolist() per column — no
+    per-element int() at the round boundary. Returns (t, srcs, seqs,
+    flags) as plain-int lists in application order, or None when empty;
+    raises CapacityError on any device-side overflow (queue/outbox
+    overflow rides the probe's overflow lane)."""
     m = st.model
-    rec = jax.device_get(
-        (
-            m.rec_time,
-            m.rec_data,
-            m.rec_flag,
-            m.rec_overflow,
-            st.queue.overflow,
-            st.outbox.overflow,
-        )
-    )
-    r_time, r_data, r_flag, r_ov, q_ov, o_ov = rec
-    if int(r_ov.sum()) or int(q_ov.sum()) or int(o_ov.sum()):
+    rec = jax.device_get((probe, m.rec_time, m.rec_data, m.rec_flag, m.rec_overflow))
+    pr, r_time, r_data, r_flag, r_ov = rec
+    engine_ov = int(pr[PROBE_OVERFLOW])
+    if int(r_ov.sum()) or engine_ov:
         raise CapacityError(
             f"hybrid device capacity exhausted (records={int(r_ov.sum())}, "
-            f"queue={int(q_ov.sum())}, outbox={int(o_ov.sum())}); raise "
+            f"queue+outbox={engine_ov}); raise "
             f"record_capacity/queue_capacity/outbox_capacity"
         )
     hh, aa = np.nonzero(r_flag > 0)
@@ -138,7 +139,12 @@ def _fetch_records(st):
     srcs = d[:, LANE_SRC]
     flags = r_flag[hh, aa]
     order = np.lexsort((seqs, srcs, t))
-    return t, srcs, seqs, flags, order
+    return (
+        t[order].tolist(),
+        srcs[order].tolist(),
+        seqs[order].tolist(),
+        flags[order].tolist(),
+    )
 
 
 class HybridScheduler:
@@ -180,14 +186,19 @@ class HybridScheduler:
         self.inflight = 0
         self.device_passes = 0
         self._horizon: "int | None" = None
+        self._probe = None  # device probe of the latest pass
 
         model, cfgs, tabs = self.model, self.cfg, self.tables
 
+        # self.st is scheduler-private (built above from init_state), so
+        # both jitted entry points donate it: the per-pass HBM state is
+        # aliased in place, never copied
         def _pass(st, window_end):
             st = st.replace(model=model.reset_records(st.model))
-            return run_round(st, window_end, model, tabs, cfgs)
+            st = run_round(st, window_end, model, tabs, cfgs)
+            return st, state_probe(st)
 
-        self._pass_jit = jax.jit(_pass)
+        self._pass_jit = jax.jit(_pass, donate_argnums=(0,))
 
         def _upload(st, valid, src, time, tie, data):
             q = equeue.push_many(
@@ -202,7 +213,7 @@ class HybridScheduler:
             )
             return st.replace(queue=q)
 
-        self._upload_jit = jax.jit(_upload)
+        self._upload_jit = jax.jit(_upload, donate_argnums=(0,))
 
     # --- device interaction ------------------------------------------------
 
@@ -214,20 +225,21 @@ class HybridScheduler:
         self.inflight += len(sends)
 
     def _run_pass(self, window_end: int) -> None:
-        self.st = self._pass_jit(self.st, jnp.asarray(window_end, jnp.int64))
+        self.st, self._probe = self._pass_jit(
+            self.st, jnp.asarray(window_end, jnp.int64)
+        )
         self.device_passes += 1
 
     def _drain_records(self) -> None:
-        recs = _fetch_records(self.st)
+        recs = _fetch_records(self.st, self._probe)
         if recs is None:
             return
-        t, srcs, seqs, flags, order = recs
-        for i in order:
+        t, srcs, seqs, flags = recs
+        for flag, rec_t, src, seq in zip(flags, t, srcs, seqs):
             self.k.hybrid_apply_record(
-                int(flags[i]), int(t[i]), int(srcs[i]), int(seqs[i]),
-                horizon_ns=self._horizon,
+                flag, rec_t, src, seq, horizon_ns=self._horizon
             )
-        self.inflight -= len(order)
+        self.inflight -= len(t)
 
     # --- the lockstep loop -------------------------------------------------
 
@@ -344,16 +356,20 @@ class ParallelHybridScheduler:
         self.phase_wall: dict = {}
         self.device_passes = 0
         self._horizon: "int | None" = None
+        self._probe = None  # fetched probe of the latest pass
         # (src, seq) -> (dst, payload-or-None) for records in flight
         self._send_meta: "dict[tuple[int, int], tuple]" = {}
 
         model, cfgs, tabs = self.model, self.cfg, self.tables
 
+        # st is scheduler-private: donate it through both entry points
+        # (same aliasing contract as HybridScheduler)
         def _pass(st, window_end):
             st = st.replace(model=model.reset_records(st.model))
-            return run_round(st, window_end, model, tabs, cfgs)
+            st = run_round(st, window_end, model, tabs, cfgs)
+            return st, state_probe(st)
 
-        self._pass_jit = jax.jit(_pass)
+        self._pass_jit = jax.jit(_pass, donate_argnums=(0,))
 
         def _upload(st, valid, src, time, tie, data):
             q = equeue.push_many(
@@ -368,7 +384,7 @@ class ParallelHybridScheduler:
             )
             return st.replace(queue=q)
 
-        self._upload_jit = jax.jit(_upload)
+        self._upload_jit = jax.jit(_upload, donate_argnums=(0,))
 
         # --- partition + workers -----------------------------------------
         k = max(1, min(num_workers, h))
@@ -465,38 +481,53 @@ class ParallelHybridScheduler:
 
     def _run_pass(self, window_end: int) -> None:
         t0 = _walltime.perf_counter()
-        self.st = self._pass_jit(self.st, jnp.asarray(window_end, jnp.int64))
-        jax.block_until_ready(self.st.now)
+        self.st, probe = self._pass_jit(
+            self.st, jnp.asarray(window_end, jnp.int64)
+        )
+        # sync on the [PROBE_LANES] probe, not the state: the phase clock
+        # still measures the whole pass (the probe is computed from its
+        # outputs) without pulling any [H]-shaped buffer to the host
+        self._probe = jax.device_get(probe)
         self.device_passes += 1
         self._phase("device_pass", t0)
 
     def _drain_records(self) -> None:
         """Fetch outcome records from the device, route each half to the
         worker(s) owning the src / dst host, preserving the serial global
-        application order within every worker."""
+        application order within every worker. Worker batches ship as
+        columnar lists (which/flag/t/src/seq/payload), one tuple of
+        columns per worker instead of one tuple per record."""
         t0 = _walltime.perf_counter()
-        recs = _fetch_records(self.st)
+        recs = _fetch_records(self.st, self._probe)
         if recs is None:
             self._phase("drain_records", t0)
             return
-        t, srcs, seqs, flags, order = recs
-        batches = [[] for _ in self._workers]
-        for i in order:
-            src, seq = int(srcs[i]), int(seqs[i])
+        t, srcs, seqs, flags = recs
+        batches = [tuple([] for _ in range(6)) for _ in self._workers]
+
+        def _append(w, which, flag, rec_t, src, seq, payload):
+            cols = batches[w]
+            cols[0].append(which)
+            cols[1].append(flag)
+            cols[2].append(rec_t)
+            cols[3].append(src)
+            cols[4].append(seq)
+            cols[5].append(payload)
+
+        for rec_t, src, seq, flag in zip(t, srcs, seqs, flags):
             dst, payload = self._send_meta.pop((src, seq))
             w_src = self.worker_of[src]
             w_dst = self.worker_of[dst]
-            rec_t, flag = int(t[i]), int(flags[i])
             if w_src == w_dst:
-                batches[w_src].append(("both", flag, rec_t, src, seq, None, self._horizon))
+                _append(w_src, "both", flag, rec_t, src, seq, None)
             else:
-                batches[w_src].append(("src", flag, rec_t, src, seq, None, self._horizon))
-                batches[w_dst].append(("dst", flag, rec_t, src, seq, payload, self._horizon))
-        for (_p, conn), batch in zip(self._workers, batches):
-            conn.send(("apply_records", batch))
+                _append(w_src, "src", flag, rec_t, src, seq, None)
+                _append(w_dst, "dst", flag, rec_t, src, seq, payload)
+        for (_p, conn), cols in zip(self._workers, batches):
+            conn.send(("apply_records", cols, self._horizon))
         for (_p, conn), _b in zip(self._workers, batches):
             self._expect(conn.recv(), "ok")
-        self.inflight -= len(order)
+        self.inflight -= len(t)
         self._phase("drain_records", t0)
 
     def _run_windows(self, end_ns: int, inclusive: bool) -> "list[tuple]":

@@ -16,8 +16,10 @@ command):
         -> ("sends", [(t, src, seq, ctr, dst, size, payload-or-None)]) —
         payload is shipped only for sends whose destination lives in
         another worker; progress_total feeds the kernel's progress line.
-  ("apply_records", [(which, flag, t, src, seq, payload, horizon)]),
-        which in {"both","src","dst"}      -> ("ok",)
+  ("apply_records", (which[], flag[], t[], src[], seq[], payload[]), horizon)
+        -> ("ok",) — columnar batch (one list per field, which in
+        {"both","src","dst"}): the round boundary ships six flat lists of
+        primitives per worker instead of one tuple per record
   ("next_time",)                      -> ("t", ns-or-None)
   ("finish", until_ns) / ("stats",) / ("proc_info",) / ("unexpected",)
   / ("shutdown",) / ("exit",)
@@ -113,7 +115,10 @@ def _serve(conn, init: dict) -> None:
                 out.append((t, src, seq, ctr, dst, size, pl))
             conn.send(("sends", out))
         elif cmd == "apply_records":
-            for (which, flag, t, src, seq, pl, horizon) in msg[1]:
+            _, (whichs, flags, ts, srcs, seqs, pls), horizon = msg
+            for which, flag, t, src, seq, pl in zip(
+                whichs, flags, ts, srcs, seqs, pls
+            ):
                 if which == "both":
                     k.hybrid_apply_record(flag, t, src, seq, horizon_ns=horizon)
                 elif which == "src":

@@ -247,23 +247,22 @@ class Manager:
 
         progress = ProgressLine(cfgo.general.progress)
 
-        def on_chunk(st):
-            if not progress.enabled and hb_ns <= 0:
-                return  # nothing to report: skip the device sync entirely
-            now = int(np.asarray(st.now))
-            progress.update(now, end)
+        def on_chunk(probe):
+            # probe is an engine ChunkProbe of already-fetched ints (the
+            # driver's per-chunk termination probe): progress and
+            # heartbeat lines cost zero extra device syncs
+            progress.update(probe.now, end)
             if hb_ns <= 0:
                 return
-            if now - last_hb[0] >= hb_ns:
-                last_hb[0] = now
-                ev = int(np.asarray(st.events_handled).sum())
-                pk = int(np.asarray(st.packets_sent).sum())
+            if probe.now - last_hb[0] >= hb_ns:
+                last_hb[0] = probe.now
                 progress.clear()
                 slog(
                     "info",
-                    now,
+                    probe.now,
                     "manager",
-                    f"heartbeat: {ev} events, {pk} packets, sim time {fmt_time_ns(now)}",
+                    f"heartbeat: {probe.events_handled} events, "
+                    f"{probe.packets_sent} packets, sim time {fmt_time_ns(probe.now)}",
                 )
 
         slog("info", 0, "manager", f"starting: {num_hosts} hosts, scheduler={sched.name}, "

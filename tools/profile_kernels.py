@@ -1,4 +1,5 @@
-"""Kernel-count vs width, and measured per-iteration engine costs.
+"""Kernel-count vs width, measured per-iteration engine costs, and
+chunk-driver dispatch accounting.
 
 Part 1 (width scan): compile the plain iteration body at several host
 widths on the live backend, print optimized-HLO fusion/kernel counts and
@@ -14,10 +15,21 @@ engine from the same mid-burst state and divides wall time by the
 drain-loop iterations actually executed (SimState.iters_done). The
 resulting table is the one published in docs/megakernel.md.
 
+Part 3 (dispatch pipeline, round-7 tentpole): on the same burst phase,
+measure the dispatch gap — wall time between a chunk completing on
+device and the next chunk's launch — for the synchronous driver shape
+(block on the probe, run the old _peek_next_time decision, then launch)
+vs the depth-2 pipelined driver (launch N+1 BEFORE fetching N's probe:
+the gap collapses to zero because the next chunk is already queued when
+completion is even observable). Also reports per-chunk HBM copy bytes
+from the compiled chunk's memory analysis with and without state
+donation: donated runs alias the whole SimState in place
+(aliased_bytes ~= state size, copied_bytes ~= the probe).
+
   python tools/profile_kernels.py [reps] [engine_hosts]
 
 Env knobs: SHADOW_TPU_PROFILE_WIDTHS (comma list, part 1),
-SHADOW_TPU_PROFILE_BURST_MS (start,end sim-ms for part 2, default 20,60).
+SHADOW_TPU_PROFILE_BURST_MS (start,end sim-ms for parts 2-3, default 20,60).
 """
 
 import json
@@ -150,6 +162,121 @@ def profile_engines(reps: int, hosts: int):
     return out
 
 
+def profile_dispatch(hosts: int, chunks: int = 6):
+    """Dispatch gap (sync vs pipelined driver) and per-chunk HBM copy
+    bytes (donated vs undonated chunk executable) on the burst phase."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench import _build
+    from shadow_tpu.engine.round import (
+        _peek_next_time,
+        _run_chunk,
+        _run_chunk_jit,
+        run_until,
+    )
+
+    burst_env = os.environ.get("SHADOW_TPU_PROFILE_BURST_MS", "20,60")
+    b0_ms = int(burst_env.split(",")[0])
+    b0 = b0_ms * 1_000_000
+
+    cfg, model, tables, st0 = _build(hosts)
+    st_burst = run_until(st0, b0, model, tables, cfg, rounds_per_chunk=32)
+    jax.block_until_ready(st_burst.events_handled)
+    end = jnp.asarray(10**15, jnp.int64)  # far horizon: chunks never quiesce
+    rpc = 8
+    out = {"hosts": hosts, "rounds_per_chunk": rpc, "chunks": chunks}
+
+    # --- per-chunk HBM copy bytes, before/after donation -----------------
+    def _nbytes(leaf):
+        try:
+            return leaf.nbytes
+        except Exception:  # typed PRNG key arrays: measure the raw words
+            return jax.random.key_data(leaf).nbytes
+
+    out["state_bytes"] = int(sum(_nbytes(l) for l in jax.tree.leaves(st_burst)))
+    try:
+        plain = jax.jit(_run_chunk, static_argnums=(2, 3, 5))
+        rows = {}
+        for name, fn in (("no_donate", plain), ("donate", _run_chunk_jit)):
+            ma = (
+                fn.lower(st_burst, end, rpc, model, tables, cfg)
+                .compile()
+                .memory_analysis()
+            )
+            rows[name] = {
+                "output_bytes": int(ma.output_size_in_bytes),
+                "aliased_bytes": int(ma.alias_size_in_bytes),
+                "copied_bytes": int(
+                    ma.output_size_in_bytes - ma.alias_size_in_bytes
+                ),
+            }
+        out["per_chunk_copy"] = rows
+    except Exception as e:  # noqa: BLE001 — memory analysis is best-effort
+        out["per_chunk_copy"] = {"error": str(e)[:200]}
+
+    # --- dispatch gap: wall between chunk completion and next launch -----
+    def launch(s):
+        return _run_chunk_jit(s, end, rpc, model, tables, cfg)
+
+    def drive(pipeline):
+        """Gap = wall from a chunk's observed completion to the next
+        chunk's launch INVOCATION — the window the device sits idle while
+        the host decides. (The launch call's own duration is reported
+        separately: XLA:CPU executes inline during dispatch, which would
+        otherwise masquerade as decision time.)"""
+        pend_st, pend_probe = launch(st_burst.donatable())
+        gaps, dispatch_walls = [], []
+        for _ in range(chunks - 1):
+            if pipeline:
+                t_launch = time.perf_counter()
+                nxt = launch(pend_st)  # dispatched before the probe fetch
+                dispatch_walls.append(time.perf_counter() - t_launch)
+                np.asarray(jax.device_get(pend_probe))  # chunk N observed done
+                t_done = time.perf_counter()
+                # the pipelined gap is 0 BY CONSTRUCTION (the launch
+                # precedes the completion observation in program order);
+                # the measured quantity is the launch-ahead margin — how
+                # long before chunk N's completion was even observable
+                # the next chunk was already dispatched
+                gaps.append(t_done - t_launch)
+                pend_st, pend_probe = nxt
+            else:
+                # the pre-pipeline driver shape: block until chunk N is
+                # done, run the separate peek dispatch + transfer that
+                # made the continue/stop decision, then launch N+1
+                jax.block_until_ready(pend_probe)
+                t_done = time.perf_counter()
+                int(_peek_next_time(pend_st))
+                t_launch = time.perf_counter()
+                gaps.append(t_launch - t_done)
+                pend_st, pend_probe = launch(pend_st)
+                dispatch_walls.append(time.perf_counter() - t_launch)
+        jax.block_until_ready(pend_st.now)
+        return gaps, dispatch_walls
+
+    drive(True)  # warm the chunk + peek executables
+    int(_peek_next_time(st_burst))
+    gaps, dwalls = drive(False)
+    out["dispatch_gap_sync_ms"] = {
+        "mean": round(sum(gaps) / len(gaps) * 1e3, 3),
+        "max": round(max(gaps) * 1e3, 3),
+        "launch_call_mean_ms": round(sum(dwalls) / len(dwalls) * 1e3, 3),
+    }
+    ahead, dwalls = drive(True)
+    out["dispatch_gap_pipelined_ms"] = {
+        # zero by construction: the next launch is dispatched before the
+        # previous chunk's completion is observable, so there is no
+        # decision window at all — launch_ahead is the measured margin
+        "by_construction": 0.0,
+        "launch_ahead_mean_ms": round(sum(ahead) / len(ahead) * 1e3, 3),
+        "launch_call_mean_ms": round(sum(dwalls) / len(dwalls) * 1e3, 3),
+    }
+    print(json.dumps({"dispatch": out}), flush=True)
+    return out
+
+
 def main():
     import jax
 
@@ -162,6 +289,7 @@ def main():
     out = {"backend": jax.default_backend()}
     out["widths"] = profile_widths(reps)
     out["engines"] = profile_engines(reps, eng_hosts)
+    out["dispatch"] = profile_dispatch(eng_hosts)
     print(json.dumps(out), flush=True)
 
 
