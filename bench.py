@@ -27,6 +27,13 @@ chunk. The orchestrator walks a retry ladder of smaller configurations
 on crash/hang, and if nothing completes it still reports a rate from
 the furthest partial progress instead of nothing.
 
+Observability (round-8 tentpole): every measure child attaches a
+utils/tracker.py Tracker to its run_until calls, so BENCH JSONs carry a
+per-phase wall-time breakdown (compile vs launch vs probe-fetch vs
+donation, percentiles in the result's "phases", cumulative totals on
+every progress line) for every trial — including failed/timed-out
+attempts, whose last progress line's phases land in the attempt log.
+
 Env knobs: SHADOW_TPU_BENCH_HOSTS (default 10240 — the BASELINE.md target
 scale; the round-3 fusion work cut the active phase to a few seconds, so
 the tunneled worker now survives it comfortably), SHADOW_TPU_BENCH_SIMSEC
@@ -160,6 +167,14 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
     import numpy as np
 
     from shadow_tpu.engine.round import run_until
+    from shadow_tpu.utils.tracker import Tracker
+
+    # one tracker per measure child: every run_until below (engine
+    # trials, compile warmups, the main run) records its dispatch spans
+    # here, and every progress line carries the cumulative per-phase
+    # totals — so even a timed-out/killed attempt leaves a per-phase
+    # wall-time breakdown in the BENCH JSON (where the budget went).
+    tracker = Tracker()
 
     print(json.dumps({"progress": 0, "wall": 0.001, "phase": "build"}),
           flush=True)
@@ -187,11 +202,11 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
         cfg = _engine_cfg(eng_env, k)
         engine_choice = eng_env
         run_until(st0, 10_000_000, model, tables, cfg,
-                  rounds_per_chunk=rounds_per_chunk)  # compile
+                  rounds_per_chunk=rounds_per_chunk, tracker=tracker)  # compile
     elif pump_env != "auto":
         cfg = dataclasses.replace(cfg, pump_k=int(pump_env))
         run_until(st0, 10_000_000, model, tables, cfg,
-                  rounds_per_chunk=rounds_per_chunk)
+                  rounds_per_chunk=rounds_per_chunk, tracker=tracker)
     else:
         trial_end = 60_000_000  # the burst phase carries nearly all events
         trials = {}
@@ -199,10 +214,12 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
             ck = _engine_cfg(name, k)
             try:
                 run_until(st0, 10_000_000, model, tables, ck,
-                          rounds_per_chunk=rounds_per_chunk)  # compile
+                          rounds_per_chunk=rounds_per_chunk,
+                          tracker=tracker)  # compile
                 t0 = time.perf_counter()
                 s = run_until(st0, trial_end, model, tables, ck,
-                              rounds_per_chunk=rounds_per_chunk)
+                              rounds_per_chunk=rounds_per_chunk,
+                              tracker=tracker)
                 jax.block_until_ready(s.events_handled)
                 trials[name] = (round(time.perf_counter() - t0, 3), ck)
                 print(json.dumps({"engine_trial": name,
@@ -218,16 +235,22 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
         engine_choice = min(trials, key=lambda n: trials[n][0])
         cfg = trials[engine_choice][1]
     t0 = time.perf_counter()
+    last_probe = [None]
 
     def on_chunk(probe):
         # probe is the driver's ChunkProbe (already-fetched ints): the
         # progress line costs no device sync and never stalls the
-        # depth-2 dispatch pipeline
+        # depth-2 dispatch pipeline. It carries the cumulative per-phase
+        # wall totals (tracker spans) so a later timeout still leaves
+        # the breakdown in the parent's attempt log.
+        last_probe[0] = probe
         print(
             json.dumps(
                 {
                     "progress": probe.now,
                     "wall": round(time.perf_counter() - t0, 3),
+                    "events": probe.events_handled,
+                    "phases": tracker.phase_totals(),
                 }
             ),
             flush=True,
@@ -242,9 +265,11 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
         rounds_per_chunk=rounds_per_chunk,
         max_chunks=1_000_000,
         on_chunk=on_chunk,
+        tracker=tracker,
     )
     jax.block_until_ready(st.events_handled)
     wall = time.perf_counter() - t0
+    probe = last_probe[0]
     return {
         "backend": jax.default_backend(),
         "rate": sim_sec / wall,
@@ -253,6 +278,21 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
         "streams_done": int(np.asarray(st.model.streams_done).sum()),
         "bytes_down": int(np.asarray(st.model.bytes_down).sum()),
         "pump_k": cfg.pump_k,
+        # per-phase dispatch percentiles (tracker plane) + the final
+        # probe's always-live aggregate lanes (drop reasons etc.)
+        "phases": tracker.phase_stats(),
+        **(
+            {
+                "tracker_totals": {
+                    "packets_sent": probe.packets_sent,
+                    "drop_loss": probe.drop_loss,
+                    "drop_codel": probe.drop_codel,
+                    "drop_unroutable": probe.drop_unroutable,
+                }
+            }
+            if probe is not None
+            else {}
+        ),
         **({"engine": engine_choice} if engine_choice is not None else {}),
     }
 
@@ -296,6 +336,7 @@ def _run_attempt(env: dict, timeout_s: float) -> dict:
         timed_out = True
 
     result, last_progress, engine_trials = None, None, {}
+    last_phases = None
     for ln in out_lines:
         try:
             obj = json.loads(ln)
@@ -303,6 +344,8 @@ def _run_attempt(env: dict, timeout_s: float) -> dict:
             continue
         if "progress" in obj:
             last_progress = obj
+            if obj.get("phases"):
+                last_phases = obj["phases"]
         elif "backend" in obj:
             result = obj
         elif "engine_trial" in obj and "wall" in obj:
@@ -322,6 +365,10 @@ def _run_attempt(env: dict, timeout_s: float) -> dict:
             "wall_s": last_progress["wall"],
             "rate": last_progress["progress"] / NS_PER_SEC / last_progress["wall"],
         }
+    if last_phases:
+        # where the budget went even when the attempt died (tracker
+        # spans: compile vs launch vs fetch wall, cumulative)
+        out["phases"] = last_phases
     if engine_trials:
         out["engine_trials"] = engine_trials
     return out

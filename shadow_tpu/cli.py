@@ -24,6 +24,19 @@ def main(argv: "list[str] | None" = None) -> int:
     run_p = sub.add_parser("run", help="run a simulation from a YAML config")
     run_p.add_argument("config", help="path to shadow.yaml-style config")
     run_p.add_argument("--show-config", action="store_true", help="print resolved config and exit")
+    run_p.add_argument(
+        "--tracker",
+        action="store_true",
+        help="enable the device-side tracker plane: per-host heartbeat "
+        "counters and a per-kind/per-class breakdown in sim-stats.json "
+        "(general.tracker; see docs/observability.md)",
+    )
+    run_p.add_argument(
+        "--trace-file",
+        metavar="PATH",
+        help="write a Chrome-trace JSON of the dispatch pipeline "
+        "(chrome://tracing / Perfetto loadable; general.trace_file)",
+    )
     sub.add_parser(
         "shm-cleanup",
         help="remove stale shared-memory blocks left by crashed runs "
@@ -35,7 +48,12 @@ def main(argv: "list[str] | None" = None) -> int:
         from shadow_tpu.runtime.cli_run import CliUserError, run_from_config
 
         try:
-            return run_from_config(args.config, show_config=args.show_config)
+            return run_from_config(
+                args.config,
+                show_config=args.show_config,
+                tracker=args.tracker,
+                trace_file=args.trace_file,
+            )
         except CliUserError as e:
             print(f"shadow-tpu: error: {e}", file=sys.stderr)
             return 1

@@ -46,6 +46,13 @@ class GeneralOptions:
     log_level: str = "info"
     data_directory: str = "shadow.data"
     progress: bool = False
+    # Tracker plane (docs/observability.md): `tracker` turns on the
+    # device-side counters (per-kind events, byte classes, high-water
+    # marks -> heartbeat lines + a richer sim-stats.json); `trace_file`
+    # writes a Chrome-trace JSON of the dispatch pipeline (and implies
+    # span recording even without `tracker`). CLI: --tracker/--trace-file.
+    tracker: bool = False
+    trace_file: Optional[str] = None
 
     @classmethod
     def from_dict(cls, d: dict) -> "GeneralOptions":
@@ -57,7 +64,15 @@ class GeneralOptions:
         if "heartbeat_interval" in d:
             hb = d.pop("heartbeat_interval")
             out.heartbeat_interval_ns = 0 if hb is None else parse_time_ns(hb)
-        for k in ("seed", "parallelism", "log_level", "data_directory", "progress"):
+        for k in (
+            "seed",
+            "parallelism",
+            "log_level",
+            "data_directory",
+            "progress",
+            "tracker",
+            "trace_file",
+        ):
             if k in d:
                 setattr(out, k, d.pop(k))
         _reject_unknown("general", d)

@@ -1,7 +1,15 @@
-from shadow_tpu.engine.state import EngineConfig, LocalEmits, PacketEmits, SimState, init_state
+from shadow_tpu.engine.state import (
+    EngineConfig,
+    LocalEmits,
+    PacketEmits,
+    SimState,
+    TrackerState,
+    init_state,
+)
 from shadow_tpu.engine.round import (
     ChunkProbe,
     bootstrap,
+    host_stats,
     round_body_debug,
     run_round,
     run_rounds_scan,
@@ -17,6 +25,8 @@ __all__ = [
     "LocalEmits",
     "PacketEmits",
     "SimState",
+    "TrackerState",
+    "host_stats",
     "ShardedRunner",
     "bootstrap",
     "init_state",

@@ -115,6 +115,10 @@ def _launch(
     # min_used (its per-tile partials are jnp.minimum-folded — any other
     # scalar would be min-merged wrongly), and the only legitimate
     # non-host-axis leaves are the known replicated context arrays.
+    # (The tracker plane's carry lanes — trk_bytes_ctrl/trk_bytes_data/
+    # trk_retrans, engine/pump.py — are ordinary [H] leaves and tile like
+    # every other counter; its round counters are SimState scalars that
+    # never enter the carry.)
     for path, leaf in jax.tree_util.tree_leaves_with_path(c):
         name = jax.tree_util.keystr(path)
         if leaf.ndim == 0 and "min_used" not in name:

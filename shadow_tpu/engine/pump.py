@@ -161,6 +161,15 @@ class PumpCarry:
     packets_sent: jax.Array
     packets_dropped: jax.Array
     packets_unroutable: jax.Array
+    # tracker plane ([H] i64 when cfg.tracker, else None — a None leaf
+    # is absent from the flattened pytree, so the megakernel tiles and
+    # streams NOTHING for them with the plane off. These are the only
+    # TrackerState leaves a pump microstep can touch: pump-taken events
+    # are all packets, so the per-kind local/tcp counters never move
+    # here.)
+    trk_bytes_ctrl: "jax.Array | None"
+    trk_bytes_data: "jax.Array | None"
+    trk_retrans: "jax.Array | None"
     min_used: jax.Array  # scalar
     # scan control
     alive: jax.Array
@@ -223,6 +232,9 @@ def pump_carry_init(
         packets_sent=st.packets_sent,
         packets_dropped=st.packets_dropped,
         packets_unroutable=st.packets_unroutable,
+        trk_bytes_ctrl=st.tracker.bytes_ctrl if cfg.tracker else None,
+        trk_bytes_data=st.tracker.bytes_data if cfg.tracker else None,
+        trk_retrans=st.tracker.retrans_segs if cfg.tracker else None,
         min_used=st.min_used_lat,
         alive=jnp.ones((h,), bool),
         rejected=jnp.zeros((h,), bool),
@@ -805,6 +817,25 @@ def pump_microstep(
     packets_sent = packets_sent + jnp.sum(kept_l, axis=1)
     packets_dropped = packets_dropped + jnp.sum(dropped_l, axis=1)
     packets_unroutable = packets_unroutable + jnp.sum(unroutable_l, axis=1)
+    trk_bytes_ctrl = c.trk_bytes_ctrl
+    trk_bytes_data = c.trk_bytes_data
+    trk_retrans = c.trk_retrans
+    if cfg.tracker:
+        # identical classification to the full handler's tracker pass
+        # (engine/round.py): control = wire size <= the model's header
+        # size (the P2 ACK / P3 FIN lanes), data = the rest; retrans is
+        # the same per-event segment count the step adds to
+        # ts.retransmits — so pump/megakernel tracker leaves stay
+        # leaf-exact vs the plain engine.
+        hdr = int(getattr(model, "WIRE_HEADER_BYTES", 0))
+        is_ctrl = kept_l & (lsz_all <= hdr)
+        trk_bytes_ctrl = trk_bytes_ctrl + jnp.sum(
+            jnp.where(is_ctrl, lsz_all, 0), axis=1
+        )
+        trk_bytes_data = trk_bytes_data + jnp.sum(
+            jnp.where(kept_l & ~is_ctrl, lsz_all, 0), axis=1
+        )
+        trk_retrans = trk_retrans + jnp.where(p3, rtx_count, 0)
     if cfg.use_dynamic_runahead:
         cross = kept_l & (dst != host_ids)[:, None] & (lat < TIME_MAX)[:, None]
         min_used = jnp.minimum(
@@ -830,6 +861,9 @@ def pump_microstep(
         packets_sent=packets_sent,
         packets_dropped=packets_dropped,
         packets_unroutable=packets_unroutable,
+        trk_bytes_ctrl=trk_bytes_ctrl,
+        trk_bytes_data=trk_bytes_data,
+        trk_retrans=trk_retrans,
         min_used=min_used,
         alive=alive,
         rejected=rejected,
@@ -878,6 +912,14 @@ def pump_carry_finish(
         packets_unroutable=c.packets_unroutable,
         min_used_lat=c.min_used,
     )
+    if cfg.tracker:
+        st = st.replace(
+            tracker=st.tracker.replace(
+                bytes_ctrl=c.trk_bytes_ctrl,
+                bytes_data=c.trk_bytes_data,
+                retrans_segs=c.trk_retrans,
+            )
+        )
     return st, jnp.any(c.rejected)
 
 

@@ -23,6 +23,7 @@ optimization).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import Optional
 
@@ -168,6 +169,7 @@ def handle_one_iteration(
         )
 
     draw = Draw(st.rng_key, st.rng_counter)
+    model_before = st.model  # pre-handler snapshot (tracker retrans delta)
     mstate, lemits, pemits = model.handle(st.model, ev, draw, cfg, host_ids)
 
     lvalid = lemits.valid & ev.valid[:, None]  # [H, EL]
@@ -297,6 +299,47 @@ def handle_one_iteration(
         used = jnp.where(kept & cross & (lat < TIME_MAX), lat, TIME_MAX)
         min_used = jnp.minimum(min_used, jnp.min(used))
 
+    # --- tracker plane (cfg.tracker static: OFF emits no ops) ---------
+    # Per-kind event counts classify the POPPED event's kind (identical
+    # in every engine); byte classes split kept emissions by wire size
+    # vs the model's header size; retrans counts the per-event delta of
+    # the flow table's retransmits counter — the pump adds the exact
+    # same per-event count, so plain/pump/megakernel tracker leaves are
+    # leaf-exact identical (tests/test_tracker.py).
+    tracker = st.tracker
+    if cfg.tracker:
+        # kind integers are only unique within a model (events.py), so
+        # the protocol-kind range is model-owned: TCP models export
+        # TCP_KIND_RANGE = (KIND_TCP_TIMER, TCP_KIND_USER_BASE)
+        tcp_range = getattr(model, "TCP_KIND_RANGE", None)
+        if tcp_range is not None:
+            lo, hi = (int(x) for x in tcp_range)
+            is_tcp_ev = ev.valid & (ev.kind >= lo) & (ev.kind < hi)
+        else:
+            is_tcp_ev = jnp.zeros_like(ev.valid)
+        is_local_ev = ev.valid & (ev.kind != KIND_PACKET) & ~is_tcp_ev
+        hdr = int(getattr(model, "WIRE_HEADER_BYTES", 0))
+        sizes64 = pemits.size.astype(jnp.int64)
+        is_ctrl = kept & (pemits.size <= hdr)
+        spec = getattr(model, "pump_spec", None)
+        if spec is not None:
+            rtx_delta = jnp.sum(
+                spec.get_tcp(mstate).retransmits
+                - spec.get_tcp(model_before).retransmits,
+                axis=1,
+            )
+        else:
+            rtx_delta = jnp.zeros_like(tracker.retrans_segs)
+        tracker = tracker.replace(
+            ev_local=tracker.ev_local + is_local_ev,
+            ev_tcp=tracker.ev_tcp + is_tcp_ev,
+            bytes_ctrl=tracker.bytes_ctrl
+            + jnp.sum(jnp.where(is_ctrl, sizes64, 0), axis=1),
+            bytes_data=tracker.bytes_data
+            + jnp.sum(jnp.where(kept & ~is_ctrl, sizes64, 0), axis=1),
+            retrans_segs=tracker.retrans_segs + rtx_delta,
+        )
+
     # carried-counter models consume no live draws for packet loss
     stride = jnp.uint32(model.DRAWS_PER_EVENT + (0 if loss_lane is not None else ep))
     return st.replace(
@@ -311,6 +354,7 @@ def handle_one_iteration(
         packets_sent=st.packets_sent + jnp.sum(kept, axis=1),
         packets_dropped=st.packets_dropped + jnp.sum(dropped, axis=1),
         packets_unroutable=st.packets_unroutable + jnp.sum(unroutable, axis=1),
+        tracker=tracker,
     )
 
 
@@ -382,13 +426,6 @@ def _has_traffic(st: SimState, axis_name: Optional[str]) -> jax.Array:
     if axis_name is not None:
         t = jax.lax.psum(t.astype(jnp.int32), axis_name) > 0
     return t
-
-
-def _overflow_total(st: SimState) -> jax.Array:
-    """Capacity accounting shared by check_capacity's peek and the
-    dispatch probe's overflow lane — one source of truth for what counts
-    as a dropped slot."""
-    return jnp.sum(st.queue.overflow) + jnp.sum(st.outbox.overflow)
 
 
 def flush_outbox(
@@ -614,7 +651,24 @@ def run_round(
         return s, iters + 1
 
     st, iters = jax.lax.while_loop(cond, body, (st, jnp.asarray(0, jnp.int32)))
+    if cfg.tracker:
+        # Sample occupancy high-water marks at the two per-round peaks:
+        # the outbox right before the flush empties it, and the queue
+        # right after the flush delivers the exchanged packets. Sampled
+        # per round (not per iteration), identically in every engine.
+        st = st.replace(
+            tracker=st.tracker.replace(
+                outbox_hwm=jnp.maximum(st.tracker.outbox_hwm, st.outbox.fill),
+                queue_hwm=jnp.maximum(st.tracker.queue_hwm, st.queue.count),
+            )
+        )
     st = flush_outbox(st, axis_name, cfg)
+    if cfg.tracker:
+        st = st.replace(
+            tracker=st.tracker.replace(
+                queue_hwm=jnp.maximum(st.tracker.queue_hwm, st.queue.count)
+            )
+        )
     return st.replace(
         now=jnp.maximum(st.now, window_end),
         iters_done=st.iters_done.at[0].add(iters),
@@ -672,10 +726,28 @@ def run_rounds_scan(
         window_end = _next_window_end(s, end_time, cfg, axis_name, start=start)
 
         def live(s):
-            return run_round(s, window_end, model, tables, cfg, axis_name)
+            s = run_round(s, window_end, model, tables, cfg, axis_name)
+            if cfg.tracker:
+                # replicated scalars: every shard runs the same round
+                # sequence, so no mesh reduction is needed (and the
+                # pipelined driver restores both from the probe on the
+                # quiescent-extra-chunk path, like `now`)
+                s = s.replace(
+                    tracker=s.tracker.replace(
+                        rounds_live=s.tracker.rounds_live + 1
+                    )
+                )
+            return s
 
         def idle(s):
-            return s.replace(now=jnp.maximum(s.now, window_end))
+            s = s.replace(now=jnp.maximum(s.now, window_end))
+            if cfg.tracker:
+                s = s.replace(
+                    tracker=s.tracker.replace(
+                        rounds_idle=s.tracker.rounds_idle + 1
+                    )
+                )
+            return s
 
         return jax.lax.cond((start < end_time) | has_traffic, live, idle, s), None
 
@@ -702,56 +774,132 @@ def _peek_next_time(st: SimState) -> jax.Array:
 
 
 @jax.jit
-def _peek_overflow(st: SimState) -> jax.Array:
-    return _overflow_total(st)
+def _peek_capacity(st: SimState) -> jax.Array:
+    """[4] i64: queue overflow, outbox overflow, queue hwm, outbox hwm —
+    the split check_capacity reports so a blowup names the saturated
+    counter without a rerun. With state_probe's overflow lanes, the
+    only two places that define what counts as a dropped slot."""
+    return jnp.stack(
+        [
+            jnp.sum(st.queue.overflow).astype(jnp.int64),
+            jnp.sum(st.outbox.overflow).astype(jnp.int64),
+            jnp.max(st.tracker.queue_hwm).astype(jnp.int64),
+            jnp.max(st.tracker.outbox_hwm).astype(jnp.int64),
+        ]
+    )
 
 
 # --- dispatch probe ----------------------------------------------------
 # Everything the host needs to decide whether to keep dispatching chunks,
 # packed into ONE small device array so the driver fetches a handful of
-# scalars per chunk instead of syncing any [H]-shaped state. Lanes:
+# scalars per chunk instead of syncing any [H]-shaped state. Core lanes:
 #   next_time  — min pending event time across all hosts (quiescence test)
 #   overflow   — queue+outbox slots dropped (capacity check, every chunk)
 #   now        — current window start (progress/heartbeats)
-#   events_handled / packets_sent — totals (heartbeat lines)
+#   events_handled / packets_sent — totals (heartbeat/rate lines)
+# The remaining lanes are the tracker plane's sync-free aggregates
+# (docs/observability.md): the queue/outbox overflow split (capacity
+# diagnostics — always live), drop reasons (always live), and the
+# TrackerState sums/maxima (zero unless cfg.tracker). Heartbeats read
+# these instead of ever fetching [H]-shaped state mid-run.
 
 PROBE_NEXT_TIME = 0
 PROBE_OVERFLOW = 1
 PROBE_NOW = 2
 PROBE_EVENTS = 3
 PROBE_PACKETS = 4
-PROBE_LANES = 5
+PROBE_QUEUE_OV = 5
+PROBE_OUTBOX_OV = 6
+PROBE_EV_LOCAL = 7
+PROBE_EV_TCP = 8
+PROBE_DROP_LOSS = 9
+PROBE_DROP_CODEL = 10
+PROBE_DROP_UNROUTABLE = 11
+PROBE_BYTES_CTRL = 12
+PROBE_BYTES_DATA = 13
+PROBE_RETRANS = 14
+PROBE_QUEUE_HWM = 15
+PROBE_OUTBOX_HWM = 16
+PROBE_ROUNDS_LIVE = 17
+PROBE_ROUNDS_IDLE = 18
+PROBE_LANES = 19
 
 
 def state_probe(st: SimState, axis_name: Optional[str] = None) -> jax.Array:
     """[PROBE_LANES] i64 summary of a chunk's outcome, computed on device
     as part of the chunk itself (no separate peek dispatch). Sharded, the
-    lanes are reduced over the mesh axis so the probe is replicated."""
+    lanes are reduced over the mesh axis (psum for sums, pmin/pmax for
+    extrema) so the probe comes out replicated."""
+    tr = st.tracker
     nt = jnp.min(equeue.next_time(st.queue))
-    ov = _overflow_total(st).astype(jnp.int64)
-    ev = jnp.sum(st.events_handled)
-    pk = jnp.sum(st.packets_sent)
-    now = st.now
+    qov = jnp.sum(st.queue.overflow).astype(jnp.int64)
+    oov = jnp.sum(st.outbox.overflow).astype(jnp.int64)
+    sums = [
+        qov + oov,  # PROBE_OVERFLOW: always the sum of the split lanes
+        jnp.sum(st.events_handled),
+        jnp.sum(st.packets_sent),
+        qov,
+        oov,
+        jnp.sum(tr.ev_local),
+        jnp.sum(tr.ev_tcp),
+        jnp.sum(st.packets_dropped),
+        jnp.sum(st.net.codel_dropped),
+        jnp.sum(st.packets_unroutable),
+        jnp.sum(tr.bytes_ctrl),
+        jnp.sum(tr.bytes_data),
+        jnp.sum(tr.retrans_segs),
+    ]
+    maxes = [
+        st.now,
+        jnp.max(tr.queue_hwm).astype(jnp.int64),
+        jnp.max(tr.outbox_hwm).astype(jnp.int64),
+    ]
+    rounds = [tr.rounds_live, tr.rounds_idle]  # replicated scalars
     if axis_name is not None:
         nt = jax.lax.pmin(nt, axis_name)
-        ov = jax.lax.psum(ov, axis_name)
-        ev = jax.lax.psum(ev, axis_name)
-        pk = jax.lax.psum(pk, axis_name)
-        now = jax.lax.pmax(now, axis_name)
-    return jnp.stack([nt, ov, now, ev, pk]).astype(jnp.int64)
+        sums = [jax.lax.psum(x, axis_name) for x in sums]
+        maxes = [jax.lax.pmax(x, axis_name) for x in maxes]
+        rounds = [jax.lax.pmax(x, axis_name) for x in rounds]
+    now, qh, oh = maxes
+    (ov, ev, pk, qov, oov, evl, evt, dl, dc, du, bc, bd, rx) = sums
+    rl, ri = rounds
+    return jnp.stack(
+        [nt, ov, now, ev, pk, qov, oov, evl, evt, dl, dc, du, bc, bd, rx,
+         qh, oh, rl, ri]
+    ).astype(jnp.int64)
 
 
 @dataclasses.dataclass(frozen=True)
 class ChunkProbe:
     """Host-side view of one fetched probe (plain ints). This is what
     `on_chunk` callbacks receive: progress/heartbeat lines read these
-    fields instead of forcing a device sync on the full state."""
+    fields instead of forcing a device sync on the full state. Field
+    order matches the PROBE_* lane map."""
 
     next_time: int
     overflow: int
     now: int
     events_handled: int
     packets_sent: int
+    queue_overflow: int
+    outbox_overflow: int
+    ev_local: int
+    ev_tcp: int
+    drop_loss: int
+    drop_codel: int
+    drop_unroutable: int
+    bytes_ctrl: int
+    bytes_data: int
+    retrans_segs: int
+    queue_hwm: int
+    outbox_hwm: int
+    rounds_live: int
+    rounds_idle: int
+
+    @property
+    def ev_packet(self) -> int:
+        """Packet events handled (total minus the local/tcp classes)."""
+        return self.events_handled - self.ev_local - self.ev_tcp
 
     @classmethod
     def from_array(cls, arr) -> "ChunkProbe":
@@ -767,9 +915,40 @@ def check_capacity(st: SimState) -> None:
     simulation has silently dropped events and no longer matches the
     determinism contract (the tensor-shaped analogue of the reference's
     unbounded queues never dropping)."""
-    dropped = int(_peek_overflow(st))
-    if dropped:
-        raise _capacity_error(dropped)
+    qov, oov, qh, oh = (int(x) for x in _peek_capacity(st))
+    if qov or oov:
+        raise _capacity_error(
+            qov + oov, queue_ov=qov, outbox_ov=oov, queue_hwm=qh, outbox_hwm=oh
+        )
+
+
+def host_stats(st: SimState) -> dict:
+    """ONE bulk device_get of every per-host tracker/stat tensor — the
+    only way per-host data ever leaves the device (heartbeat cadence or
+    end-of-run; the per-chunk path reads only the probe). Returns plain
+    numpy arrays keyed by counter name, plus the replicated round
+    scalars."""
+    return jax.device_get(
+        {
+            "host_id": st.host_id,
+            "events_handled": st.events_handled,
+            "packets_sent": st.packets_sent,
+            "packets_dropped": st.packets_dropped,
+            "packets_unroutable": st.packets_unroutable,
+            "codel_dropped": st.net.codel_dropped,
+            "bytes_sent": st.net.bytes_sent,
+            "bytes_recv": st.net.bytes_recv,
+            "ev_local": st.tracker.ev_local,
+            "ev_tcp": st.tracker.ev_tcp,
+            "bytes_ctrl": st.tracker.bytes_ctrl,
+            "bytes_data": st.tracker.bytes_data,
+            "retrans_segs": st.tracker.retrans_segs,
+            "queue_hwm": st.tracker.queue_hwm,
+            "outbox_hwm": st.tracker.outbox_hwm,
+            "rounds_live": st.tracker.rounds_live,
+            "rounds_idle": st.tracker.rounds_idle,
+        }
+    )
 
 
 def _run_chunk(st, end, num_rounds, model, tables, cfg):
@@ -785,17 +964,51 @@ def _run_chunk(st, end, num_rounds, model, tables, cfg):
 _run_chunk_jit = jax.jit(_run_chunk, static_argnums=(2, 3, 5), donate_argnums=(0,))
 
 
-def _capacity_error(dropped: int) -> CapacityError:
+def _capacity_error(
+    dropped: int,
+    queue_ov: "int | None" = None,
+    outbox_ov: "int | None" = None,
+    queue_hwm: "int | None" = None,
+    outbox_hwm: "int | None" = None,
+) -> CapacityError:
+    """The split (when known — it rides the probe's dedicated lanes, so
+    every driver has it) names WHICH fixed-slot counter saturated; the
+    high-water marks (tracker plane, nonzero only with cfg.tracker) say
+    how close to the rim the other one ran."""
+    if queue_ov is None:
+        which = "queue.overflow/outbox.overflow"
+    else:
+        sat = [
+            name
+            for name, n in (("queue", queue_ov), ("outbox/exchange", outbox_ov))
+            if n
+        ]
+        which = (
+            f"saturated: {' + '.join(sat) or 'unknown'} "
+            f"[queue.overflow={queue_ov}, outbox.overflow={outbox_ov}"
+        )
+        if queue_hwm or outbox_hwm:
+            which += f"; high-water queue={queue_hwm}, outbox={outbox_hwm}"
+        which += "]"
     return CapacityError(
         f"event capacity exhausted: {dropped} events/packets dropped "
-        f"(queue.overflow/outbox.overflow); increase queue_capacity/"
+        f"({which}); increase queue_capacity/"
         f"outbox_capacity — or, for sharded all_to_all runs with "
         f"pair-skewed destinations, set a2a_capacity=-1 (whole-outbox "
         f"buckets, never overflow)"
     )
 
 
-def _drive(launch, st, end_time, max_chunks, on_chunk, pipeline, desc):
+def _tspan(tracker, name, **args):
+    """A tracker span, or a no-op when no tracker is attached (the hot
+    path pays one `if`)."""
+    if tracker is None:
+        return contextlib.nullcontext()
+    return tracker.span(name, **args)
+
+
+def _drive(launch, st, end_time, max_chunks, on_chunk, pipeline, desc,
+           tracker=None):
     """The shared chunk-dispatch loop behind run_until and
     ShardedRunner.run_until.
 
@@ -812,19 +1025,45 @@ def _drive(launch, st, end_time, max_chunks, on_chunk, pipeline, desc):
     On quiescence with a chunk already in flight, that extra chunk ran
     entirely on a quiescent state — every round took run_rounds_scan's
     idle branch — so its output is leaf-identical and is returned as-is.
+
+    With a `tracker` attached (utils/tracker.py), every launch call and
+    probe fetch is recorded as a trace span (the first launch includes
+    jit compilation, labelled "compile+launch"), and whenever the tracker
+    says a per-host heartbeat is due — decided from the already-fetched
+    probe, never an extra sync — the full per-host counter tensors are
+    pulled in ONE bulk device_get from the live (never-donated) pending
+    state and rendered as reference-style tracker lines.
     """
-    pend_st, pend_probe = launch(st)
+    with _tspan(tracker, "compile+launch", chunk=0):
+        pend_st, pend_probe = launch(st)
     launched = 1
+    fetched = 0  # index of the chunk whose probe is fetched next
     while True:
         nxt = None
         if pipeline and launched < max_chunks:
-            nxt = launch(pend_st)  # donates pend_st; device stays busy
+            with _tspan(tracker, "chunk_launch", chunk=launched):
+                nxt = launch(pend_st)  # donates pend_st; device stays busy
             launched += 1
-        probe = ChunkProbe.from_array(jax.device_get(pend_probe))
+        with _tspan(tracker, "probe_fetch", chunk=fetched):
+            probe = ChunkProbe.from_array(jax.device_get(pend_probe))
+        fetched += 1
         if probe.overflow:
-            raise _capacity_error(probe.overflow)
+            raise _capacity_error(
+                probe.overflow,
+                queue_ov=probe.queue_overflow,
+                outbox_ov=probe.outbox_overflow,
+                queue_hwm=probe.queue_hwm,
+                outbox_hwm=probe.outbox_hwm,
+            )
         if on_chunk is not None:
             on_chunk(probe)
+        if tracker is not None and tracker.host_heartbeat_due(probe.now):
+            # pend_st was donated into `nxt` under pipelining; the bulk
+            # fetch must read a live state, so use the in-flight chunk's
+            # output (one window later — immaterial at heartbeat cadence)
+            src = nxt[0] if nxt is not None else pend_st
+            with _tspan(tracker, "host_stats_fetch"):
+                tracker.emit_host_heartbeat(probe, host_stats(src))
         if probe.next_time >= end_time:
             if nxt is None:
                 return pend_st
@@ -832,15 +1071,27 @@ def _drive(launch, st, end_time, max_chunks, on_chunk, pipeline, desc):
             # round took the idle branch: leaf-identical output, except
             # that when quiescence landed exactly on the chunk boundary
             # the idle rounds clamp `now` to end_time where the
-            # synchronous driver stopped at the last productive window.
-            # Restore chunk N's `now` (it rides the probe) so pipelined
-            # and synchronous results are leaf-exact in every case.
-            return nxt[0].replace(
-                now=jnp.asarray(probe.now, nxt[0].now.dtype)
+            # synchronous driver stopped at the last productive window —
+            # and, under cfg.tracker, count themselves as idle rounds.
+            # Restore chunk N's `now` and round counters (they ride the
+            # probe) so pipelined and synchronous results are leaf-exact
+            # in every case.
+            out = nxt[0]
+            return out.replace(
+                now=jnp.asarray(probe.now, out.now.dtype),
+                tracker=out.tracker.replace(
+                    rounds_live=jnp.asarray(
+                        probe.rounds_live, out.tracker.rounds_live.dtype
+                    ),
+                    rounds_idle=jnp.asarray(
+                        probe.rounds_idle, out.tracker.rounds_idle.dtype
+                    ),
+                ),
             )
         if nxt is None:
             if launched < max_chunks:  # synchronous mode: launch after probe
-                nxt = launch(pend_st)
+                with _tspan(tracker, "chunk_launch", chunk=launched):
+                    nxt = launch(pend_st)
                 launched += 1
             else:
                 raise RuntimeError(
@@ -860,6 +1111,7 @@ def run_until(
     max_chunks: int = 10_000,
     on_chunk=None,
     pipeline: bool = True,
+    tracker=None,
 ) -> SimState:
     """Host-side driver: chunked device scans until no work remains before
     end_time. Single-device variant; the sharded driver lives in
@@ -874,6 +1126,8 @@ def run_until(
 
     `on_chunk(probe: ChunkProbe)` is invoked once per completed chunk
     (heartbeats/progress); it receives the fetched probe, not the state.
+    `tracker` (utils/tracker.py) records dispatch-pipeline spans and
+    per-host heartbeats (see _drive).
     """
     validate_runahead(cfg, tables)
     if int(_peek_next_time(st)) >= end_time:
@@ -882,7 +1136,8 @@ def run_until(
         check_capacity(st)
         return st
     end = jnp.asarray(end_time, jnp.int64)
-    st = st.donatable()  # the caller's buffers are never donated
+    with _tspan(tracker, "donate_copy"):
+        st = st.donatable()  # the caller's buffers are never donated
 
     def launch(s):
         return _run_chunk_jit(s, end, rounds_per_chunk, model, tables, cfg)
@@ -890,6 +1145,7 @@ def run_until(
     return _drive(
         launch, st, end_time, max_chunks, on_chunk, pipeline,
         desc=f"{max_chunks}x{rounds_per_chunk} rounds",
+        tracker=tracker,
     )
 
 

@@ -43,6 +43,7 @@ _SHARD_MAP_CHECK_KW = (
 from shadow_tpu.engine.round import (
     _drive,
     _peek_next_time,
+    _tspan,
     check_capacity,
     run_rounds_scan,
     state_probe,
@@ -157,10 +158,14 @@ class ShardedRunner:
         max_chunks: int = 10_000,
         on_chunk=None,
         pipeline: bool = True,
+        tracker=None,
     ) -> SimState:
         """Sharded chunk driver: the same depth-2 async dispatch pipeline
         as engine/round.py run_until (donated state, probe-only syncs,
-        per-chunk capacity checks); `on_chunk` receives a ChunkProbe."""
+        per-chunk capacity checks); `on_chunk` receives a ChunkProbe and
+        `tracker` records the same dispatch spans / per-host heartbeats
+        as the single-device driver (the probe lanes arrive psum/pmax
+        reduced over the mesh, so heartbeats stay sync-free sharded)."""
         st = shard_state(st, self.mesh)
         if int(_peek_next_time(st)) >= end_time:
             # already quiescent: zero-work fast path, state untouched
@@ -168,7 +173,8 @@ class ShardedRunner:
             return st
         # shard_state is a no-op alias when the input is already laid out;
         # donatable() guarantees the caller's buffers are never donated
-        st = st.donatable()
+        with _tspan(tracker, "donate_copy"):
+            st = st.donatable()
         if self._compiled is None:
             self._compiled = self._chunk_fn(st)
         end = jnp.asarray(end_time, jnp.int64)
@@ -179,4 +185,5 @@ class ShardedRunner:
         return _drive(
             launch, st, end_time, max_chunks, on_chunk, pipeline,
             desc=f"{max_chunks}x{self.rounds_per_chunk} rounds (sharded)",
+            tracker=tracker,
         )

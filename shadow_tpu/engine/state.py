@@ -108,6 +108,13 @@ class EngineConfig:
     # largest power-of-two divisor of the local host count whose carry
     # tile fits the VMEM budget. Must divide num_hosts when set.
     megakernel_tile: int = 0
+    # Device-side tracker plane (docs/observability.md; reference
+    # tracker.c:407-430 + sim_stats.rs): accumulate per-host per-kind
+    # event counters, byte classes, and high-water marks into
+    # SimState.tracker. Static, so OFF traces zero extra ops; ON leaves
+    # the simulated trajectory leaf-exact unchanged (tracker leaves are
+    # write-only — nothing reads them back into the simulation).
+    tracker: bool = False
     # draws consumed per handled event = model.DRAWS_PER_EVENT + PACKET_EMITS
     # (one loss draw per packet lane), fixed-stride for determinism.
 
@@ -163,6 +170,54 @@ def _empty_outbox(h: int, o: int) -> Outbox:
 
 
 @flax.struct.dataclass
+class TrackerState:
+    """Device-side observability counters (the tracker plane; reference:
+    src/main/host/tracker.c:407-430 heartbeat counters + sim_stats.rs
+    worker-local counters). Accumulated inside the round engines when
+    EngineConfig.tracker is set, zero otherwise; never read back by the
+    simulation, so the trajectory is identical either way. Leaves lead
+    with the host axis except the round counters, which are replicated
+    scalars (each shard executes the same round sequence in lockstep).
+
+    Event-kind split: kind == KIND_PACKET is a packet event, kinds in
+    the model's declared TCP_KIND_RANGE (TCP timer/flush, model-owned
+    because kind integers are only unique within a model — events.py)
+    are tcp, everything else is a local task; packet events are
+    derivable as events_handled - ev_local - ev_tcp; drop reasons live on
+    SimState/NetDevState already (packets_dropped / packets_unroutable /
+    net.codel_dropped). Byte classes mirror tracker.c's control/data
+    split: a kept packet whose wire size is <= the model's
+    WIRE_HEADER_BYTES is control (pure ACK/SYN/FIN), else data;
+    retrans_segs counts retransmitted TCP segments (the per-event delta
+    of the flow table's retransmits counter — identical across engines
+    because the pump adds the exact same per-event count)."""
+
+    ev_local: jax.Array  # [H] i64 local task/timer events handled
+    ev_tcp: jax.Array  # [H] i64 TCP timer/flush events handled
+    bytes_ctrl: jax.Array  # [H] i64 control bytes sent (kept packets)
+    bytes_data: jax.Array  # [H] i64 data bytes sent (kept packets)
+    retrans_segs: jax.Array  # [H] i64 retransmitted segments
+    queue_hwm: jax.Array  # [H] i32 event-queue occupancy high-water mark
+    outbox_hwm: jax.Array  # [H] i32 outbox fill high-water mark
+    rounds_live: jax.Array  # scalar i64 rounds that ran a drain loop
+    rounds_idle: jax.Array  # scalar i64 rounds skipped by the idle branch
+
+
+def _empty_tracker(h: int) -> TrackerState:
+    return TrackerState(
+        ev_local=jnp.zeros((h,), jnp.int64),
+        ev_tcp=jnp.zeros((h,), jnp.int64),
+        bytes_ctrl=jnp.zeros((h,), jnp.int64),
+        bytes_data=jnp.zeros((h,), jnp.int64),
+        retrans_segs=jnp.zeros((h,), jnp.int64),
+        queue_hwm=jnp.zeros((h,), jnp.int32),
+        outbox_hwm=jnp.zeros((h,), jnp.int32),
+        rounds_live=jnp.asarray(0, jnp.int64),
+        rounds_idle=jnp.asarray(0, jnp.int64),
+    )
+
+
+@flax.struct.dataclass
 class SimState:
     now: jax.Array  # scalar i64: start of the current window
     min_used_lat: jax.Array  # scalar i64: min path latency used so far
@@ -182,6 +237,8 @@ class SimState:
     # diagnostic: pop-iterations executed, accumulated on each shard's row 0
     # (sum over the axis = total device iterations; feeds the perf probes)
     iters_done: jax.Array  # [H] i32
+    # the tracker plane (zeros unless EngineConfig.tracker is set)
+    tracker: TrackerState
 
     @property
     def num_hosts(self) -> int:
@@ -270,4 +327,5 @@ def init_state(
         packets_dropped=jnp.zeros((h,), jnp.int64),
         packets_unroutable=jnp.zeros((h,), jnp.int64),
         iters_done=jnp.zeros((h,), jnp.int32),
+        tracker=_empty_tracker(h),
     )

@@ -25,6 +25,17 @@ KIND_INVALID = -1
 KIND_PACKET = 0  # a packet arriving at a host's upstream router
 KIND_MODEL_BASE = 1  # local (task/timer) kinds start here
 
+# Tracker-plane kind classes (reference: tracker.c splits heartbeat
+# counters by event class): kind == KIND_PACKET is a packet event; a
+# model that embeds a protocol machine declares its protocol-internal
+# kind range as a static `TCP_KIND_RANGE = (lo, hi)` attribute (the TCP
+# models export [KIND_TCP_TIMER, TCP_KIND_USER_BASE), transport/tcp.py
+# — kind values are only unique WITHIN a model, e.g. phold's KIND_SEND
+# shares the integer with KIND_TCP_TIMER, so the range must be
+# model-owned); every other handled kind is a model-local task. The
+# classification depends only on (model, kind), so per-kind counters
+# are identical across plain/pump/megakernel by construction.
+
 _SEQ_BITS = 32
 _SRC_BITS = 30
 SEQ_MASK = (1 << _SEQ_BITS) - 1

@@ -39,6 +39,7 @@ from shadow_tpu.simtime import NS_PER_MS, NS_PER_SEC
 from shadow_tpu.transport import tcp
 from shadow_tpu.transport.tcp import (
     KIND_TCP_FLUSH,
+    KIND_TCP_TIMER,
     TCP_KIND_USER_BASE,
     TcpParams,
     TcpState,
@@ -74,6 +75,11 @@ class TgenModel:
 
     DRAWS_PER_EVENT = 0
     BOOTSTRAP_DRAWS = 0
+    # tracker-plane kind classification (engine/round.py): the kinds the
+    # TCP machinery owns (RTO timers + flush continuations) — kind
+    # integers are only unique within a model, so the range is declared
+    # here, not globally
+    TCP_KIND_RANGE = (KIND_TCP_TIMER, TCP_KIND_USER_BASE)
 
     @property
     def LOCAL_EMITS(self):  # noqa: N802
@@ -82,6 +88,12 @@ class TgenModel:
     @property
     def PACKET_EMITS(self):  # noqa: N802
         return self.tcp_params.packet_lanes
+
+    @property
+    def WIRE_HEADER_BYTES(self):  # noqa: N802
+        # tracker-plane byte classification (engine/round.py): a kept
+        # packet at exactly header size is control (pure ACK/SYN/FIN)
+        return self.tcp_params.header_bytes
 
     def __post_init__(self):
         if self.num_clients + self.num_servers > self.num_hosts:

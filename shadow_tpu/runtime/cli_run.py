@@ -21,11 +21,22 @@ class CliUserError(Exception):
     pass
 
 
-def run_from_config(path: str, show_config: bool = False) -> int:
+def run_from_config(
+    path: str,
+    show_config: bool = False,
+    tracker: bool = False,
+    trace_file: "str | None" = None,
+) -> int:
     try:
         config = load_config_file(path)
     except (ValueError, OSError, yaml.YAMLError) as e:
         raise CliUserError(f"invalid config: {e}") from e
+    # CLI flags override the config's general section (reference
+    # main.rs:61-120: flags are config overrides)
+    if tracker:
+        config.general.tracker = True
+    if trace_file:
+        config.general.trace_file = trace_file
     set_level(config.general.log_level)
     if show_config:
         print(json.dumps(config.to_dict(), indent=2, default=str))

@@ -45,8 +45,11 @@ import numpy as np
 from shadow_tpu import equeue
 from shadow_tpu.engine import EngineConfig
 from shadow_tpu.engine.round import (
+    PROBE_OUTBOX_OV,
     PROBE_OVERFLOW,
+    PROBE_QUEUE_OV,
     CapacityError,
+    _tspan,
     run_round,
     state_probe,
 )
@@ -125,9 +128,12 @@ def _fetch_records(st, probe):
     pr, r_time, r_data, r_flag, r_ov = rec
     engine_ov = int(pr[PROBE_OVERFLOW])
     if int(r_ov.sum()) or engine_ov:
+        # name the saturated lane (record ring vs queue vs outbox — the
+        # probe's split lanes) so the blowup is diagnosable in one run
         raise CapacityError(
             f"hybrid device capacity exhausted (records={int(r_ov.sum())}, "
-            f"queue+outbox={engine_ov}); raise "
+            f"queue={int(pr[PROBE_QUEUE_OV])}, "
+            f"outbox={int(pr[PROBE_OUTBOX_OV])}); raise "
             f"record_capacity/queue_capacity/outbox_capacity"
         )
     hh, aa = np.nonzero(r_flag > 0)
@@ -187,6 +193,9 @@ class HybridScheduler:
         self.device_passes = 0
         self._horizon: "int | None" = None
         self._probe = None  # device probe of the latest pass
+        # optional utils/tracker.py registry: records hybrid_pass/
+        # hybrid_upload/hybrid_drain spans for the dispatch trace
+        self.tracker = None
 
         model, cfgs, tabs = self.model, self.cfg, self.tables
 
@@ -220,26 +229,29 @@ class HybridScheduler:
     def _upload_sends(self, sends: "list[tuple]") -> None:
         """Stage buffered sends as KIND_MSEND events on their source hosts'
         device queues."""
-        valid, src, time, tie, data = _pack_sends(sends)
-        self.st = self._upload_jit(self.st, valid, src, time, tie, data)
+        with _tspan(self.tracker, "hybrid_upload", sends=len(sends)):
+            valid, src, time, tie, data = _pack_sends(sends)
+            self.st = self._upload_jit(self.st, valid, src, time, tie, data)
         self.inflight += len(sends)
 
     def _run_pass(self, window_end: int) -> None:
-        self.st, self._probe = self._pass_jit(
-            self.st, jnp.asarray(window_end, jnp.int64)
-        )
+        with _tspan(self.tracker, "hybrid_pass"):
+            self.st, self._probe = self._pass_jit(
+                self.st, jnp.asarray(window_end, jnp.int64)
+            )
         self.device_passes += 1
 
     def _drain_records(self) -> None:
-        recs = _fetch_records(self.st, self._probe)
-        if recs is None:
-            return
-        t, srcs, seqs, flags = recs
-        for flag, rec_t, src, seq in zip(flags, t, srcs, seqs):
-            self.k.hybrid_apply_record(
-                flag, rec_t, src, seq, horizon_ns=self._horizon
-            )
-        self.inflight -= len(t)
+        with _tspan(self.tracker, "hybrid_drain"):
+            recs = _fetch_records(self.st, self._probe)
+            if recs is None:
+                return
+            t, srcs, seqs, flags = recs
+            for flag, rec_t, src, seq in zip(flags, t, srcs, seqs):
+                self.k.hybrid_apply_record(
+                    flag, rec_t, src, seq, horizon_ns=self._horizon
+                )
+            self.inflight -= len(t)
 
     # --- the lockstep loop -------------------------------------------------
 
@@ -357,6 +369,10 @@ class ParallelHybridScheduler:
         self.device_passes = 0
         self._horizon: "int | None" = None
         self._probe = None  # fetched probe of the latest pass
+        # optional utils/tracker.py registry: every _phase interval
+        # (worker_execute round-trips, device passes, upload/drain) also
+        # lands in the dispatch trace as a span
+        self.tracker = None
         # (src, seq) -> (dst, payload-or-None) for records in flight
         self._send_meta: "dict[tuple[int, int], tuple]" = {}
 
@@ -468,9 +484,10 @@ class ParallelHybridScheduler:
     # --- device interaction (same math as HybridScheduler) ---------------
 
     def _phase(self, name, t0):
-        self.phase_wall[name] = self.phase_wall.get(name, 0.0) + (
-            _walltime.perf_counter() - t0
-        )
+        t1 = _walltime.perf_counter()
+        self.phase_wall[name] = self.phase_wall.get(name, 0.0) + (t1 - t0)
+        if self.tracker is not None:
+            self.tracker.add_span(name, t0, t1)
 
     def _upload_sends(self, sends: "list[tuple]") -> None:
         t0 = _walltime.perf_counter()

@@ -225,6 +225,7 @@ class Manager:
             use_netstack=use_netstack,
             bootstrap_end_ns=cfgo.general.bootstrap_end_time_ns,
             use_dynamic_runahead=cfgo.experimental.use_dynamic_runahead,
+            tracker=cfgo.general.tracker,
         )
 
         sched = make_scheduler(
@@ -246,29 +247,42 @@ class Manager:
         from shadow_tpu.utils.progress import ProgressLine
 
         progress = ProgressLine(cfgo.general.progress)
+        tracker = self._build_tracker(progress)
 
         def on_chunk(probe):
             # probe is an engine ChunkProbe of already-fetched ints (the
             # driver's per-chunk termination probe): progress and
             # heartbeat lines cost zero extra device syncs
-            progress.update(probe.now, end)
+            progress.update(probe.now, end, events=probe.events_handled)
+            if tracker is not None:
+                tracker.record_probe(probe)
             if hb_ns <= 0:
                 return
             if probe.now - last_hb[0] >= hb_ns:
                 last_hb[0] = probe.now
                 progress.clear()
+                extra = ""
+                if tracker is not None:
+                    # the probe's tracker lanes: aggregate drop/kind
+                    # detail on the manager heartbeat, still sync-free
+                    extra = (
+                        f", drops loss={probe.drop_loss} "
+                        f"codel={probe.drop_codel} "
+                        f"unroutable={probe.drop_unroutable}"
+                    )
                 slog(
                     "info",
                     probe.now,
                     "manager",
                     f"heartbeat: {probe.events_handled} events, "
-                    f"{probe.packets_sent} packets, sim time {fmt_time_ns(probe.now)}",
+                    f"{probe.packets_sent} packets, sim time "
+                    f"{fmt_time_ns(probe.now)}{extra}",
                 )
 
         slog("info", 0, "manager", f"starting: {num_hosts} hosts, scheduler={sched.name}, "
              f"runahead={runahead}ns, stop={fmt_time_ns(end)}")
         t0 = time.perf_counter()
-        final = sched.run(end, on_chunk=on_chunk)
+        final = sched.run(end, on_chunk=on_chunk, tracker=tracker)
         wall = time.perf_counter() - t0
         progress.finish(end)
 
@@ -294,11 +308,52 @@ class Manager:
                 sim_seconds=end / NS_PER_SEC,
                 scheduler=sched.name,
             )
+        self._fold_tracker(
+            tracker, results, end,
+            final_state=None if isinstance(sched, CpuRefScheduler) else final,
+        )
         slog("info", end, "manager",
              f"finished: {results.events_handled} events in {wall:.2f}s wall "
              f"({results.sim_sec_per_wall_sec:.2f} sim-s/wall-s)")
         self._write_outputs(results)
         return results
+
+    def _fold_tracker(self, tracker, results, end, final_state=None):
+        """The shared run epilogue: fold the tracker registry into
+        sim-stats' extra_stats and write the dispatch trace. With a
+        final SimState and device counters on, performs the ONE bulk
+        per-host fetch (the heartbeat path fetches only on cadence);
+        span-only trackers (--trace-file without --tracker) publish
+        phases only."""
+        if tracker is None:
+            return
+        if tracker.counters and final_state is not None:
+            from shadow_tpu.engine.round import host_stats
+
+            tracker.finalize(host_stats(final_state))
+        results.extra_stats["tracker"] = tracker.stats_dict()
+        trace_path = tracker.write_trace()
+        if trace_path:
+            slog("info", end, "manager", f"wrote dispatch trace: {trace_path}")
+
+    def _build_tracker(self, progress=None):
+        """The host-side tracker registry (utils/tracker.py), or None
+        when neither general.tracker nor general.trace_file asks for it.
+        trace_file alone records dispatch spans; per-host heartbeats and
+        the sim-stats fold need the device counters (general.tracker)."""
+        g = self.config.general
+        if not (g.tracker or g.trace_file):
+            return None
+        from shadow_tpu.utils.tracker import Tracker
+
+        return Tracker(
+            host_names=[h.name for h in self.hosts],
+            heartbeat_ns=g.heartbeat_interval_ns if g.tracker else 0,
+            trace_path=g.trace_file,
+            clear_line=progress.clear if progress is not None else None,
+            host_heartbeats=g.tracker,
+            counters=g.tracker,
+        )
 
     def _run_managed(self) -> SimResults:
         """Run real executables as managed processes under the LD_PRELOAD
@@ -317,6 +372,7 @@ class Manager:
         tables = tables.with_hosts(host_node)
 
         runahead = self._resolve_runahead(tables)
+        tracker = self._build_tracker()
 
         specs = [
             ProcessSpec(
@@ -338,7 +394,7 @@ class Manager:
                 "egress is FIFO in lane order"
             )
         if sched_name == "tpu" and cfgo.general.parallelism > 1:
-            return self._run_managed_parallel(tables, runahead, specs)
+            return self._run_managed_parallel(tables, runahead, specs, tracker)
 
         k = NetKernel(
             tables,
@@ -396,6 +452,7 @@ class Manager:
                 ),
                 record_capacity=cfgo.experimental.record_capacity,
             )
+            runner.tracker = tracker
             run_fn, sched_label = runner.run, HybridScheduler.name
         else:
             run_fn, sched_label = k.run, "managed"
@@ -427,13 +484,16 @@ class Manager:
             unexpected_final_states=unexpected,
             extra_stats=stats,
         )
+        self._fold_tracker(tracker, results, end)
         slog("info", end, "manager",
              f"finished: {stats['syscalls_handled']} syscalls, "
              f"{stats['packets_sent']} packets in {wall:.2f}s wall")
         self._write_outputs(results)
         return results
 
-    def _run_managed_parallel(self, tables, runahead: int, specs) -> SimResults:
+    def _run_managed_parallel(
+        self, tables, runahead: int, specs, tracker=None
+    ) -> SimResults:
         """Managed run with hosts sharded over worker kernel processes
         (general.parallelism workers) and packets on the device engine —
         the role of the reference's thread_per_core scheduler
@@ -485,6 +545,7 @@ class Manager:
             max_unapplied_ns=cfgo.experimental.max_unapplied_cpu_latency_ns,
             cpu_freq_hz=[h.cpu_freq_hz for h in self.hosts],
         )
+        sched.tracker = tracker
         end = cfgo.general.stop_time_ns
         slog("info", 0, "manager",
              f"starting: {len(self.hosts)} hosts, scheduler={sched.name} "
@@ -515,6 +576,7 @@ class Manager:
             unexpected_final_states=unexpected,
             extra_stats=stats,
         )
+        self._fold_tracker(tracker, results, end)
         slog("info", end, "manager",
              f"finished: {stats['syscalls_handled']} syscalls, "
              f"{stats['packets_sent']} packets in {wall:.2f}s wall")

@@ -51,7 +51,8 @@ class TpuScheduler:
         else:
             self._runner = None
 
-    def run(self, end_time_ns: int, on_chunk=None, max_chunks: int = 100_000):
+    def run(self, end_time_ns: int, on_chunk=None, max_chunks: int = 100_000,
+            tracker=None):
         st = bootstrap(
             init_state(
                 self.cfg,
@@ -63,7 +64,10 @@ class TpuScheduler:
             self.cfg,
         )
         if self._runner is not None:
-            return self._runner.run_until(st, end_time_ns, max_chunks=max_chunks, on_chunk=on_chunk)
+            return self._runner.run_until(
+                st, end_time_ns, max_chunks=max_chunks, on_chunk=on_chunk,
+                tracker=tracker,
+            )
         return run_until(
             st,
             end_time_ns,
@@ -73,6 +77,7 @@ class TpuScheduler:
             rounds_per_chunk=self.rounds_per_chunk,
             max_chunks=max_chunks,
             on_chunk=on_chunk,
+            tracker=tracker,
         )
 
 
@@ -100,7 +105,10 @@ class CpuRefScheduler:
                            tx_bytes_per_interval=tx_bytes_per_interval,
                            rx_bytes_per_interval=rx_bytes_per_interval)
 
-    def run(self, end_time_ns: int, on_chunk=None, max_chunks: int = 100_000):
+    def run(self, end_time_ns: int, on_chunk=None, max_chunks: int = 100_000,
+            tracker=None):
+        # the oracle has no device dispatch pipeline: tracker spans and
+        # device counters do not apply here
         self.ref.bootstrap()
         self.ref.run_until(end_time_ns)
         return self.ref

@@ -163,19 +163,20 @@ def profile_engines(reps: int, hosts: int):
 
 
 def profile_dispatch(hosts: int, chunks: int = 6):
-    """Dispatch gap (sync vs pipelined driver) and per-chunk HBM copy
-    bytes (donated vs undonated chunk executable) on the burst phase."""
+    """Dispatch accounting on the burst phase, read from the tracker
+    plane's spans (round-8 tentpole): the REAL run_until driver runs
+    with a utils/tracker.py Tracker attached — the same spans
+    `--trace-file` writes — and the sync decision gap / pipelined
+    launch-ahead margin / per-launch call wall are computed from the
+    recorded (ts, dur) intervals instead of an ad-hoc reimplementation
+    of the drive loop. Also reports per-chunk HBM copy bytes (donated
+    vs undonated chunk executable)."""
     import jax
     import jax.numpy as jnp
-    import numpy as np
 
     from bench import _build
-    from shadow_tpu.engine.round import (
-        _peek_next_time,
-        _run_chunk,
-        _run_chunk_jit,
-        run_until,
-    )
+    from shadow_tpu.engine.round import _run_chunk, _run_chunk_jit, run_until
+    from shadow_tpu.utils.tracker import Tracker
 
     burst_env = os.environ.get("SHADOW_TPU_PROFILE_BURST_MS", "20,60")
     b0_ms = int(burst_env.split(",")[0])
@@ -184,7 +185,8 @@ def profile_dispatch(hosts: int, chunks: int = 6):
     cfg, model, tables, st0 = _build(hosts)
     st_burst = run_until(st0, b0, model, tables, cfg, rounds_per_chunk=32)
     jax.block_until_ready(st_burst.events_handled)
-    end = jnp.asarray(10**15, jnp.int64)  # far horizon: chunks never quiesce
+    far = 10**15  # far horizon: chunks never quiesce
+    end = jnp.asarray(far, jnp.int64)
     rpc = 8
     out = {"hosts": hosts, "rounds_per_chunk": rpc, "chunks": chunks}
 
@@ -216,62 +218,66 @@ def profile_dispatch(hosts: int, chunks: int = 6):
     except Exception as e:  # noqa: BLE001 — memory analysis is best-effort
         out["per_chunk_copy"] = {"error": str(e)[:200]}
 
-    # --- dispatch gap: wall between chunk completion and next launch -----
-    def launch(s):
-        return _run_chunk_jit(s, end, rpc, model, tables, cfg)
-
+    # --- dispatch gap from tracker spans ---------------------------------
     def drive(pipeline):
-        """Gap = wall from a chunk's observed completion to the next
-        chunk's launch INVOCATION — the window the device sits idle while
-        the host decides. (The launch call's own duration is reported
-        separately: XLA:CPU executes inline during dispatch, which would
-        otherwise masquerade as decision time.)"""
-        pend_st, pend_probe = launch(st_burst.donatable())
-        gaps, dispatch_walls = [], []
-        for _ in range(chunks - 1):
-            if pipeline:
-                t_launch = time.perf_counter()
-                nxt = launch(pend_st)  # dispatched before the probe fetch
-                dispatch_walls.append(time.perf_counter() - t_launch)
-                np.asarray(jax.device_get(pend_probe))  # chunk N observed done
-                t_done = time.perf_counter()
-                # the pipelined gap is 0 BY CONSTRUCTION (the launch
-                # precedes the completion observation in program order);
-                # the measured quantity is the launch-ahead margin — how
-                # long before chunk N's completion was even observable
-                # the next chunk was already dispatched
-                gaps.append(t_done - t_launch)
-                pend_st, pend_probe = nxt
-            else:
-                # the pre-pipeline driver shape: block until chunk N is
-                # done, run the separate peek dispatch + transfer that
-                # made the continue/stop decision, then launch N+1
-                jax.block_until_ready(pend_probe)
-                t_done = time.perf_counter()
-                int(_peek_next_time(pend_st))
-                t_launch = time.perf_counter()
-                gaps.append(t_launch - t_done)
-                pend_st, pend_probe = launch(pend_st)
-                dispatch_walls.append(time.perf_counter() - t_launch)
-        jax.block_until_ready(pend_st.now)
-        return gaps, dispatch_walls
+        """Run exactly `chunks` launches through the production driver
+        with a Tracker attached; the bounded max_chunks stop raises
+        RuntimeError by design (the horizon is unreachable). Only THAT
+        stop is absorbed — a CapacityError or any other runtime failure
+        must surface, not publish gap numbers from a dead run."""
+        tr = Tracker()
+        try:
+            run_until(
+                st_burst, far, model, tables, cfg, rounds_per_chunk=rpc,
+                max_chunks=chunks, pipeline=pipeline, tracker=tr,
+            )
+        except RuntimeError as e:
+            if "did not reach end_time" not in str(e):
+                raise  # capacity/donation/backend errors are real
+        launches = {
+            e.get("args", {}).get("chunk"): e
+            for e in tr.spans("compile+launch") + tr.spans("chunk_launch")
+        }
+        fetches = {
+            e.get("args", {}).get("chunk"): e for e in tr.spans("probe_fetch")
+        }
+        return tr, launches, fetches
 
-    drive(True)  # warm the chunk + peek executables
-    int(_peek_next_time(st_burst))
-    gaps, dwalls = drive(False)
+    def _span_end(e):
+        return e["ts"] + e["dur"]
+
+    drive(True)  # warm the chunk executable (its spans are discarded)
+    _tr, launches, fetches = drive(False)
+    # synchronous driver: the device idles from probe-fetch end (chunk N
+    # observed done, decision made) to the next launch call — plus the
+    # launch call itself (reported separately: XLA:CPU executes inline
+    # during dispatch, which would otherwise masquerade as decision time)
+    gaps = [
+        (launches[i + 1]["ts"] - _span_end(fetches[i])) / 1e3
+        for i in range(chunks - 1)
+        if i + 1 in launches and i in fetches
+    ]
+    dwalls = [launches[i]["dur"] / 1e3 for i in launches if i > 0]
     out["dispatch_gap_sync_ms"] = {
-        "mean": round(sum(gaps) / len(gaps) * 1e3, 3),
-        "max": round(max(gaps) * 1e3, 3),
-        "launch_call_mean_ms": round(sum(dwalls) / len(dwalls) * 1e3, 3),
+        "mean": round(sum(gaps) / max(len(gaps), 1), 3),
+        "max": round(max(gaps), 3),
+        "launch_call_mean_ms": round(sum(dwalls) / max(len(dwalls), 1), 3),
     }
-    ahead, dwalls = drive(True)
+    _tr, launches, fetches = drive(True)
+    # pipelined: chunk N+1's launch span ENDS before chunk N's probe
+    # fetch does — the gap is 0 by construction; the measured quantity is
+    # the launch-ahead margin (how long before chunk N's completion was
+    # even observable the next chunk was already dispatched)
+    ahead = [
+        (_span_end(fetches[i]) - _span_end(launches[i + 1])) / 1e3
+        for i in range(chunks - 1)
+        if i + 1 in launches and i in fetches
+    ]
+    dwalls = [launches[i]["dur"] / 1e3 for i in launches if i > 0]
     out["dispatch_gap_pipelined_ms"] = {
-        # zero by construction: the next launch is dispatched before the
-        # previous chunk's completion is observable, so there is no
-        # decision window at all — launch_ahead is the measured margin
         "by_construction": 0.0,
-        "launch_ahead_mean_ms": round(sum(ahead) / len(ahead) * 1e3, 3),
-        "launch_call_mean_ms": round(sum(dwalls) / len(dwalls) * 1e3, 3),
+        "launch_ahead_mean_ms": round(sum(ahead) / max(len(ahead), 1), 3),
+        "launch_call_mean_ms": round(sum(dwalls) / max(len(dwalls), 1), 3),
     }
     print(json.dumps({"dispatch": out}), flush=True)
     return out
