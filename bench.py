@@ -167,6 +167,7 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
     import numpy as np
 
     from shadow_tpu.engine.round import run_until
+    from shadow_tpu.runtime.recovery import RecoveryPolicy, run_until_recovering
     from shadow_tpu.utils.tracker import Tracker
 
     # one tracker per measure child: every run_until below (engine
@@ -256,7 +257,12 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
             flush=True,
         )
 
-    st = run_until(
+    # the main measurement runs under rollback-and-regrow recovery
+    # (runtime/recovery.py): a capacity blowup at scale regrows the
+    # saturated buffer and replays instead of killing the trial — each
+    # recovery prints a salvageable {"recovery": ...} line the parent
+    # folds into the attempt's structured failure/recovery fields
+    st, recoveries = run_until_recovering(
         st0,
         end,
         model,
@@ -266,6 +272,8 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
         max_chunks=1_000_000,
         on_chunk=on_chunk,
         tracker=tracker,
+        policy=RecoveryPolicy(max_recoveries=2),
+        on_recovery=lambda rec: print(json.dumps({"recovery": rec}), flush=True),
     )
     jax.block_until_ready(st.events_handled)
     wall = time.perf_counter() - t0
@@ -274,6 +282,7 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
         "backend": jax.default_backend(),
         "rate": sim_sec / wall,
         "wall_s": round(wall, 2),
+        "recoveries": len(recoveries),
         "events": int(np.asarray(st.events_handled).sum()),
         "streams_done": int(np.asarray(st.model.streams_done).sum()),
         "bytes_down": int(np.asarray(st.model.bytes_down).sum()),
@@ -310,10 +319,29 @@ def _cpu_env(**extra) -> dict:
     return env
 
 
+def _classify_failure(timed_out: bool, returncode, err_tail: str) -> str:
+    """Structured failure kind for the attempt log: capacity blowups and
+    worker/tunnel crashes are distinguishable from plain timeouts without
+    grepping free text (the published JSON carries the kind)."""
+    # timeout wins over the capacity substring: a trial that RECOVERED
+    # from a capacity blowup (its warning line sits in the stderr tail)
+    # and then timed out failed on time, not capacity — the recovery
+    # count is published separately
+    if timed_out:
+        return "timeout"
+    if "CapacityError" in err_tail or "capacity exhausted" in err_tail:
+        return "capacity"
+    if isinstance(returncode, int) and returncode < 0:
+        return "worker-crash"  # killed by a signal (dead tunnel worker)
+    return "error"
+
+
 def _run_attempt(env: dict, timeout_s: float) -> dict:
     """Run one measurement subprocess; returns
-    {ok, result?, partial?, error?} where partial carries the furthest
-    progress line seen before a crash/timeout."""
+    {ok, result?, partial?, error?, failure?} where partial carries the
+    furthest progress line seen before a crash/timeout and failure is the
+    structured {kind, recoveries} record bench JSONs publish for
+    failed/aborted trials."""
     t0 = time.perf_counter()
     try:
         r = subprocess.run(
@@ -336,7 +364,7 @@ def _run_attempt(env: dict, timeout_s: float) -> dict:
         timed_out = True
 
     result, last_progress, engine_trials = None, None, {}
-    last_phases = None
+    last_phases, recoveries = None, []
     for ln in out_lines:
         try:
             obj = json.loads(ln)
@@ -348,15 +376,24 @@ def _run_attempt(env: dict, timeout_s: float) -> dict:
                 last_phases = obj["phases"]
         elif "backend" in obj:
             result = obj
+        elif "recovery" in obj:
+            # rollback-and-regrow events print as they happen, so even a
+            # later-killed attempt records how many times it recovered
+            recoveries.append(obj["recovery"])
         elif "engine_trial" in obj and "wall" in obj:
             # auto-select trial timings print before the main run starts,
             # so even a timed-out attempt records which engine won
             engine_trials[obj["engine_trial"]] = obj["wall"]
     if result is not None:
         return {"ok": True, "result": result}
+    rc = None if timed_out else getattr(r, "returncode", None)
     out = {
         "ok": False,
-        "error": err_tail if timed_out else f"rc={getattr(r, 'returncode', '?')}: {err_tail}",
+        "error": err_tail if timed_out else f"rc={rc}: {err_tail}",
+        "failure": {
+            "kind": _classify_failure(timed_out, rc, err_tail),
+            "recoveries": len(recoveries),
+        },
         "wall_s": round(time.perf_counter() - t0, 1),
     }
     if last_progress is not None and last_progress.get("wall", 0) > 0:

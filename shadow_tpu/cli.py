@@ -37,6 +37,33 @@ def main(argv: "list[str] | None" = None) -> int:
         help="write a Chrome-trace JSON of the dispatch pipeline "
         "(chrome://tracing / Perfetto loadable; general.trace_file)",
     )
+    run_p.add_argument(
+        "--checkpoint-dir",
+        metavar="DIR",
+        help="write versioned run checkpoints into DIR at --checkpoint-"
+        "interval cadence; SIGINT/SIGTERM also write a final one "
+        "(general.checkpoint_dir; docs/robustness.md)",
+    )
+    run_p.add_argument(
+        "--checkpoint-interval",
+        metavar="TIME",
+        help="sim-time cadence between checkpoints, e.g. '30 s' "
+        "(general.checkpoint_interval; default 30 s)",
+    )
+    run_p.add_argument(
+        "--resume",
+        action="store_true",
+        help="resume from the newest checkpoint in --checkpoint-dir and "
+        "run to stop_time — bit-exact vs an uninterrupted run "
+        "(general.resume)",
+    )
+    run_p.add_argument(
+        "--no-recover",
+        action="store_true",
+        help="disable rollback-and-regrow capacity recovery: fail fast "
+        "on a CapacityError instead of regrowing the saturated buffer "
+        "and replaying (experimental.recover)",
+    )
     sub.add_parser(
         "shm-cleanup",
         help="remove stale shared-memory blocks left by crashed runs "
@@ -53,6 +80,10 @@ def main(argv: "list[str] | None" = None) -> int:
                 show_config=args.show_config,
                 tracker=args.tracker,
                 trace_file=args.trace_file,
+                checkpoint_dir=args.checkpoint_dir,
+                checkpoint_interval=args.checkpoint_interval,
+                resume=args.resume,
+                no_recover=args.no_recover,
             )
         except CliUserError as e:
             print(f"shadow-tpu: error: {e}", file=sys.stderr)

@@ -53,6 +53,15 @@ class GeneralOptions:
     # span recording even without `tracker`). CLI: --tracker/--trace-file.
     tracker: bool = False
     trace_file: Optional[str] = None
+    # Fault tolerance (docs/robustness.md): `checkpoint_dir` turns on
+    # versioned chunk-boundary checkpoints at `checkpoint_interval`
+    # sim-time cadence (SIGINT/SIGTERM also write a final one); `resume`
+    # restores the newest checkpoint in the dir and continues to
+    # stop_time, bit-exact vs an uninterrupted run. CLI:
+    # --checkpoint-dir/--checkpoint-interval/--resume.
+    checkpoint_dir: Optional[str] = None
+    checkpoint_interval_ns: int = 30_000_000_000
+    resume: bool = False
 
     @classmethod
     def from_dict(cls, d: dict) -> "GeneralOptions":
@@ -64,6 +73,11 @@ class GeneralOptions:
         if "heartbeat_interval" in d:
             hb = d.pop("heartbeat_interval")
             out.heartbeat_interval_ns = 0 if hb is None else parse_time_ns(hb)
+        if "checkpoint_interval" in d:
+            ci = d.pop("checkpoint_interval")
+            # null = no periodic cadence (final/interrupt checkpoints
+            # only), mirroring heartbeat_interval's null handling
+            out.checkpoint_interval_ns = 0 if ci is None else parse_time_ns(ci)
         for k in (
             "seed",
             "parallelism",
@@ -72,6 +86,8 @@ class GeneralOptions:
             "progress",
             "tracker",
             "trace_file",
+            "checkpoint_dir",
+            "resume",
         ):
             if k in d:
                 setattr(out, k, d.pop(k))
@@ -140,6 +156,14 @@ class ExperimentalOptions:
     syscall_latency_ns: int = 1_000
     vdso_latency_ns: int = 10
     max_unapplied_cpu_latency_ns: int = 1_000_000
+    # Rollback-and-regrow capacity recovery (docs/robustness.md): on a
+    # CapacityError the scripted device run rolls back to the last clean
+    # chunk-boundary snapshot, doubles the saturated buffer, recompiles,
+    # and replays — leaf-exact vs starting with the larger capacity.
+    # `recover: false` (CLI --no-recover) restores fail-fast.
+    recover: bool = True
+    recovery_max_retries: int = 4
+    recovery_snapshot_chunks: int = 32
 
     @classmethod
     def from_dict(cls, d: dict) -> "ExperimentalOptions":
@@ -168,6 +192,9 @@ class ExperimentalOptions:
             "use_tcp_autotune",
             "use_memory_manager",
             "interface_qdisc",
+            "recover",
+            "recovery_max_retries",
+            "recovery_snapshot_chunks",
         ):
             if k in d:
                 setattr(out, k, d.pop(k))

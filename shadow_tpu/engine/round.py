@@ -907,7 +907,26 @@ class ChunkProbe:
 
 
 class CapacityError(RuntimeError):
-    """Fixed-slot capacity exhausted — user-remediable via config."""
+    """Fixed-slot capacity exhausted — user-remediable via config, or
+    recoverable in place via rollback-and-regrow (runtime/recovery.py).
+    Instances carry the overflow split as attributes so recovery can
+    target the saturated buffer without parsing the message:
+    queue_overflow / outbox_overflow / queue_hwm / outbox_hwm (ints,
+    0 when unknown) and shard_detail (per-shard breakdown string from
+    the sharded driver, or None)."""
+
+    queue_overflow: int = 0
+    outbox_overflow: int = 0
+    queue_hwm: int = 0
+    outbox_hwm: int = 0
+    shard_detail: "str | None" = None
+
+
+class RunInterrupted(RuntimeError):
+    """The run was stopped by SIGINT/SIGTERM (runtime/checkpoint.py
+    InterruptGuard): the driver committed a final checkpoint (when one
+    could be verified clean) before raising. The partial state is NOT
+    returned — resume from the checkpoint instead."""
 
 
 def check_capacity(st: SimState) -> None:
@@ -990,13 +1009,18 @@ def _capacity_error(
         if queue_hwm or outbox_hwm:
             which += f"; high-water queue={queue_hwm}, outbox={outbox_hwm}"
         which += "]"
-    return CapacityError(
+    err = CapacityError(
         f"event capacity exhausted: {dropped} events/packets dropped "
         f"({which}); increase queue_capacity/"
         f"outbox_capacity — or, for sharded all_to_all runs with "
         f"pair-skewed destinations, set a2a_capacity=-1 (whole-outbox "
         f"buckets, never overflow)"
     )
+    err.queue_overflow = int(queue_ov or 0)
+    err.outbox_overflow = int(outbox_ov or 0)
+    err.queue_hwm = int(queue_hwm or 0)
+    err.outbox_hwm = int(outbox_hwm or 0)
+    return err
 
 
 def _tspan(tracker, name, **args):
@@ -1008,7 +1032,7 @@ def _tspan(tracker, name, **args):
 
 
 def _drive(launch, st, end_time, max_chunks, on_chunk, pipeline, desc,
-           tracker=None):
+           tracker=None, on_state=None, capacity_detail=None):
     """The shared chunk-dispatch loop behind run_until and
     ShardedRunner.run_until.
 
@@ -1033,11 +1057,24 @@ def _drive(launch, st, end_time, max_chunks, on_chunk, pipeline, desc,
     probe, never an extra sync — the full per-host counter tensors are
     pulled in ONE bulk device_get from the live (never-donated) pending
     state and rendered as reference-style tracker lines.
+
+    `on_state` (runtime/checkpoint.py StateTap) taps chunk-boundary
+    states for checkpoints / recovery snapshots / interrupt handling:
+    `due(probe, chunk)` decides from the already-fetched probe,
+    `commit(host_state)` receives a VERIFIED plain-numpy snapshot
+    (state_to_host), `interrupted()` asks for an immediate stop. Under
+    pipelining the live state at probe time is one chunk ahead of the
+    verified probe, so a snapshot is held pending and committed only
+    after its own chunk's probe passes the capacity check — a committed
+    snapshot can never contain silently-dropped events. `capacity_detail`
+    (sharded driver) turns a live state into a per-shard overflow
+    breakdown appended to the CapacityError.
     """
     with _tspan(tracker, "compile+launch", chunk=0):
         pend_st, pend_probe = launch(st)
     launched = 1
     fetched = 0  # index of the chunk whose probe is fetched next
+    pending_snap = None  # (chunk_idx, host_state) awaiting its own probe
     while True:
         nxt = None
         if pipeline and launched < max_chunks:
@@ -1048,13 +1085,22 @@ def _drive(launch, st, end_time, max_chunks, on_chunk, pipeline, desc,
             probe = ChunkProbe.from_array(jax.device_get(pend_probe))
         fetched += 1
         if probe.overflow:
-            raise _capacity_error(
+            err = _capacity_error(
                 probe.overflow,
                 queue_ov=probe.queue_overflow,
                 outbox_ov=probe.outbox_overflow,
                 queue_hwm=probe.queue_hwm,
                 outbox_hwm=probe.outbox_hwm,
             )
+            if capacity_detail is not None:
+                try:
+                    src = nxt[0] if nxt is not None else pend_st
+                    err.shard_detail = capacity_detail(src)
+                    if err.shard_detail:
+                        err.args = (f"{err.args[0]}\n{err.shard_detail}",)
+                except Exception:  # diagnostics must not mask the error
+                    pass
+            raise err
         if on_chunk is not None:
             on_chunk(probe)
         if tracker is not None and tracker.host_heartbeat_due(probe.now):
@@ -1064,6 +1110,37 @@ def _drive(launch, st, end_time, max_chunks, on_chunk, pipeline, desc,
             src = nxt[0] if nxt is not None else pend_st
             with _tspan(tracker, "host_stats_fetch"):
                 tracker.emit_host_heartbeat(probe, host_stats(src))
+        if on_state is not None:
+            # chunk `fetched-1`'s probe just passed the capacity check:
+            # any snapshot waiting on it is now verified clean
+            if pending_snap is not None and pending_snap[0] <= fetched - 1:
+                on_state.commit(pending_snap[1])
+                pending_snap = None
+            interrupted = on_state.interrupted()
+            if (
+                pending_snap is None and on_state.due(probe, fetched - 1)
+            ) or interrupted:
+                from shadow_tpu.engine.state import state_to_host
+
+                src = nxt[0] if nxt is not None else pend_st
+                with _tspan(tracker, "state_snapshot", chunk=launched - 1):
+                    host = state_to_host(src)
+                if nxt is None:
+                    on_state.commit(host)  # src IS the verified chunk
+                elif interrupted:
+                    # cannot wait a chunk for verification: check the
+                    # overflow counters on the host copy directly
+                    if (
+                        int(host.queue.overflow.sum()) == 0
+                        and int(host.outbox.overflow.sum()) == 0
+                    ):
+                        on_state.commit(host)
+                else:
+                    pending_snap = (launched - 1, host)
+            if interrupted:
+                raise RunInterrupted(
+                    f"run interrupted at sim time {probe.now} ns"
+                )
         if probe.next_time >= end_time:
             if nxt is None:
                 return pend_st
@@ -1112,6 +1189,7 @@ def run_until(
     on_chunk=None,
     pipeline: bool = True,
     tracker=None,
+    on_state=None,
 ) -> SimState:
     """Host-side driver: chunked device scans until no work remains before
     end_time. Single-device variant; the sharded driver lives in
@@ -1145,7 +1223,7 @@ def run_until(
     return _drive(
         launch, st, end_time, max_chunks, on_chunk, pipeline,
         desc=f"{max_chunks}x{rounds_per_chunk} rounds",
-        tracker=tracker,
+        tracker=tracker, on_state=on_state,
     )
 
 

@@ -151,6 +151,44 @@ class ShardedRunner:
         # single-device driver (run_until feeds only its private copy)
         return jax.jit(f, donate_argnums=(0,))
 
+    def _capacity_detail(self, st: SimState) -> str:
+        """Per-shard overflow/high-water breakdown for a CapacityError:
+        the probe's lanes arrive psum/pmax-reduced over the mesh, which
+        says THAT capacity blew but not WHERE. This runs only on the
+        failure path (one bulk fetch of the four [H] counter arrays),
+        reshapes the block-sharded rows to [shards, local] and names the
+        shard(s) that actually saturated, so regrow/debugging targets the
+        hot shard instead of the mesh-summed aggregate."""
+        import numpy as np
+
+        n = self.mesh.shape[AXIS]
+        qov, oov, qhw, ohw = (
+            np.asarray(jax.device_get(a)).reshape(n, -1)
+            for a in (
+                st.queue.overflow,
+                st.outbox.overflow,
+                st.tracker.queue_hwm,
+                st.tracker.outbox_hwm,
+            )
+        )
+        rows = []
+        for i in range(n):
+            if qov[i].sum() or oov[i].sum():
+                row = (
+                    f"shard {i}: queue_ov={int(qov[i].sum())} "
+                    f"outbox_ov={int(oov[i].sum())}"
+                )
+                # high-water marks are only accumulated under cfg.tracker;
+                # zeros would misread as "near-empty buffers" on the very
+                # shard that saturated
+                if qhw[i].max() or ohw[i].max():
+                    row += (
+                        f" queue_hwm={int(qhw[i].max())} "
+                        f"outbox_hwm={int(ohw[i].max())}"
+                    )
+                rows.append(row)
+        return "per-shard overflow: " + "; ".join(rows) if rows else ""
+
     def run_until(
         self,
         st: SimState,
@@ -159,6 +197,7 @@ class ShardedRunner:
         on_chunk=None,
         pipeline: bool = True,
         tracker=None,
+        on_state=None,
     ) -> SimState:
         """Sharded chunk driver: the same depth-2 async dispatch pipeline
         as engine/round.py run_until (donated state, probe-only syncs,
@@ -185,5 +224,6 @@ class ShardedRunner:
         return _drive(
             launch, st, end_time, max_chunks, on_chunk, pipeline,
             desc=f"{max_chunks}x{self.rounds_per_chunk} rounds (sharded)",
-            tracker=tracker,
+            tracker=tracker, on_state=on_state,
+            capacity_detail=self._capacity_detail,
         )

@@ -299,6 +299,95 @@ def empty_packet_emits(h: int, ep: int) -> PacketEmits:
     )
 
 
+def _is_key_leaf(leaf) -> bool:
+    return hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jax.dtypes.prng_key)
+
+
+def state_to_host(st: SimState) -> SimState:
+    """ONE bulk device_get of the full state, with typed PRNG key leaves
+    unwrapped to their raw uint32 words (numpy cannot represent extended
+    dtypes). This is the host-side snapshot format shared by on-disk
+    checkpoints (runtime/checkpoint.py) and the rollback-and-regrow
+    retention (runtime/recovery.py): a plain-numpy pytree that stays
+    valid no matter how many times the device buffers are donated
+    afterwards. Invert with state_from_host."""
+    return jax.device_get(
+        jax.tree.map(lambda l: jax.random.key_data(l) if _is_key_leaf(l) else l, st)
+    )
+
+
+def state_from_host(host_st: SimState, like: SimState) -> SimState:
+    """Rebuild a device SimState from a state_to_host snapshot. `like`
+    supplies the leaf dtypes and marks which leaves are typed PRNG keys
+    (their raw words are re-wrapped with the template's key impl); every
+    leaf shape must match the template exactly — a shape drift means the
+    snapshot belongs to a different world/config."""
+
+    def rewrap(h, t):
+        if _is_key_leaf(t):
+            return jax.random.wrap_key_data(
+                jnp.asarray(h), impl=jax.random.key_impl(t)
+            )
+        a = jnp.asarray(h, dtype=t.dtype)
+        if a.shape != t.shape:
+            raise ValueError(
+                f"snapshot leaf shape {a.shape} != template {t.shape}; "
+                "the snapshot was taken for a different world/config"
+            )
+        return a
+
+    return jax.tree.map(rewrap, host_st, like)
+
+
+def grow_state(
+    st: SimState,
+    queue_capacity: "int | None" = None,
+    outbox_capacity: "int | None" = None,
+) -> SimState:
+    """Widen the fixed-slot buffers of a state in place of a fresh init:
+    existing slots keep their contents (including tombstone garbage —
+    identical garbage on matched trajectories, so leaf-exactness survives),
+    new slots get the canonical empty fill values of equeue.create /
+    _empty_outbox. Growing is trajectory-neutral for a state that never
+    overflowed: a run continued from the grown state is leaf-exact to one
+    that started with the larger capacity (tests/test_robustness.py), which
+    is what makes rollback-and-regrow recovery deterministic. Shrinking is
+    refused — it could drop live slots."""
+    from shadow_tpu.events import KIND_INVALID
+
+    def pad(a, extra, fill, dtype):
+        shape = (a.shape[0], extra) + a.shape[2:]
+        return jnp.concatenate([a, jnp.full(shape, fill, dtype)], axis=1)
+
+    q = st.queue
+    if queue_capacity is not None and queue_capacity != q.capacity:
+        if queue_capacity < q.capacity:
+            raise ValueError("grow_state cannot shrink queue_capacity")
+        extra = queue_capacity - q.capacity
+        q = q.replace(
+            time=pad(q.time, extra, TIME_MAX, jnp.int64),
+            tie=pad(q.tie, extra, jnp.iinfo(jnp.int64).max, jnp.int64),
+            kind=pad(q.kind, extra, KIND_INVALID, jnp.int32),
+            data=pad(q.data, extra, 0, jnp.int32),
+            aux=pad(q.aux, extra, 0, jnp.int32),
+        )
+    ob = st.outbox
+    o_cap = ob.valid.shape[1]
+    if outbox_capacity is not None and outbox_capacity != o_cap:
+        if outbox_capacity < o_cap:
+            raise ValueError("grow_state cannot shrink outbox_capacity")
+        extra = outbox_capacity - o_cap
+        ob = ob.replace(
+            valid=pad(ob.valid, extra, False, bool),
+            dst=pad(ob.dst, extra, 0, jnp.int32),
+            time=pad(ob.time, extra, TIME_MAX, jnp.int64),
+            tie=pad(ob.tie, extra, 0, jnp.int64),
+            data=pad(ob.data, extra, 0, jnp.int32),
+            aux=pad(ob.aux, extra, 0, jnp.int32),
+        )
+    return st.replace(queue=q, outbox=ob)
+
+
 def init_state(
     cfg: EngineConfig,
     model_state,

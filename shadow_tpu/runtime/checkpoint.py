@@ -1,0 +1,289 @@
+"""Deterministic checkpoint/restore for device runs (docs/robustness.md).
+
+A checkpoint is the complete run cursor: because the chunk loop is
+memoryless given the SimState (engine/round.py `_run_chunk` is a pure
+function of (state, end, cfg)), a state captured at a chunk boundary plus
+the config fingerprint is everything resume needs — RNG keys and draw
+counters, scheduler progress (`now`), and the tracker plane all live on
+the state pytree. A run resumed from a checkpoint re-executes exactly the
+chunk sequence the uninterrupted run would have run from that boundary,
+so the final state is bit-identical (tests/test_robustness.py pins this
+leaf-exactly across plain/pump/megakernel and the sharded runner).
+
+On-disk format (versioned): one .npz per checkpoint holding the
+state_to_host leaves (typed PRNG keys stored as raw uint32 words) as
+``leaf_00000..`` entries plus a ``__meta__`` JSON string with the format
+version, the config fingerprint, the sim time, and the leaf key paths.
+Writes are atomic (tmp + os.replace), so a kill mid-write can never leave
+a truncated "latest" checkpoint. Restore validates version, fingerprint,
+and every leaf shape/dtype against a freshly built template state — a
+checkpoint can only resume the exact world it was saved from.
+
+The driver taps states through StateTap (engine/round.py `_drive`
+on_state hook): snapshots are committed only after their own chunk's
+probe passes the capacity check (two-phase under pipelining), so a
+checkpoint can never contain silently-dropped events. InterruptGuard
+turns SIGINT/SIGTERM into a final verified checkpoint + RunInterrupted
+instead of a lost run.
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import json
+import os
+import signal
+import threading
+
+import jax
+import numpy as np
+
+from shadow_tpu.engine.state import SimState, state_from_host
+from shadow_tpu.utils.shadow_log import slog
+
+CHECKPOINT_VERSION = 1
+
+
+class CheckpointError(ValueError):
+    """A checkpoint could not be used: wrong version, wrong config
+    fingerprint, or a corrupt/mismatched leaf set."""
+
+
+def config_fingerprint(config) -> str:
+    """Hash of everything that pins the simulated trajectory: the full
+    processed config minus the knobs that only affect where outputs land
+    or how the run is displayed/checkpointed. `tracker` stays IN (it
+    changes the TrackerState leaves); `stop_time` stays in (resume must
+    target the same horizon for chunk boundaries to line up)."""
+    d = config.to_dict()
+    g = d.get("general", {})
+    for k in (
+        "data_directory",
+        "progress",
+        "log_level",
+        "trace_file",
+        "heartbeat_interval_ns",
+        "checkpoint_dir",
+        "checkpoint_interval_ns",
+        "resume",
+    ):
+        g.pop(k, None)
+    e = d.get("experimental", {})
+    for k in ("recover", "recovery_max_retries", "recovery_snapshot_chunks"):
+        e.pop(k, None)
+    return hashlib.sha256(
+        json.dumps(d, sort_keys=True, default=str).encode()
+    ).hexdigest()
+
+
+def save_checkpoint(path: str, host_state: SimState, meta: dict) -> str:
+    """Write a host (state_to_host) snapshot atomically. `meta` must carry
+    at least the fingerprint; version/leaf bookkeeping is added here."""
+    leaves, _ = jax.tree.flatten(host_state)
+    paths = [
+        jax.tree_util.keystr(p)
+        for p, _l in jax.tree_util.tree_flatten_with_path(host_state)[0]
+    ]
+    full_meta = dict(meta)
+    full_meta.update(
+        version=CHECKPOINT_VERSION,
+        num_leaves=len(leaves),
+        leaf_paths=paths,
+        # recorded so resume can rebuild the template at the RIGHT widths
+        # even after rollback-and-regrow grew them past the config values
+        queue_capacity=int(host_state.queue.time.shape[1]),
+        outbox_capacity=int(host_state.outbox.valid.shape[1]),
+    )
+    arrays = {f"leaf_{i:05d}": np.asarray(l) for i, l in enumerate(leaves)}
+    arrays["__meta__"] = np.asarray(json.dumps(full_meta))
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, path)
+    return path
+
+
+def peek_checkpoint_meta(path: str) -> dict:
+    """Read only the meta record (no leaf arrays): resume uses this to
+    learn the saved buffer capacities before building the template."""
+    with np.load(path, allow_pickle=False) as z:
+        return json.loads(str(z["__meta__"][()]))
+
+
+def load_checkpoint(
+    path: str, like: SimState, fingerprint: "str | None" = None
+) -> "tuple[SimState, dict]":
+    """Load a checkpoint back into a device SimState shaped like the
+    template (a freshly built initial state for the same config).
+    Validates the format version, the config fingerprint (when given),
+    and every leaf shape/dtype via state_from_host."""
+    with np.load(path, allow_pickle=False) as z:
+        meta = json.loads(str(z["__meta__"][()]))
+        if meta.get("version") != CHECKPOINT_VERSION:
+            raise CheckpointError(
+                f"checkpoint {path} has format version {meta.get('version')}, "
+                f"this build reads version {CHECKPOINT_VERSION}"
+            )
+        if fingerprint is not None and meta.get("fingerprint") != fingerprint:
+            raise CheckpointError(
+                f"checkpoint {path} was written for a different config "
+                f"(fingerprint {str(meta.get('fingerprint'))[:12]}… != "
+                f"{fingerprint[:12]}…); resume must use the exact config "
+                "the checkpoint was saved from"
+            )
+        leaves = [z[f"leaf_{i:05d}"] for i in range(meta["num_leaves"])]
+    t_leaves, treedef = jax.tree.flatten(like)
+    if len(leaves) != len(t_leaves):
+        raise CheckpointError(
+            f"checkpoint {path} holds {len(leaves)} leaves, the template "
+            f"state has {len(t_leaves)} — state layout changed"
+        )
+    host = jax.tree.unflatten(treedef, leaves)
+    try:
+        st = state_from_host(host, like)
+    except ValueError as e:
+        raise CheckpointError(f"checkpoint {path}: {e}") from e
+    return st, meta
+
+
+class CheckpointManager:
+    """Writes checkpoints on a sim-time cadence and prunes old ones.
+    Filenames embed the zero-padded sim time (``ckpt-<now>.npz``), so the
+    lexically-last file is always the newest; `keep` bounds disk use."""
+
+    def __init__(
+        self,
+        directory: str,
+        interval_ns: int,
+        fingerprint: str,
+        keep: int = 2,
+    ):
+        self.directory = directory
+        self.interval_ns = int(interval_ns)
+        self.fingerprint = fingerprint
+        self.keep = keep
+        self.written: "list[str]" = []
+        self._next = self.interval_ns if self.interval_ns > 0 else None
+        # the live engine config (set per recovery attempt by
+        # run_until_recovering): rollback-and-regrow also widens
+        # deliver_lanes/a2a_capacity, which are cfg knobs not derivable
+        # from state shapes — resume must restore them too or the replay
+        # deterministically re-hits the same overflow
+        self.engine_cfg = None
+        os.makedirs(directory, exist_ok=True)
+
+    def due(self, probe) -> bool:
+        return self._next is not None and probe.now >= self._next
+
+    def write(self, host_state: SimState, final: bool = False) -> str:
+        now = int(host_state.now)
+        if self._next is not None:
+            self._next = (now // self.interval_ns + 1) * self.interval_ns
+        path = os.path.join(self.directory, f"ckpt-{now:020d}.npz")
+        meta = {"fingerprint": self.fingerprint, "now_ns": now, "final": final}
+        if self.engine_cfg is not None:
+            meta["deliver_lanes"] = self.engine_cfg.deliver_lanes
+            meta["a2a_capacity"] = self.engine_cfg.a2a_capacity
+        save_checkpoint(path, host_state, meta)
+        self.written.append(path)
+        slog("info", now, "checkpoint",
+             f"wrote {'final ' if final else ''}checkpoint {path}")
+        self._prune()
+        return path
+
+    def _prune(self) -> None:
+        existing = sorted(glob.glob(os.path.join(self.directory, "ckpt-*.npz")))
+        for stale in existing[: -self.keep] if self.keep > 0 else []:
+            try:
+                os.remove(stale)
+            except OSError:
+                pass
+
+    @staticmethod
+    def latest_path(directory: str) -> "str | None":
+        found = sorted(glob.glob(os.path.join(directory, "ckpt-*.npz")))
+        return found[-1] if found else None
+
+
+class InterruptGuard:
+    """SIGINT/SIGTERM → "write a final checkpoint, then stop" instead of
+    a lost run. The handler only sets a flag; the dispatch loop notices
+    it at the next probe (engine/round.py `_drive`), commits the best
+    verifiable snapshot, and raises RunInterrupted. A second signal
+    restores the previous handlers, so a double Ctrl-C still kills a
+    wedged run the ordinary way.
+
+    `test_interrupt_at_ns` (or the SHADOW_TPU_TEST_INTERRUPT_AT_NS env
+    var) arms the same code path deterministically from sim time — the
+    tier-1 CLI smoke interrupts with it instead of racing a timer."""
+
+    def __init__(self, test_interrupt_at_ns: "int | None" = None):
+        if test_interrupt_at_ns is None:
+            env = os.environ.get("SHADOW_TPU_TEST_INTERRUPT_AT_NS")
+            test_interrupt_at_ns = int(env) if env else None
+        self.test_interrupt_at_ns = test_interrupt_at_ns
+        self._flag = False
+        self._prev: dict = {}
+
+    def fired(self, now_ns: int) -> bool:
+        if self._flag:
+            return True
+        return (
+            self.test_interrupt_at_ns is not None
+            and now_ns >= self.test_interrupt_at_ns
+        )
+
+    def _handle(self, signum, frame):
+        self._flag = True
+        self._restore()  # second signal falls through to the old handler
+
+    def __enter__(self) -> "InterruptGuard":
+        if threading.current_thread() is threading.main_thread():
+            for sig in (signal.SIGINT, signal.SIGTERM):
+                self._prev[sig] = signal.signal(sig, self._handle)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._restore()
+
+    def _restore(self) -> None:
+        for sig, prev in list(self._prev.items()):
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError):
+                pass
+        self._prev.clear()
+
+
+class StateTap:
+    """The concrete on_state hook `_drive` calls: composes the checkpoint
+    cadence, the recovery retainer (runtime/recovery.py StateRetainer),
+    and the interrupt guard over ONE shared snapshot per due point — the
+    full-state device_get is paid once no matter how many consumers want
+    the state."""
+
+    def __init__(self, checkpoints=None, retainer=None, guard=None):
+        self.checkpoints = checkpoints
+        self.retainer = retainer
+        self.guard = guard
+        self._last_now = 0
+        self._ckpt_due = False
+        self._retain_due = False
+
+    def due(self, probe, chunk_idx: int) -> bool:
+        self._last_now = probe.now
+        self._ckpt_due = self.checkpoints is not None and self.checkpoints.due(probe)
+        self._retain_due = self.retainer is not None and self.retainer.due(chunk_idx)
+        return self._ckpt_due or self._retain_due
+
+    def interrupted(self) -> bool:
+        return self.guard is not None and self.guard.fired(self._last_now)
+
+    def commit(self, host_state: SimState) -> None:
+        final = self.interrupted()
+        if self.retainer is not None and (self._retain_due or final):
+            self.retainer.commit(host_state)
+        if self.checkpoints is not None and (self._ckpt_due or final):
+            self.checkpoints.write(host_state, final=final)
+        self._ckpt_due = self._retain_due = False

@@ -8,11 +8,13 @@ propagates with its traceback.
 from __future__ import annotations
 
 import json
+import sys
 
 import yaml
 
 from shadow_tpu.config import load_config_file
-from shadow_tpu.engine.round import CapacityError
+from shadow_tpu.engine.round import CapacityError, RunInterrupted
+from shadow_tpu.runtime.checkpoint import CheckpointError
 from shadow_tpu.runtime.manager import Manager
 from shadow_tpu.utils.shadow_log import set_level
 
@@ -26,6 +28,10 @@ def run_from_config(
     show_config: bool = False,
     tracker: bool = False,
     trace_file: "str | None" = None,
+    checkpoint_dir: "str | None" = None,
+    checkpoint_interval: "str | None" = None,
+    resume: bool = False,
+    no_recover: bool = False,
 ) -> int:
     try:
         config = load_config_file(path)
@@ -37,6 +43,21 @@ def run_from_config(
         config.general.tracker = True
     if trace_file:
         config.general.trace_file = trace_file
+    if checkpoint_dir:
+        config.general.checkpoint_dir = checkpoint_dir
+    if checkpoint_interval:
+        from shadow_tpu.simtime import parse_time_ns
+
+        try:
+            config.general.checkpoint_interval_ns = parse_time_ns(
+                checkpoint_interval
+            )
+        except ValueError as e:
+            raise CliUserError(f"invalid --checkpoint-interval: {e}") from e
+    if resume:
+        config.general.resume = True
+    if no_recover:
+        config.experimental.recover = False
     set_level(config.general.log_level)
     if show_config:
         print(json.dumps(config.to_dict(), indent=2, default=str))
@@ -48,6 +69,17 @@ def run_from_config(
     try:
         results = manager.run()
     except CapacityError as e:
+        raise CliUserError(str(e)) from e
+    except RunInterrupted as e:
+        # not a user error: the run stopped on request with a final
+        # checkpoint written; 130 is the conventional SIGINT exit status
+        print(f"shadow-tpu: {e}; resume with --resume", file=sys.stderr)
+        return 130
+    except CheckpointError as e:
+        # checkpoint/resume validation (fingerprint mismatch, missing
+        # checkpoint, unsupported scheduler) surfaces at run() time;
+        # anything else propagates with its traceback — a real bug must
+        # not masquerade as a config mistake
         raise CliUserError(str(e)) from e
     if results.unexpected_final_states:
         return 1

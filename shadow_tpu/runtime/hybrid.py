@@ -42,6 +42,52 @@ import time as _walltime
 import jax.numpy as jnp
 import numpy as np
 
+from shadow_tpu.utils.shadow_log import slog
+
+
+class _WorkerDied(Exception):
+    """A hybrid worker process exited/hung mid-RPC (supervision-internal:
+    callers see it only after the respawn budget is exhausted)."""
+
+    def __init__(self, worker: int, reason: str):
+        super().__init__(f"hybrid worker {worker} {reason}")
+        self.worker = worker
+        self.reason = reason
+
+
+class WorkerCrashed(RuntimeError):
+    """A hybrid worker died more times than the respawn budget allows."""
+
+
+# state-mutating worker commands, replayed verbatim into a respawned
+# worker to rebuild its deterministic kernel state up to the last round
+# boundary (read-only commands — next_time/stats/proc_info/unexpected —
+# are not replayed; "exit" is terminal). Maps command -> reply tag.
+_REPLAYED_CMDS = {
+    "run_window": "sends",
+    "apply_records": "ok",
+    "finish": "ok",
+    "shutdown": "ok",
+    "shutdown_check": "ok",
+}
+
+# byte ceiling for a worker's replay log: apply_records batches carry
+# full payload columns, so a count cap alone would not bound memory on
+# high-traffic runs
+_REPLAY_LOG_MAX_BYTES = 256 * 1024 * 1024
+
+
+def _replay_msg_cost(msg) -> int:
+    """Approximate retained bytes of one replay-log message: per-record
+    bookkeeping plus the raw payload column bytes (the dominant term for
+    apply_records batches)."""
+    if msg[0] != "apply_records":
+        return 128
+    cols = msg[1]
+    n = len(cols[0]) if cols and cols[0] else 0
+    payload = sum(len(pl) for pl in cols[5] if pl) if len(cols) > 5 else 0
+    return 128 + 64 * n + payload
+
 from shadow_tpu import equeue
 from shadow_tpu.engine import EngineConfig
 from shadow_tpu.engine.round import (
@@ -338,6 +384,9 @@ class ParallelHybridScheduler:
         vdso_latency_ns: int = 10,
         max_unapplied_ns: int = 1_000_000,
         cpu_freq_hz=None,
+        rpc_timeout_s: float = 600.0,
+        max_worker_respawns: int = 1,
+        replay_log_max: int = 50_000,
     ):
         import multiprocessing as mp
         import pathlib
@@ -426,44 +475,67 @@ class ParallelHybridScheduler:
 
         lat = np.asarray(tables.lat_ns)
         rel = np.asarray(tables.rel)
-        ctx = mp.get_context("spawn")
-        self._workers = []
+        self._ctx = mp.get_context("spawn")
+        self._worker_main = worker_main
+        self.rpc_timeout_s = rpc_timeout_s
+        self.max_worker_respawns = max_worker_respawns
+        # supervision state: the retained init dict + the per-worker log
+        # of state-mutating commands are everything a respawn needs to
+        # rebuild a dead worker's deterministic kernel state by replay.
+        # The log holds full record batches, so it grows with simulated
+        # traffic: replay_log_max bounds manager memory — past it the log
+        # is dropped and a later worker death becomes fatal (a run that
+        # long should be supervised at a coarser grain)
+        self.replay_log_max = replay_log_max
+        self._init_of: "list[dict]" = []
+        self._cmd_log: "list[list]" = [[] for _ in range(k)]
+        self._log_bytes = [0] * k
+        self._log_dropped = [False] * k
+        self._respawns = [0] * k
+        self._workers: "list[tuple]" = [None] * k
         for w in range(k):
-            init = dict(
-                worker_index=w,
-                lat=lat,
-                rel=rel,
-                host_names=list(host_names),
-                host_nodes=list(host_nodes),
-                seed=seed,
-                data_dir=str(data_dir),
-                window_ns=self.W,
-                bw_up_bits=list(bw_up_bits) if bw_up_bits else None,
-                bw_down_bits=list(bw_down_bits) if bw_down_bits else None,
-                host_ips=list(host_ips) if host_ips else None,
-                strace_mode=strace_mode,
-                pcap=pcap,
-                heartbeat_ns=heartbeat_ns,
-                bootstrap_end_ns=bootstrap_end_ns,
-                tcp_sack=tcp_sack,
-                tcp_autotune=tcp_autotune,
-                qdisc=qdisc,
-                syscall_latency_ns=syscall_latency_ns,
-                vdso_latency_ns=vdso_latency_ns,
-                max_unapplied_ns=max_unapplied_ns,
-                cpu_freq_hz=list(cpu_freq_hz) if cpu_freq_hz else None,
-                owned=[i for i in range(h) if self.worker_of[i] == w],
-                specs=specs_of[w],
+            self._init_of.append(
+                dict(
+                    worker_index=w,
+                    lat=lat,
+                    rel=rel,
+                    host_names=list(host_names),
+                    host_nodes=list(host_nodes),
+                    seed=seed,
+                    data_dir=str(data_dir),
+                    window_ns=self.W,
+                    bw_up_bits=list(bw_up_bits) if bw_up_bits else None,
+                    bw_down_bits=list(bw_down_bits) if bw_down_bits else None,
+                    host_ips=list(host_ips) if host_ips else None,
+                    strace_mode=strace_mode,
+                    pcap=pcap,
+                    heartbeat_ns=heartbeat_ns,
+                    bootstrap_end_ns=bootstrap_end_ns,
+                    tcp_sack=tcp_sack,
+                    tcp_autotune=tcp_autotune,
+                    qdisc=qdisc,
+                    syscall_latency_ns=syscall_latency_ns,
+                    vdso_latency_ns=vdso_latency_ns,
+                    max_unapplied_ns=max_unapplied_ns,
+                    cpu_freq_hz=list(cpu_freq_hz) if cpu_freq_hz else None,
+                    owned=[i for i in range(h) if self.worker_of[i] == w],
+                    specs=specs_of[w],
+                )
             )
-            parent_conn, child_conn = ctx.Pipe()
-            proc = ctx.Process(target=worker_main, args=(child_conn, init), daemon=True)
-            proc.start()
-            child_conn.close()
-            self._workers.append((proc, parent_conn))
-        for _proc, conn in self._workers:
-            self._expect(conn.recv(), "ready")
+            self._spawn(w)
+        try:
+            for w in range(k):
+                self._expect(self._recv(w), "ready")
+        except _WorkerDied as d:
+            # a worker that cannot even START is a deterministic failure:
+            # no respawn — reap the whole fleet and fail cleanly instead
+            # of leaking the internal marker with k-1 daemons left behind
+            self.close()
+            raise WorkerCrashed(
+                f"hybrid worker {d.worker} failed to start ({d.reason})"
+            ) from d
 
-    # --- worker plumbing --------------------------------------------------
+    # --- worker plumbing / supervision ------------------------------------
 
     @staticmethod
     def _expect(reply, tag):
@@ -473,10 +545,141 @@ class ParallelHybridScheduler:
             raise RuntimeError(f"unexpected worker reply {reply[0]!r} (wanted {tag!r})")
         return reply[1:]
 
+    def _spawn(self, w: int) -> None:
+        parent_conn, child_conn = self._ctx.Pipe()
+        proc = self._ctx.Process(
+            target=self._worker_main, args=(child_conn, self._init_of[w]), daemon=True
+        )
+        proc.start()
+        child_conn.close()
+        self._workers[w] = (proc, parent_conn)
+
+    def _recv(self, w: int, timeout: "float | None" = None):
+        """Bounded conn.recv: polls in short steps so a worker that died
+        (or hung) mid-RPC raises _WorkerDied instead of blocking the
+        manager forever. A hung worker is killed before raising, so the
+        process is always reaped."""
+        proc, conn = self._workers[w]
+        deadline = _walltime.monotonic() + (
+            timeout if timeout is not None else self.rpc_timeout_s
+        )
+        while True:
+            try:
+                if conn.poll(0.2):
+                    return conn.recv()
+            except (EOFError, OSError):
+                raise _WorkerDied(w, "closed its pipe mid-RPC")
+            if not proc.is_alive():
+                # the worker may have replied and then exited: one last look
+                try:
+                    if conn.poll(0.05):
+                        return conn.recv()
+                except (EOFError, OSError):
+                    pass
+                raise _WorkerDied(w, f"exited with code {proc.exitcode}")
+            if _walltime.monotonic() > deadline:
+                proc.kill()
+                proc.join(5)
+                raise _WorkerDied(w, f"hung past the {self.rpc_timeout_s}s RPC timeout")
+
+    def _send(self, w: int, msg) -> None:
+        try:
+            self._workers[w][1].send(msg)
+        except (BrokenPipeError, OSError):
+            raise _WorkerDied(w, "died before the command could be sent")
+
+    def _revive(self, w: int, reason: str) -> None:
+        """Respawn a dead worker and replay its command log — guests
+        re-execute deterministically (the run-twice determinism contract),
+        so after replay the fresh worker's kernel state is bit-identical
+        to the dead one's at the last completed round boundary. Bounded by
+        max_worker_respawns per worker."""
+        self._respawns[w] += 1
+        if self._respawns[w] > self.max_worker_respawns:
+            raise WorkerCrashed(
+                f"hybrid worker {w} died {self._respawns[w]} times "
+                f"(last: {reason}); respawn budget "
+                f"({self.max_worker_respawns}) exhausted"
+            )
+        if self._log_dropped[w]:
+            raise WorkerCrashed(
+                f"hybrid worker {w} {reason}, but its replay log exceeded "
+                f"replay_log_max={self.replay_log_max} and was dropped — "
+                "cannot rebuild its state deterministically"
+            )
+        slog("warning", 0, "hybrid",
+             f"worker {w} {reason}; respawning and replaying "
+             f"{len(self._cmd_log[w])} commands to the last round boundary "
+             f"(respawn {self._respawns[w]}/{self.max_worker_respawns})")
+        proc, conn = self._workers[w]
+        try:
+            conn.close()
+        except OSError:
+            pass
+        if proc.is_alive():
+            proc.kill()
+        proc.join(5)
+        self._spawn(w)
+        try:
+            self._expect(self._recv(w), "ready")
+            for m in self._cmd_log[w]:
+                self._send(w, m)
+                # replies are discarded: the parent already consumed the
+                # originals (sends/records) the first time around
+                self._expect(self._recv(w), _REPLAYED_CMDS[m[0]])
+        except _WorkerDied as d:
+            # dying AGAIN during its own recovery is deterministic, not
+            # transient: escalate instead of leaking the internal marker
+            raise WorkerCrashed(
+                f"hybrid worker {w} died again during respawn replay "
+                f"({d.reason})"
+            ) from d
+
+    def _command(self, msgs: list, tag: str):
+        """Send one message per worker (pipelined: all sends, then all
+        recvs) with dead/hung-worker recovery: a worker that dies mid-RPC
+        is respawned, replayed to the last round boundary, and re-issued
+        the in-flight command — the round proceeds as if nothing died.
+        Completed state-mutating commands are appended to each worker's
+        replay log."""
+        def _retry(w, m, fn, died):
+            self._revive(w, died.reason)
+            try:
+                self._send(w, m)  # the dead worker never completed it
+                return fn()
+            except _WorkerDied as d2:
+                raise WorkerCrashed(
+                    f"hybrid worker {w} died again right after respawn "
+                    f"({d2.reason})"
+                ) from d2
+
+        replies = []
+        for w, m in enumerate(msgs):
+            try:
+                self._send(w, m)
+            except _WorkerDied as d:
+                _retry(w, m, lambda: None, d)
+        for w, m in enumerate(msgs):
+            try:
+                replies.append(self._expect(self._recv(w), tag))
+            except _WorkerDied as d:
+                replies.append(
+                    _retry(w, m, lambda w=w: self._expect(self._recv(w), tag), d)
+                )
+            if m[0] in _REPLAYED_CMDS and not self._log_dropped[w]:
+                self._cmd_log[w].append(m)
+                self._log_bytes[w] += _replay_msg_cost(m)
+                if (
+                    len(self._cmd_log[w]) > self.replay_log_max
+                    or self._log_bytes[w] > _REPLAY_LOG_MAX_BYTES
+                ):
+                    self._cmd_log[w] = []
+                    self._log_bytes[w] = 0
+                    self._log_dropped[w] = True
+        return replies
+
     def _broadcast(self, msg, tag):
-        for _p, conn in self._workers:
-            conn.send(msg)
-        return [self._expect(conn.recv(), tag) for _p, conn in self._workers]
+        return self._command([msg] * len(self._workers), tag)
 
     def _grid_end(self, t: int) -> int:
         return (t // self.W + 1) * self.W
@@ -540,10 +743,9 @@ class ParallelHybridScheduler:
             else:
                 _append(w_src, "src", flag, rec_t, src, seq, None)
                 _append(w_dst, "dst", flag, rec_t, src, seq, payload)
-        for (_p, conn), cols in zip(self._workers, batches):
-            conn.send(("apply_records", cols, self._horizon))
-        for (_p, conn), _b in zip(self._workers, batches):
-            self._expect(conn.recv(), "ok")
+        self._command(
+            [("apply_records", cols, self._horizon) for cols in batches], "ok"
+        )
         self.inflight -= len(t)
         self._phase("drain_records", t0)
 
@@ -663,13 +865,30 @@ class ParallelHybridScheduler:
         self._broadcast(("shutdown",), "ok")
 
     def close(self) -> None:
+        """Teardown that cannot hang: every recv is bounded by a poll
+        timeout and every worker process is reaped — a worker that died
+        mid-RPC (or wedged) is killed and joined instead of blocking the
+        manager on a pipe that will never deliver."""
         for _p, conn in self._workers:
             try:
                 conn.send(("exit",))
-                conn.recv()
-            except Exception:
+            except (BrokenPipeError, OSError):
+                pass  # already dead: reaped below
+        for _p, conn in self._workers:
+            try:
+                if conn.poll(5):
+                    conn.recv()
+            except (EOFError, OSError):
+                pass
+            try:
+                conn.close()
+            except OSError:
                 pass
         for p, _conn in self._workers:
-            p.join(timeout=10)
+            p.join(timeout=5)
             if p.is_alive():
                 p.terminate()
+                p.join(timeout=2)
+            if p.is_alive():
+                p.kill()
+                p.join(timeout=2)

@@ -32,14 +32,24 @@ anything JAX-adjacent (threefry draws run on CPU XLA).
 from __future__ import annotations
 
 import os
+import signal
 import traceback
 
 
 def worker_main(conn, init: dict) -> None:
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    # Ctrl-C goes to the whole foreground process group: the PARENT owns
+    # orderly teardown (final checkpoint, worker reaping) — a worker that
+    # dies first would look like a crash and trigger a pointless respawn.
+    try:
+        signal.signal(signal.SIGINT, signal.SIG_IGN)
+    except (ValueError, OSError):  # non-main thread / exotic platform
+        pass
     try:
         _serve(conn, init)
+    except EOFError:
+        return  # parent went away: exit quietly, nothing to report to
     except BaseException:
         try:
             conn.send(("error", traceback.format_exc()))

@@ -19,8 +19,11 @@ import time
 
 import numpy as np
 
+import contextlib
+
 from shadow_tpu.config import ConfigOptions
 from shadow_tpu.engine import EngineConfig
+from shadow_tpu.engine.round import RunInterrupted
 from shadow_tpu.graph import IpAssignment, NetworkGraph, compute_routing
 from shadow_tpu.graph.network_graph import ONE_GBIT_SWITCH_GML
 from shadow_tpu.models.registry import build_model
@@ -227,6 +230,7 @@ class Manager:
             use_dynamic_runahead=cfgo.experimental.use_dynamic_runahead,
             tracker=cfgo.general.tracker,
         )
+        ecfg, ckpt, guard, resume_path = self._setup_checkpointing(ecfg)
 
         sched = make_scheduler(
             cfgo.experimental.scheduler,
@@ -282,7 +286,43 @@ class Manager:
         slog("info", 0, "manager", f"starting: {num_hosts} hosts, scheduler={sched.name}, "
              f"runahead={runahead}ns, stop={fmt_time_ns(end)}")
         t0 = time.perf_counter()
-        final = sched.run(end, on_chunk=on_chunk, tracker=tracker)
+        if isinstance(sched, CpuRefScheduler):
+            final = sched.run(end, on_chunk=on_chunk, tracker=tracker)
+        else:
+            resume_state = None
+            if resume_path is not None:
+                from shadow_tpu.runtime.checkpoint import load_checkpoint
+
+                resume_state, meta = load_checkpoint(
+                    resume_path, sched.initial_state(), ckpt.fingerprint
+                )
+                slog("info", meta["now_ns"], "manager",
+                     f"resuming from checkpoint {resume_path} "
+                     f"(sim time {fmt_time_ns(meta['now_ns'])})")
+            recovery = None
+            if cfgo.experimental.recover:
+                from shadow_tpu.runtime.recovery import RecoveryPolicy
+
+                recovery = RecoveryPolicy(
+                    max_recoveries=cfgo.experimental.recovery_max_retries,
+                    snapshot_interval_chunks=(
+                        cfgo.experimental.recovery_snapshot_chunks
+                    ),
+                )
+            try:
+                with guard if guard is not None else contextlib.nullcontext():
+                    final = sched.run(
+                        end, on_chunk=on_chunk, tracker=tracker,
+                        start_state=resume_state, checkpoints=ckpt,
+                        guard=guard, recovery=recovery,
+                    )
+            except RunInterrupted:
+                progress.clear()
+                slog("info", 0, "manager",
+                     f"interrupted; checkpoints are in "
+                     f"{cfgo.general.checkpoint_dir} — rerun with --resume "
+                     "to continue to a bit-identical final state")
+                raise
         wall = time.perf_counter() - t0
         progress.finish(end)
 
@@ -308,6 +348,14 @@ class Manager:
                 sim_seconds=end / NS_PER_SEC,
                 scheduler=sched.name,
             )
+        report = getattr(sched, "recovery_report", [])
+        if report:
+            # rollback-and-regrow happened: surface it in sim-stats.json
+            # (the tracker registry carries the same records when attached)
+            results.extra_stats["recovery"] = {
+                "count": len(report),
+                "events": report,
+            }
         self._fold_tracker(
             tracker, results, end,
             final_state=None if isinstance(sched, CpuRefScheduler) else final,
@@ -335,6 +383,64 @@ class Manager:
         trace_path = tracker.write_trace()
         if trace_path:
             slog("info", end, "manager", f"wrote dispatch trace: {trace_path}")
+
+    def _setup_checkpointing(self, ecfg: EngineConfig):
+        """Build the checkpoint manager + interrupt guard when
+        general.checkpoint_dir asks for them, and resolve a --resume to
+        the newest checkpoint. Resume validates the config fingerprint
+        (the trajectory-pinning config hash) and rebuilds the engine
+        config at the checkpoint's recorded buffer capacities, which may
+        exceed the config values when the interrupted run had already
+        regrown them. Returns (ecfg, ckpt_manager, guard, resume_path)."""
+        from shadow_tpu.runtime.checkpoint import (
+            CheckpointError,
+            CheckpointManager,
+            InterruptGuard,
+            config_fingerprint,
+            peek_checkpoint_meta,
+        )
+
+        g = self.config.general
+        if not g.checkpoint_dir:
+            if g.resume:
+                raise CheckpointError(
+                    "--resume requires --checkpoint-dir (general.checkpoint_dir)"
+                )
+            return ecfg, None, None, None
+        if self.config.experimental.scheduler != "tpu" or self.managed_mode:
+            raise CheckpointError(
+                "checkpointing supports scripted-model runs on the tpu "
+                "scheduler; managed/hybrid runs get worker supervision "
+                "instead (docs/robustness.md)"
+            )
+        fingerprint = config_fingerprint(self.config)
+        resume_path = None
+        if g.resume:
+            resume_path = CheckpointManager.latest_path(g.checkpoint_dir)
+            if resume_path is None:
+                raise CheckpointError(
+                    f"--resume: no checkpoint found in {g.checkpoint_dir}"
+                )
+            meta = peek_checkpoint_meta(resume_path)
+            # rebuild at the checkpoint's recorded widths: an interrupted
+            # run may have regrown them past the config values, and the
+            # exchange/grid knobs grown alongside must follow or the
+            # resumed replay re-hits the very overflow that was recovered
+            overrides = {}
+            qc, oc = meta.get("queue_capacity"), meta.get("outbox_capacity")
+            if qc and oc:
+                overrides.update(queue_capacity=qc, outbox_capacity=oc)
+            for knob in ("deliver_lanes", "a2a_capacity"):
+                if knob in meta:
+                    overrides[knob] = meta[knob]
+            if any(
+                overrides.get(k) != getattr(ecfg, k) for k in overrides
+            ):
+                ecfg = dataclasses.replace(ecfg, **overrides)
+        ckpt = CheckpointManager(
+            g.checkpoint_dir, g.checkpoint_interval_ns, fingerprint
+        )
+        return ecfg, ckpt, InterruptGuard(), resume_path
 
     def _build_tracker(self, progress=None):
         """The host-side tracker registry (utils/tracker.py), or None
@@ -367,6 +473,15 @@ class Manager:
         from shadow_tpu.hostk.kernel import NetKernel, ProcessSpec
 
         cfgo = self.config
+        if cfgo.general.checkpoint_dir or cfgo.general.resume:
+            from shadow_tpu.runtime.checkpoint import CheckpointError
+
+            raise CheckpointError(
+                "checkpoint/resume supports scripted-model runs only; "
+                "managed guests are live OS processes and cannot be "
+                "serialized — hybrid runs get worker supervision instead "
+                "(docs/robustness.md)"
+            )
         host_node = [h.node_index for h in self.hosts]
         tables = compute_routing(self.graph, use_shortest_path=cfgo.network.use_shortest_path)
         tables = tables.with_hosts(host_node)

@@ -1,0 +1,209 @@
+"""Rollback-and-regrow capacity recovery (docs/robustness.md).
+
+The engine's fixed-slot buffers (event queue, outbox, exchange buckets)
+fail loudly on overflow: the per-chunk probe carries the overflow split,
+so a CapacityError surfaces at the chunk where the first event was
+dropped (engine/round.py). Until now that was fatal. Here it becomes a
+recoverable fault:
+
+  1. roll back to the newest VERIFIED clean state — the retained host
+     snapshot a StateRetainer committed at a chunk boundary whose probe
+     passed the capacity check, or the caller's never-donated entry state
+     when no snapshot exists yet;
+  2. regrow the saturated buffer along an escalation ladder (x`growth`
+     per recovery, targeting the counter the CapacityError names —
+     queue vs outbox — with a bounded retry budget);
+  3. recompile (capacities are static XLA shapes) and replay from the
+     rollback point.
+
+Replay is deterministic: growing a buffer is trajectory-neutral for a
+state that never overflowed (engine/state.py grow_state), so the
+recovered run is leaf-exact to a run that started with the larger
+capacity — the determinism contract survives the fault
+(tests/test_robustness.py pins this).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from shadow_tpu.engine.round import CapacityError, run_until
+from shadow_tpu.engine.state import grow_state, state_from_host, state_to_host
+from shadow_tpu.runtime.checkpoint import StateTap
+from shadow_tpu.utils.shadow_log import slog
+
+
+@dataclasses.dataclass
+class RecoveryPolicy:
+    """The escalation ladder's budget. max_recoveries=0 restores the old
+    fail-fast behavior (`--no-recover`)."""
+
+    max_recoveries: int = 4
+    growth: int = 2
+    snapshot_interval_chunks: int = 32
+
+
+class StateRetainer:
+    """Keeps the newest verified host snapshot as the rollback point.
+    Snapshots arrive through StateTap.commit, i.e. only after their own
+    chunk's probe passed the capacity check — a retained state can never
+    contain a silent drop. Holding it on the host (numpy) keeps it valid
+    across buffer donation."""
+
+    def __init__(self, every_chunks: int):
+        self.every = max(1, int(every_chunks))
+        self.host_state = None
+        self._last_chunk = 0
+
+    def due(self, chunk_idx: int) -> bool:
+        return chunk_idx - self._last_chunk >= self.every
+
+    def commit(self, host_state) -> None:
+        self.host_state = host_state
+        self._last_chunk += self.every
+
+    def seed(self, host_state) -> None:
+        """Install a rollback point directly (the regrown replay start)."""
+        self.host_state = host_state
+        self._last_chunk = 0
+
+
+def grown_cfg(cfg, err: CapacityError, growth: int):
+    """The next rung of the escalation ladder: double (x`growth`) the
+    capacity of the buffer the CapacityError names. Queue growth also
+    widens an explicit deliver_lanes grid (the round-boundary delivery
+    grid is a queue-side resource — its overflow counts into
+    queue.overflow). When the error carries no split (older callers),
+    grow both."""
+    q_ov = getattr(err, "queue_overflow", 0)
+    o_ov = getattr(err, "outbox_overflow", 0)
+    if not q_ov and not o_ov:
+        q_ov = o_ov = 1
+    changes = {}
+    if q_ov:
+        changes["queue_capacity"] = cfg.queue_capacity * growth
+        if cfg.deliver_lanes > 0:
+            changes["deliver_lanes"] = cfg.deliver_lanes * growth
+    if o_ov:
+        changes["outbox_capacity"] = cfg.outbox_capacity * growth
+        if cfg.a2a_capacity > 0:
+            # sharded all_to_all bucket overflow counts into the outbox
+            # lane; an explicit bucket size must grow too or the replay
+            # would deterministically hit the identical bucket overflow
+            changes["a2a_capacity"] = cfg.a2a_capacity * growth
+    return dataclasses.replace(cfg, **changes)
+
+
+def run_until_recovering(
+    st,
+    end_time: int,
+    model=None,
+    tables=None,
+    cfg=None,
+    *,
+    rounds_per_chunk: int = 64,
+    max_chunks: int = 10_000,
+    on_chunk=None,
+    pipeline: bool = True,
+    tracker=None,
+    policy: "RecoveryPolicy | None" = None,
+    checkpoints=None,
+    guard=None,
+    runner_factory=None,
+    on_recovery=None,
+):
+    """run_until with the recovery loop wrapped around it. Returns
+    (final_state, recoveries) where recoveries is the list of recovery
+    records ([] for a clean run). `runner_factory(cfg) -> run(st,
+    on_state=...) -> SimState` overrides the driver (the sharded
+    scheduler passes a ShardedRunner builder); the default is the
+    single-device run_until. `checkpoints`/`guard` ride the same StateTap
+    (one shared snapshot per due point). `on_recovery(record)` fires per
+    recovery (bench progress lines)."""
+    policy = policy or RecoveryPolicy()
+
+    if runner_factory is None:
+
+        def runner_factory(run_cfg):
+            def run(run_st, on_state=None):
+                return run_until(
+                    run_st,
+                    end_time,
+                    model,
+                    tables,
+                    run_cfg,
+                    rounds_per_chunk=rounds_per_chunk,
+                    max_chunks=max_chunks,
+                    on_chunk=on_chunk,
+                    pipeline=pipeline,
+                    tracker=tracker,
+                    on_state=on_state,
+                )
+
+            return run
+
+    # The retainer is armed LAZILY, after the first CapacityError: the
+    # zero-fault path (every healthy run) pays no per-N-chunk full-state
+    # fetch and holds no host copy — its rollback point is the caller's
+    # never-donated entry state, which already exists for free. Replay
+    # attempts DO retain snapshots, so repeated rungs never replay the
+    # whole run again.
+    retainer = None
+    cur_st, cur_cfg = st, cfg
+    recoveries: "list[dict]" = []
+    while True:
+        tap = None
+        if retainer is not None or checkpoints is not None or guard is not None:
+            tap = StateTap(checkpoints=checkpoints, retainer=retainer, guard=guard)
+        if checkpoints is not None:
+            # checkpoints written during this attempt must record the
+            # attempt's (possibly regrown) cfg knobs for resume
+            checkpoints.engine_cfg = cur_cfg
+        try:
+            final = runner_factory(cur_cfg)(cur_st, on_state=tap)
+            return final, recoveries
+        except CapacityError as err:
+            if len(recoveries) >= policy.max_recoveries:
+                raise
+            new_cfg = grown_cfg(cur_cfg, err, policy.growth)
+            if retainer is not None and retainer.host_state is not None:
+                base = state_from_host(retainer.host_state, cur_st)
+                from_ns = int(base.now)
+            else:
+                base = cur_st  # the caller's never-donated entry state
+                from_ns = int(base.now)
+            grown = grow_state(
+                base,
+                queue_capacity=new_cfg.queue_capacity,
+                outbox_capacity=new_cfg.outbox_capacity,
+            )
+            record = {
+                "queue_overflow": getattr(err, "queue_overflow", 0),
+                "outbox_overflow": getattr(err, "outbox_overflow", 0),
+                "queue_capacity": new_cfg.queue_capacity,
+                "outbox_capacity": new_cfg.outbox_capacity,
+                "replay_from_ns": from_ns,
+            }
+            recoveries.append(record)
+            slog(
+                "warning",
+                from_ns,
+                "recovery",
+                f"capacity exhausted (queue_ov={record['queue_overflow']}, "
+                f"outbox_ov={record['outbox_overflow']}); rolling back to "
+                f"sim time {from_ns} ns and regrowing to "
+                f"queue_capacity={new_cfg.queue_capacity}, "
+                f"outbox_capacity={new_cfg.outbox_capacity} "
+                f"(recovery {len(recoveries)}/{policy.max_recoveries})",
+            )
+            if tracker is not None and hasattr(tracker, "record_recovery"):
+                tracker.record_recovery(record)
+            if on_recovery is not None:
+                on_recovery(record)
+            cur_st, cur_cfg = grown, new_cfg
+            if retainer is None:
+                retainer = StateRetainer(policy.snapshot_interval_chunks)
+            # the replay may overflow again before reaching a fresh
+            # snapshot: seed the rollback point with the regrown start so
+            # the next rung never replays stale shapes (or the whole run)
+            retainer.seed(state_to_host(grown))

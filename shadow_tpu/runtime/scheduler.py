@@ -51,34 +51,92 @@ class TpuScheduler:
         else:
             self._runner = None
 
-    def run(self, end_time_ns: int, on_chunk=None, max_chunks: int = 100_000,
-            tracker=None):
-        st = bootstrap(
+    def initial_state(self, cfg: "EngineConfig | None" = None):
+        """The bootstrapped t=0 state — also the template resume loads a
+        checkpoint into (same config → same shapes/dtypes)."""
+        cfg = cfg or self.cfg
+        return bootstrap(
             init_state(
-                self.cfg,
+                cfg,
                 self.model.init(),
                 tx_bytes_per_interval=self.tx_bytes_per_interval,
                 rx_bytes_per_interval=self.rx_bytes_per_interval,
             ),
             self.model,
-            self.cfg,
+            cfg,
         )
-        if self._runner is not None:
-            return self._runner.run_until(
-                st, end_time_ns, max_chunks=max_chunks, on_chunk=on_chunk,
-                tracker=tracker,
-            )
-        return run_until(
+
+    def _runner_factory(self, end_time_ns: int, on_chunk, max_chunks, tracker):
+        """run(st, on_state=...) builders per engine config — the seam
+        rollback-and-regrow recompiles through (a regrown capacity is a
+        new static shape). The original config reuses the already-built
+        sharded runner; grown configs get a fresh one."""
+
+        def factory(cfg):
+            if self.num_devices > 1:
+                runner = (
+                    self._runner
+                    if cfg == self.cfg
+                    else ShardedRunner(
+                        self._runner.mesh, self.model, self.tables, cfg,
+                        self.rounds_per_chunk,
+                    )
+                )
+
+                def run(st, on_state=None):
+                    return runner.run_until(
+                        st, end_time_ns, max_chunks=max_chunks,
+                        on_chunk=on_chunk, tracker=tracker, on_state=on_state,
+                    )
+
+            else:
+
+                def run(st, on_state=None):
+                    return run_until(
+                        st, end_time_ns, self.model, self.tables, cfg,
+                        rounds_per_chunk=self.rounds_per_chunk,
+                        max_chunks=max_chunks, on_chunk=on_chunk,
+                        tracker=tracker, on_state=on_state,
+                    )
+
+            return run
+
+        return factory
+
+    def run(self, end_time_ns: int, on_chunk=None, max_chunks: int = 100_000,
+            tracker=None, start_state=None, checkpoints=None, guard=None,
+            recovery=None):
+        """Run to end_time_ns. `start_state` (a restored checkpoint)
+        replaces the bootstrapped t=0 state; `checkpoints` /`guard` tap
+        chunk-boundary states (runtime/checkpoint.py); `recovery` (a
+        RecoveryPolicy, None = fail-fast) turns CapacityError into
+        rollback-and-regrow. The recovery report of the last run is left
+        on self.recovery_report."""
+        from shadow_tpu.runtime.recovery import run_until_recovering
+
+        st = start_state if start_state is not None else self.initial_state()
+        self.recovery_report = []
+        if recovery is None and checkpoints is None and guard is None:
+            # the plain path: no taps, no recovery wrapper
+            return self._runner_factory(
+                end_time_ns, on_chunk, max_chunks, tracker
+            )(self.cfg)(st)
+        from shadow_tpu.runtime.recovery import RecoveryPolicy
+
+        final, report = run_until_recovering(
             st,
             end_time_ns,
-            self.model,
-            self.tables,
-            self.cfg,
-            rounds_per_chunk=self.rounds_per_chunk,
-            max_chunks=max_chunks,
-            on_chunk=on_chunk,
+            cfg=self.cfg,
             tracker=tracker,
+            policy=recovery or RecoveryPolicy(max_recoveries=0),
+            checkpoints=checkpoints,
+            guard=guard,
+            runner_factory=self._runner_factory(
+                end_time_ns, on_chunk, max_chunks, tracker
+            ),
         )
+        self.recovery_report = report
+        return final
 
 
 class CpuRefScheduler:

@@ -93,6 +93,9 @@ class Tracker:
         self._next_hb = heartbeat_ns if heartbeat_ns > 0 else None
         self.last_probe = None  # latest ChunkProbe seen (aggregates)
         self._final_hosts: "dict | None" = None  # last bulk host_stats
+        # rollback-and-regrow recovery records (runtime/recovery.py):
+        # folded into stats_dict and marked in the trace as instants
+        self.recoveries: "list[dict]" = []
 
     # --- spans -----------------------------------------------------------
 
@@ -223,6 +226,13 @@ class Tracker:
     def record_probe(self, probe) -> None:
         self.last_probe = probe
 
+    def record_recovery(self, record: dict) -> None:
+        """One rollback-and-regrow recovery happened (runtime/recovery.py):
+        keep the record for the stats fold and drop an instant marker into
+        the dispatch trace at the wall time it occurred."""
+        self.recoveries.append(dict(record))
+        self.instant("capacity_recovery", **record)
+
     # --- folding ---------------------------------------------------------
 
     def finalize(self, host_stats: "dict | None" = None, probe=None) -> None:
@@ -267,6 +277,8 @@ class Tracker:
         tracker.c keeps per host). Span-only trackers report only the
         phase breakdown."""
         out: dict = {"phases": self.phase_stats()}
+        if self.recoveries:
+            out["recoveries"] = list(self.recoveries)
         if not self.counters:
             return out
         hs = self._final_hosts

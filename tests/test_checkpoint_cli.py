@@ -1,0 +1,118 @@
+"""Tier-1 CLI smoke for the fault-tolerant run loop: a scripted run with
+--checkpoint-interval is interrupted (the deterministic test-interrupt
+knob arms the real SIGINT code path), then --resume runs it to completion
+and the published sim-stats.json is identical to an uninterrupted run's
+(modulo wall-clock fields)."""
+
+import json
+import pathlib
+
+import pytest
+
+from shadow_tpu.runtime.cli_run import CliUserError, run_from_config
+
+CONFIG = """
+general:
+  stop_time: 200 ms
+  seed: {seed}
+  data_directory: {data_dir}
+  heartbeat_interval: null
+  tracker: true
+network:
+  graph:
+    type: 1_gbit_switch
+experimental:
+  rounds_per_chunk: 4
+hosts:
+  peer:
+    network_node_id: 0
+    quantity: 12
+    processes:
+      - path: phold
+        args:
+          min_delay: "2 ms"
+          max_delay: "12 ms"
+"""
+
+
+def _write(tmp_path, name, seed=1) -> pathlib.Path:
+    d = tmp_path / name
+    d.mkdir()
+    cfg = d / "shadow.yaml"
+    cfg.write_text(CONFIG.format(data_dir=d / "data", seed=seed))
+    return cfg
+
+
+def _stats(cfg_path: pathlib.Path) -> dict:
+    stats = json.loads(
+        (cfg_path.parent / "data" / "sim-stats.json").read_text()
+    )
+    stats.pop("wall_seconds")
+    if "tracker" in stats:
+        stats["tracker"].pop("phases", None)  # wall-time percentiles
+    return stats
+
+
+def test_cli_checkpoint_interrupt_resume_identical_stats(tmp_path, monkeypatch):
+    # uninterrupted reference run
+    ref_cfg = _write(tmp_path, "ref")
+    assert run_from_config(str(ref_cfg)) == 0
+    ref = _stats(ref_cfg)
+    assert ref["events_handled"] > 0
+
+    # interrupted run: the test knob arms the SIGINT/SIGTERM path at a
+    # fixed sim time, so the interrupt (and its final checkpoint) is
+    # deterministic instead of racing a timer
+    run_cfg = _write(tmp_path, "run")
+    ckpt_dir = str(tmp_path / "ckpts")
+    monkeypatch.setenv("SHADOW_TPU_TEST_INTERRUPT_AT_NS", str(100_000_000))
+    rc = run_from_config(
+        str(run_cfg),
+        checkpoint_dir=ckpt_dir,
+        checkpoint_interval="40 ms",
+    )
+    assert rc == 130  # the conventional SIGINT exit status
+    ckpts = sorted(pathlib.Path(ckpt_dir).glob("ckpt-*.npz"))
+    assert ckpts, "interrupt must leave a checkpoint behind"
+    assert not (run_cfg.parent / "data" / "sim-stats.json").exists()
+
+    # resume to completion: published stats identical to the reference
+    monkeypatch.delenv("SHADOW_TPU_TEST_INTERRUPT_AT_NS")
+    rc = run_from_config(str(run_cfg), checkpoint_dir=ckpt_dir, resume=True)
+    assert rc == 0
+    assert _stats(run_cfg) == ref
+
+    # resume with a different trajectory-pinning config must refuse
+    bad_cfg = _write(tmp_path, "bad", seed=2)
+    with pytest.raises(CliUserError, match="different config"):
+        run_from_config(str(bad_cfg), checkpoint_dir=ckpt_dir, resume=True)
+
+
+def test_cli_resume_requires_checkpoint_dir(tmp_path):
+    cfg = _write(tmp_path, "nodir")
+    with pytest.raises(CliUserError, match="checkpoint"):
+        run_from_config(str(cfg), resume=True)
+
+
+def test_cli_resume_empty_dir(tmp_path):
+    cfg = _write(tmp_path, "empty")
+    with pytest.raises(CliUserError, match="no checkpoint found"):
+        run_from_config(
+            str(cfg), checkpoint_dir=str(tmp_path / "none"), resume=True
+        )
+
+
+def test_cli_checkpoint_rejected_for_managed(tmp_path):
+    cfg = tmp_path / "managed.yaml"
+    cfg.write_text(
+        """
+general: {{ stop_time: 1 sec, data_directory: {d} }}
+hosts:
+  h:
+    network_node_id: 0
+    processes:
+      - path: /bin/true
+""".format(d=tmp_path / "data")
+    )
+    with pytest.raises(CliUserError, match="scripted-model runs only"):
+        run_from_config(str(cfg), checkpoint_dir=str(tmp_path / "ck"))

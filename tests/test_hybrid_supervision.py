@@ -1,0 +1,147 @@
+"""Hybrid worker supervision (docs/robustness.md): a worker process that
+dies (or hangs) mid-run is detected by the bounded per-RPC recv, killed,
+respawned, and replayed to the last round boundary — and the run's
+outcomes are identical to one where nothing died (guest re-execution is
+deterministic, the same contract the run-twice determinism tests pin).
+Teardown must reap dead workers instead of hanging on their pipes."""
+
+import pathlib
+import subprocess
+import time
+
+import pytest
+
+from shadow_tpu.engine import EngineConfig
+from shadow_tpu.graph import compute_routing
+from shadow_tpu.hostk.kernel import ProcessSpec
+from shadow_tpu.runtime.hybrid import ParallelHybridScheduler, WorkerCrashed
+from shadow_tpu.simtime import NS_PER_MS, NS_PER_SEC
+from tests.topo import two_node_graph
+
+GUESTS = pathlib.Path(__file__).parent / "guests"
+W = 1 * NS_PER_MS
+
+
+@pytest.fixture(scope="module")
+def bins(tmp_path_factory):
+    out = tmp_path_factory.mktemp("guests")
+    built = {}
+    for name in ("tcp_echo_server", "tcp_client"):
+        dst = out / name
+        subprocess.run(
+            ["cc", "-O2", "-o", str(dst), str(GUESTS / f"{name}.c")], check=True
+        )
+        built[name] = str(dst)
+    return built
+
+
+def _world():
+    graph = two_node_graph(10, 0.0)
+    host_names = ["server0", "client0"]
+    host_nodes = [0, 1]
+    tables = compute_routing(graph).with_hosts(host_nodes)
+    cfg = EngineConfig(
+        num_hosts=2, queue_capacity=256, outbox_capacity=64,
+        runahead_ns=W, seed=5,
+    )
+    return tables, cfg, host_names, host_nodes
+
+
+def _specs(bins, nbytes):
+    return [
+        ProcessSpec(host="server0", args=[bins["tcp_echo_server"], "8080", "1"]),
+        ProcessSpec(
+            host="client0",
+            args=[bins["tcp_client"], "server0", "8080", str(nbytes)],
+            start_ns=100 * NS_PER_MS,
+        ),
+    ]
+
+
+class _KillableSched(ParallelHybridScheduler):
+    """Test harness: SIGKILLs a chosen worker right before the Nth window
+    broadcast — a deterministic stand-in for a worker crashing mid-run."""
+
+    kill_worker: "int | None" = None
+    kill_at_call = 0
+    _calls = 0
+
+    def _run_windows(self, end_ns, inclusive):
+        type(self)._calls += 1
+        if self.kill_worker is not None and type(self)._calls == self.kill_at_call:
+            self._workers[self.kill_worker][0].kill()
+            time.sleep(0.3)  # let the pipe actually close
+        return super()._run_windows(end_ns, inclusive)
+
+
+def _run(tmp_path, bins, name, kill_worker=None, kill_at_call=0, **kw):
+    tables, cfg, host_names, host_nodes = _world()
+
+    class Sched(_KillableSched):
+        pass
+
+    Sched.kill_worker = kill_worker
+    Sched.kill_at_call = kill_at_call
+    Sched._calls = 0
+    sched = Sched(
+        tables, cfg, host_names=host_names, host_nodes=host_nodes,
+        specs=_specs(bins, 6000), num_workers=2, seed=5,
+        data_dir=tmp_path / name, **kw,
+    )
+    try:
+        try:
+            sched.run(30 * NS_PER_SEC)
+        finally:
+            sched.shutdown()
+        stats = sched.stats()
+        log = sorted(sched.event_log())
+        info = {
+            p["host"]: (p["stdout"], p["exit_code"], p["syscalls"])
+            for p in sched.proc_info()
+        }
+        return stats, log, info, list(sched._respawns)
+    finally:
+        sched.close()
+
+
+def test_kill_one_worker_recovers_identically(tmp_path, bins):
+    """SIGKILL one worker mid-run: the scheduler respawns it, replays its
+    command log to the last round boundary, and finishes with stats,
+    event log, and guest outputs identical to an undisturbed run."""
+    clean = _run(tmp_path, bins, "clean")
+    assert clean[3] == [0, 0]
+    killed = _run(tmp_path, bins, "killed", kill_worker=1, kill_at_call=2)
+    assert killed[3] == [0, 1]  # exactly one respawn, of the killed worker
+    assert killed[0] == clean[0]
+    assert killed[1] == clean[1]
+    assert killed[2] == clean[2]
+
+
+def test_respawn_budget_exhausted_raises(tmp_path, bins):
+    """max_worker_respawns=0 turns a worker death into a loud
+    WorkerCrashed instead of a silent infinite respawn loop."""
+    with pytest.raises(WorkerCrashed, match="respawn budget"):
+        _run(
+            tmp_path, bins, "budget",
+            kill_worker=1, kill_at_call=2, max_worker_respawns=0,
+        )
+
+
+def test_close_reaps_dead_worker(tmp_path, bins):
+    """close() must return promptly and reap every worker process even
+    when one died mid-RPC — today's bound is poll+timeout per pipe, so a
+    dead worker can no longer hang the manager."""
+    tables, cfg, host_names, host_nodes = _world()
+    sched = ParallelHybridScheduler(
+        tables, cfg, host_names=host_names, host_nodes=host_nodes,
+        specs=_specs(bins, 1000), num_workers=2, seed=5,
+        data_dir=tmp_path / "reap",
+    )
+    procs = [p for p, _c in sched._workers]
+    procs[0].kill()
+    time.sleep(0.3)
+    t0 = time.monotonic()
+    sched.close()
+    assert time.monotonic() - t0 < 30
+    for p in procs:
+        assert not p.is_alive()

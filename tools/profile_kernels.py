@@ -283,6 +283,78 @@ def profile_dispatch(hosts: int, chunks: int = 6):
     return out
 
 
+def profile_checkpoint(hosts: int, reps: int = 3):
+    """Part 4 (robustness round): wall time and bytes of a checkpoint
+    save (state_to_host bulk fetch + atomic npz write) and restore (npz
+    read + state_from_host upload), on a mid-burst state — the cost a
+    --checkpoint-interval cadence actually pays per checkpoint, and the
+    transfer the rollback-and-regrow retainer pays per snapshot. Also
+    verifies the restore is leaf-exact."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from bench import _build
+    from shadow_tpu.engine.round import run_until
+    from shadow_tpu.engine.state import (
+        _is_key_leaf,
+        state_from_host,
+        state_to_host,
+    )
+    from shadow_tpu.runtime.checkpoint import load_checkpoint, save_checkpoint
+
+    burst_env = os.environ.get("SHADOW_TPU_PROFILE_BURST_MS", "20,60")
+    b0 = int(burst_env.split(",")[0]) * 1_000_000
+
+    cfg, model, tables, st0 = _build(hosts)
+    st = run_until(st0, b0, model, tables, cfg, rounds_per_chunk=32)
+    jax.block_until_ready(st.events_handled)
+
+    def _nbytes(leaf):
+        try:
+            return leaf.nbytes
+        except Exception:
+            return jax.random.key_data(leaf).nbytes
+
+    out = {
+        "hosts": hosts,
+        "state_bytes": int(sum(_nbytes(l) for l in jax.tree.leaves(st))),
+        "leaves": len(jax.tree.leaves(st)),
+    }
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "ckpt.npz")
+        fetch_ms, save_ms, load_ms = [], [], []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            host = state_to_host(st)
+            fetch_ms.append((time.perf_counter() - t0) * 1e3)
+            t0 = time.perf_counter()
+            save_checkpoint(path, host, {"fingerprint": "profile"})
+            save_ms.append((time.perf_counter() - t0) * 1e3)
+            t0 = time.perf_counter()
+            restored, _meta = load_checkpoint(path, st)
+            jax.block_until_ready(restored.events_handled)
+            load_ms.append((time.perf_counter() - t0) * 1e3)
+        out["file_bytes"] = int(os.path.getsize(path))
+        out["fetch_ms"] = round(min(fetch_ms), 2)
+        out["save_ms"] = round(min(save_ms), 2)
+        out["restore_ms"] = round(min(load_ms), 2)
+        host = state_to_host(st)
+        rt = state_from_host(host, st)
+        out["roundtrip_exact"] = bool(
+            all(
+                np.array_equal(
+                    np.asarray(jax.random.key_data(a) if _is_key_leaf(a) else a),
+                    np.asarray(jax.random.key_data(b) if _is_key_leaf(b) else b),
+                )
+                for a, b in zip(jax.tree.leaves(st), jax.tree.leaves(rt))
+            )
+        )
+    print(json.dumps({"checkpoint": out}), flush=True)
+    return out
+
+
 def main():
     import jax
 
@@ -296,6 +368,7 @@ def main():
     out["widths"] = profile_widths(reps)
     out["engines"] = profile_engines(reps, eng_hosts)
     out["dispatch"] = profile_dispatch(eng_hosts)
+    out["checkpoint"] = profile_checkpoint(eng_hosts)
     print(json.dumps(out), flush=True)
 
 
