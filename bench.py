@@ -34,6 +34,13 @@ donation, percentiles in the result's "phases", cumulative totals on
 every progress line) for every trial — including failed/timed-out
 attempts, whose last progress line's phases land in the attempt log.
 
+Ensemble (round-10 tentpole, docs/ensemble.md): a separate child trial
+runs a dispatch-bound phold world at --replicas 1/8/32 through the
+vmapped ensemble driver and publishes wall-clock PER REPLICA per row
+plus the aggregate statistics block (detail.ensemble). Knobs:
+SHADOW_TPU_BENCH_ENSEMBLE=0 disables, SHADOW_TPU_BENCH_ENSEMBLE_HOSTS /
+_SIMSEC size it, SHADOW_TPU_BENCH_ENSEMBLE_WORKLOAD=phold|tgen.
+
 Env knobs: SHADOW_TPU_BENCH_HOSTS (default 10240 — the BASELINE.md target
 scale; the round-3 fusion work cut the active phase to a few seconds, so
 the tunneled worker now survives it comfortably), SHADOW_TPU_BENCH_SIMSEC
@@ -306,6 +313,128 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
     }
 
 
+def _measure_ensemble(num_hosts: int, sim_sec: float, replica_counts=(1, 8, 32)):
+    """Ensemble trial (runs in a disposable child, role=ensemble): the
+    amortized-cost demonstration the ensemble plane exists for
+    (docs/ensemble.md). A small phold world — dispatch-bound by
+    construction, so the per-chunk launch overhead is the dominant cost
+    that stacking R replicas under one vmap amortizes — is run at
+    R=1/8/32 through the production ensemble driver; each row reports
+    wall-clock PER REPLICA, and the largest completed R also publishes
+    the per-replica + aggregate statistics block exactly as a
+    `--replicas` run's sim-stats.json would carry it. Workload:
+    SHADOW_TPU_BENCH_ENSEMBLE_WORKLOAD=phold (default) | tgen."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from shadow_tpu.engine import EngineConfig
+    from shadow_tpu.engine.ensemble import (
+        init_ensemble_state,
+        replica_seeds,
+        run_ensemble_until,
+    )
+    from shadow_tpu.graph import NetworkGraph, compute_routing
+    from shadow_tpu.models.phold import PholdModel
+    from shadow_tpu.runtime.ensemble import ensemble_stats
+    from shadow_tpu.simtime import NS_PER_MS
+
+    workload = os.environ.get("SHADOW_TPU_BENCH_ENSEMBLE_WORKLOAD", "phold")
+    end = int(sim_sec * NS_PER_SEC)
+    bw = None
+    if workload == "tgen":
+        cfg, model, tables = _build_world(num_hosts)
+        cfg = dataclasses.replace(cfg, tracker=True)
+        from shadow_tpu.netstack import bw_bits_per_sec_to_refill
+
+        bw = bw_bits_per_sec_to_refill(HOST_BW_BITS)
+    else:
+        n_nodes = 8
+        lines = ["graph [", "  directed 0"]
+        for i in range(n_nodes):
+            lines.append(f"  node [ id {i} ]")
+            lines.append(f'  edge [ source {i} target {i} latency "1 ms" ]')
+            lines.append(
+                f'  edge [ source {i} target {(i + 1) % n_nodes} latency "3 ms" ]'
+            )
+        lines.append("]")
+        graph = NetworkGraph.from_gml("\n".join(lines))
+        tables = compute_routing(graph).with_hosts(
+            [i % n_nodes for i in range(num_hosts)]
+        )
+        cfg = EngineConfig(
+            num_hosts=num_hosts,
+            runahead_ns=graph.min_latency_ns(),
+            seed=7,
+            tracker=True,
+        )
+        model = PholdModel(
+            num_hosts=num_hosts,
+            min_delay_ns=1 * NS_PER_MS,
+            max_delay_ns=8 * NS_PER_MS,
+        )
+
+    out = {
+        "workload": workload,
+        "hosts": num_hosts,
+        "sim_sec": sim_sec,
+        "rows": [],
+    }
+    base_per_replica = None
+    last_done = None  # (final_state, r_count, wall) of the largest done R
+    for r_count in replica_counts:
+        row = {"replicas": r_count}
+        try:
+            ens0 = init_ensemble_state(
+                cfg, model, r_count,
+                tx_bytes_per_interval=bw, rx_bytes_per_interval=bw,
+            )
+            t0 = time.perf_counter()
+            s = run_ensemble_until(
+                ens0, end, model, tables, cfg, rounds_per_chunk=32
+            )
+            jax.block_until_ready(s.events_handled)
+            row["compile_plus_run_s"] = round(time.perf_counter() - t0, 3)
+            t0 = time.perf_counter()
+            s = run_ensemble_until(
+                ens0, end, model, tables, cfg, rounds_per_chunk=32
+            )
+            jax.block_until_ready(s.events_handled)
+            wall = time.perf_counter() - t0
+            row.update(
+                wall_s=round(wall, 4),
+                wall_per_replica_ms=round(wall / r_count * 1e3, 2),
+                events=int(np.asarray(s.events_handled).sum()),
+            )
+            if base_per_replica is None:
+                base_per_replica = wall / r_count
+            else:
+                row["speedup_per_replica_vs_r1"] = round(
+                    base_per_replica / (wall / r_count), 2
+                )
+            last_done = (s, r_count, wall)
+        except Exception as e:  # noqa: BLE001 — a big-R OOM must not
+            # kill the smaller rows already measured
+            row["error"] = str(e)[:300]
+        out["rows"].append(row)
+        print(json.dumps({"ensemble_row": row}), flush=True)
+    if last_done is not None:
+        # the aggregate statistics block, as a --replicas run's
+        # sim-stats.json would publish it — folded ONCE from the largest
+        # completed R (the fold's bulk host_stats fetch is not free)
+        s, r_count, wall = last_done
+        out["aggregate_stats"] = ensemble_stats(
+            s, replica_seeds(cfg, r_count, 1), wall, sim_sec
+        )
+    done = [r for r in out["rows"] if "wall_per_replica_ms" in r]
+    if len(done) >= 2:
+        out["amortization_demonstrated"] = (
+            done[-1]["wall_per_replica_ms"] < done[0]["wall_per_replica_ms"]
+        )
+    return out
+
+
 def _child_env(**extra) -> dict:
     env = dict(os.environ)
     env.update({k: str(v) for k, v in extra.items()})
@@ -425,6 +554,11 @@ def main():
 
     if role == "measure":
         print(json.dumps(_measure(num_hosts, sim_sec, rounds_per_chunk=rpc)))
+        return
+    if role == "ensemble":
+        eh = int(os.environ.get("SHADOW_TPU_BENCH_ENSEMBLE_HOSTS", 128))
+        es = float(os.environ.get("SHADOW_TPU_BENCH_ENSEMBLE_SIMSEC", 0.1))
+        print(json.dumps({"ensemble": _measure_ensemble(eh, es)}))
         return
 
     # ---- orchestrator -------------------------------------------------
@@ -680,6 +814,57 @@ def main():
         if tpu_up and "error" in row.get("tpu", {}):
             break  # don't burn the remaining sizes on a dead tunnel
 
+    # ---- ensemble trial (round-10 tentpole, docs/ensemble.md): the
+    # amortization demonstration — wall-clock per replica at R=1/8/32 on
+    # a dispatch-bound phold world through the vmapped ensemble driver,
+    # plus the aggregate statistics block a --replicas run publishes.
+    # Salvageable like everything else: per-R rows print as they land,
+    # so a timeout keeps the rows already measured.
+    # SHADOW_TPU_BENCH_ENSEMBLE=0 disables. -------------------------------
+    ensemble = None
+    if os.environ.get("SHADOW_TPU_BENCH_ENSEMBLE", "1") != "0" and _time_left() > 150:
+        eh = int(
+            os.environ.get(
+                "SHADOW_TPU_BENCH_ENSEMBLE_HOSTS", 1024 if tpu_up else 128
+            )
+        )
+        env_extra = dict(
+            SHADOW_TPU_BENCH_ROLE="ensemble",
+            SHADOW_TPU_BENCH_ENSEMBLE_HOSTS=eh,
+        )
+        rows = []
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=_child_env(**env_extra) if tpu_up else _cpu_env(**env_extra),
+                capture_output=True,
+                text=True,
+                timeout=600 if tpu_up else min(420.0, max(_time_left(), 90.0)),
+            )
+            for ln in r.stdout.strip().splitlines():
+                try:
+                    obj = json.loads(ln)
+                except ValueError:
+                    continue
+                if "ensemble" in obj:
+                    ensemble = obj["ensemble"]
+                elif "ensemble_row" in obj:
+                    rows.append(obj["ensemble_row"])
+            if ensemble is None and rows:
+                ensemble = {"rows": rows, "partial": True}
+            if ensemble is None:
+                ensemble = {"error": f"rc={r.returncode}: {r.stderr[-300:]}"}
+        except subprocess.TimeoutExpired as e:
+            out_s = e.stdout.decode(errors="replace") if isinstance(e.stdout, bytes) else (e.stdout or "")
+            for ln in out_s.strip().splitlines():
+                try:
+                    obj = json.loads(ln)
+                except ValueError:
+                    continue
+                if "ensemble_row" in obj:
+                    rows.append(obj["ensemble_row"])
+            ensemble = {"rows": rows, "partial": True, "error": "timeout"}
+
     # optional: the old JAX-on-CPU measurement, for the record only
     cpu_xla = None
     if os.environ.get("SHADOW_TPU_BENCH_CPU_XLA") == "1":
@@ -712,6 +897,7 @@ def main():
                     "main": main_res,
                     "native_baseline": base,
                     **({"scaling": scaling} if scaling else {}),
+                    **({"ensemble": ensemble} if ensemble else {}),
                     **({"cpu_xla": cpu_xla} if cpu_xla else {}),
                     "attempts": [
                         {k: v for k, v in a.items() if k != "result"} for a in attempts_log

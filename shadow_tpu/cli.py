@@ -58,6 +58,23 @@ def main(argv: "list[str] | None" = None) -> int:
         "(general.resume)",
     )
     run_p.add_argument(
+        "--replicas",
+        type=int,
+        metavar="N",
+        help="run N independent seeded replicas of the scenario in one "
+        "device program (scripted models, tpu scheduler); replica r is "
+        "leaf-identical to a single run seeded seed + r*stride, and "
+        "sim-stats.json gains per-replica + aggregate CI sections "
+        "(general.replicas; docs/ensemble.md)",
+    )
+    run_p.add_argument(
+        "--replica-seed-stride",
+        type=int,
+        metavar="K",
+        help="spacing between consecutive replicas' derived seeds "
+        "(default 1; general.replica_seed_stride)",
+    )
+    run_p.add_argument(
         "--no-recover",
         action="store_true",
         help="disable rollback-and-regrow capacity recovery: fail fast "
@@ -84,6 +101,8 @@ def main(argv: "list[str] | None" = None) -> int:
                 checkpoint_interval=args.checkpoint_interval,
                 resume=args.resume,
                 no_recover=args.no_recover,
+                replicas=args.replicas,
+                replica_seed_stride=args.replica_seed_stride,
             )
         except CliUserError as e:
             print(f"shadow-tpu: error: {e}", file=sys.stderr)

@@ -62,6 +62,15 @@ class GeneralOptions:
     checkpoint_dir: Optional[str] = None
     checkpoint_interval_ns: int = 30_000_000_000
     resume: bool = False
+    # Ensemble plane (docs/ensemble.md): `replicas` runs R independent
+    # seeded copies of the scenario in ONE device program (scripted
+    # models on the tpu scheduler; vmapped over a leading replica axis);
+    # replica r is leaf-identical to a single run seeded
+    # seed + r * replica_seed_stride. sim-stats.json gains per-replica
+    # sections plus an aggregate mean/stddev/CI block. CLI: --replicas /
+    # --replica-seed-stride.
+    replicas: int = 1
+    replica_seed_stride: int = 1
 
     @classmethod
     def from_dict(cls, d: dict) -> "GeneralOptions":
@@ -88,10 +97,19 @@ class GeneralOptions:
             "trace_file",
             "checkpoint_dir",
             "resume",
+            "replicas",
+            "replica_seed_stride",
         ):
             if k in d:
                 setattr(out, k, d.pop(k))
         _reject_unknown("general", d)
+        if out.replicas < 1:
+            raise ValueError("general.replicas must be >= 1")
+        if out.replica_seed_stride < 1:
+            raise ValueError(
+                "general.replica_seed_stride must be >= 1 (stride 0 would "
+                "alias every replica onto the same PRNG streams)"
+            )
         return out
 
 
@@ -138,6 +156,12 @@ class ExperimentalOptions:
     scheduler: str = "tpu"
     runahead_ns: Optional[int] = None  # None = min graph latency
     use_dynamic_runahead: bool = False
+    # Round-engine selection (engine/state.py EngineConfig.engine): all
+    # four values are bit-identical on every model; determinism-relevant
+    # only in that the config fingerprint pins a resumed run to the exact
+    # executable its checkpoints were written under.
+    engine: str = "auto"  # "auto" | "plain" | "pump" | "megakernel"
+    pump_k: int = 0  # microsteps per pump/megakernel iteration (0 = off)
     queue_capacity: int = 64
     outbox_capacity: int = 16
     record_capacity: int = 128  # hybrid per-host outcome-record ring
@@ -181,6 +205,8 @@ class ExperimentalOptions:
         for k in (
             "scheduler",
             "use_dynamic_runahead",
+            "engine",
+            "pump_k",
             "queue_capacity",
             "outbox_capacity",
             "record_capacity",
@@ -214,6 +240,11 @@ class ExperimentalOptions:
             raise ValueError(
                 f"unknown scheduler {out.scheduler!r} "
                 "(expected 'tpu', 'cpu-ref', or 'managed')"
+            )
+        if out.engine not in ("auto", "plain", "pump", "megakernel"):
+            raise ValueError(
+                f"unknown engine {out.engine!r} "
+                "(expected 'auto', 'plain', 'pump', or 'megakernel')"
             )
         _reject_unknown("experimental", d)
         return out

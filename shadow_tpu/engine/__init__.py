@@ -17,11 +17,19 @@ from shadow_tpu.engine.round import (
     state_probe,
     validate_runahead,
 )
+from shadow_tpu.engine.ensemble import (
+    init_ensemble_state,
+    replica_slice,
+    run_ensemble_until,
+)
 from shadow_tpu.engine.sharded import ShardedRunner, shard_state, state_specs
 
 __all__ = [
     "ChunkProbe",
     "EngineConfig",
+    "init_ensemble_state",
+    "replica_slice",
+    "run_ensemble_until",
     "LocalEmits",
     "PacketEmits",
     "SimState",

@@ -637,7 +637,7 @@ def run_round(
             )
         return handle_one_iteration(s, window_end, model, tables, cfg)
 
-    def body(carry):
+    def _step(carry):
         s, iters = carry
         if use_pump:
             s, rej = stage(s, window_end, model, tables, stage_cfg)
@@ -649,6 +649,23 @@ def run_round(
         else:
             s = _handler(s)
         return s, iters + 1
+
+    if cfg.ensemble:
+        # Per-replica done-mask (engine/ensemble.py): under jax.vmap the
+        # while_loop condition is any-reduced across the replica batch,
+        # so the body keeps running until the SLOWEST replica drains its
+        # round. Re-testing the predicate inside the body and taking an
+        # identity branch freezes a drained replica's carry — including
+        # `iters`, hence iters_done — instead of accumulating no-op
+        # iterations, which is what keeps every ensemble slice leaf-exact
+        # to its single-replica run. Static flag: unbatched traces keep
+        # the bare step (no second predicate on the hottest loop).
+
+        def body(carry):
+            return jax.lax.cond(cond(carry), _step, lambda c: c, carry)
+
+    else:
+        body = _step
 
     st, iters = jax.lax.while_loop(cond, body, (st, jnp.asarray(0, jnp.int32)))
     if cfg.tracker:
@@ -920,6 +937,9 @@ class CapacityError(RuntimeError):
     queue_hwm: int = 0
     outbox_hwm: int = 0
     shard_detail: "str | None" = None
+    # ensemble runs (engine/ensemble.py): index of the replica whose
+    # probe row carried the overflow (None for single-world runs)
+    replica: "int | None" = None
 
 
 class RunInterrupted(RuntimeError):

@@ -115,6 +115,15 @@ class EngineConfig:
     # the simulated trajectory leaf-exact unchanged (tracker leaves are
     # write-only — nothing reads them back into the simulation).
     tracker: bool = False
+    # Set (only) by engine/ensemble.py ensemble_engine_cfg: the round
+    # drain body self-masks per batch element (replicas that drained
+    # freeze as identity no-ops instead of accumulating iters under
+    # vmap's any-reduced while condition). The mask is semantics-neutral
+    # — ensemble slices stay leaf-exact vs single runs traced WITHOUT it
+    # (tests/test_ensemble.py) — but costs an extra predicate + XLA
+    # conditional per drain iteration, so unbatched traces keep the bare
+    # body.
+    ensemble: bool = False
     # draws consumed per handled event = model.DRAWS_PER_EVENT + PACKET_EMITS
     # (one loss draw per packet lane), fixed-stride for determinism.
 

@@ -85,6 +85,11 @@ class NetworkGraph:
     def one_gbit_switch(cls) -> "NetworkGraph":
         return cls.from_gml(ONE_GBIT_SWITCH_GML)
 
+    # one-time (per process) warning that nonzero edge jitter is parsed
+    # but not applied — reference parity (graph/mod.rs parses jitter and
+    # routing ignores it too); see docs/architecture.md "network graph"
+    _jitter_warned = False
+
     @classmethod
     def from_parsed(cls, g: GmlGraph) -> "NetworkGraph":
         node_ids = [n["id"] for n in g.nodes]
@@ -104,6 +109,7 @@ class NetworkGraph:
         rel = np.zeros((n, n), dtype=np.float32)
         jit = np.zeros((n, n), dtype=np.int64)
 
+        jitter_edges = []
         for e in g.edges:
             s = id_to_index.get(e["source"])
             t = id_to_index.get(e["target"])
@@ -120,6 +126,8 @@ class NetworkGraph:
             if not 0.0 <= loss <= 1.0:
                 raise ValueError(f"packet_loss not in [0,1]: {e}")
             ejit = parse_time_ns(e.get("jitter", 0)) if "jitter" in e else 0
+            if ejit > 0:
+                jitter_edges.append((e["source"], e["target"]))
             pairs = [(s, t)] if g.directed else [(s, t), (t, s)]
             for a, b in pairs:
                 # keep the better (lower-latency) edge if duplicated
@@ -128,6 +136,28 @@ class NetworkGraph:
                     rel[a, b] = np.float32(1.0 - loss)
                     jit[a, b] = ejit
 
+        if jitter_edges and not cls._jitter_warned:
+            # parsed-but-unused is easy to mistake for applied-but-small:
+            # warn ONCE per process, naming the edges, so experiments that
+            # rely on jittered latency know it is not being simulated
+            # (reference parity — the reference parses and ignores it in
+            # routing too; docs/architecture.md)
+            cls._jitter_warned = True
+            from shadow_tpu.utils.shadow_log import slog
+
+            shown = ", ".join(f"{s}->{t}" for s, t in jitter_edges[:8])
+            extra = (
+                f" (+{len(jitter_edges) - 8} more)" if len(jitter_edges) > 8 else ""
+            )
+            slog(
+                "warning",
+                0,
+                "graph",
+                f"{len(jitter_edges)} edge(s) declare nonzero jitter "
+                f"({shown}{extra}); jitter is parsed but NOT applied to "
+                "link latency — reference-parity behavior, see "
+                "docs/architecture.md",
+            )
         return cls(
             num_nodes=n,
             node_ids=node_ids,

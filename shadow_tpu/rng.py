@@ -29,6 +29,33 @@ def host_keys(seed: int, num_hosts: int) -> jax.Array:
     return jax.vmap(lambda h: random.fold_in(base, h))(jnp.arange(num_hosts, dtype=jnp.uint32))
 
 
+def replica_keys(
+    base_seed: int, num_replicas: int, num_hosts: int, stride: int = 1
+) -> jax.Array:
+    """[R, H] per-host base keys for an R-replica ensemble.
+
+    Replica r's row is EXACTLY host_keys(base_seed + r * stride, num_hosts)
+    — the independence contract of the ensemble plane (engine/ensemble.py):
+    replica r of an ensemble run is leaf-identical to a single run seeded
+    base_seed + r * stride, because this is the only seam where the seed
+    enters the state. Distinct integer seeds give distinct threefry roots,
+    and fold_in(root, host) keeps rows distinct per host, so the R x H key
+    grid is collision-free (tests/test_rng.py asserts it exhaustively).
+    `stride` spaces the derived seeds so ensembles with overlapping base
+    seeds can be kept disjoint (seed collides <=> the derived integer
+    collides, which stride > 1 makes easy to avoid)."""
+    if num_replicas < 1:
+        raise ValueError("num_replicas must be >= 1")
+    if stride < 1:
+        raise ValueError(
+            "replica seed stride must be >= 1 (stride 0 would alias every "
+            "replica onto the same stream)"
+        )
+    return jnp.stack(
+        [host_keys(base_seed + r * stride, num_hosts) for r in range(num_replicas)]
+    )
+
+
 def _draw_keys(keys: jax.Array, counters: jax.Array) -> jax.Array:
     return jax.vmap(random.fold_in)(keys, counters.astype(jnp.uint32))
 

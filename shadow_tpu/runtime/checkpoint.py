@@ -55,7 +55,13 @@ def config_fingerprint(config) -> str:
     processed config minus the knobs that only affect where outputs land
     or how the run is displayed/checkpointed. `tracker` stays IN (it
     changes the TrackerState leaves); `stop_time` stays in (resume must
-    target the same horizon for chunk boundaries to line up)."""
+    target the same horizon for chunk boundaries to line up); `replicas`/
+    `replica_seed_stride` stay in (they change the state's leading axis
+    and every replica's derived seed — a resume with a mismatched replica
+    count must fail HERE with a clear error, never as a shape mismatch
+    deep in jax); `engine`/`pump_k` stay in (the engines are bit-identical
+    by contract, but pinning them keeps a resumed run on the exact
+    executable the checkpoint was written under)."""
     d = config.to_dict()
     g = d.get("general", {})
     for k in (
@@ -92,8 +98,10 @@ def save_checkpoint(path: str, host_state: SimState, meta: dict) -> str:
         leaf_paths=paths,
         # recorded so resume can rebuild the template at the RIGHT widths
         # even after rollback-and-regrow grew them past the config values
-        queue_capacity=int(host_state.queue.time.shape[1]),
-        outbox_capacity=int(host_state.outbox.valid.shape[1]),
+        # (shape[-1] is the capacity axis for single [H, Q] and ensemble
+        # [R, H, Q] states alike)
+        queue_capacity=int(host_state.queue.time.shape[-1]),
+        outbox_capacity=int(host_state.outbox.valid.shape[-1]),
     )
     arrays = {f"leaf_{i:05d}": np.asarray(l) for i, l in enumerate(leaves)}
     arrays["__meta__"] = np.asarray(json.dumps(full_meta))
@@ -177,7 +185,10 @@ class CheckpointManager:
         return self._next is not None and probe.now >= self._next
 
     def write(self, host_state: SimState, final: bool = False) -> str:
-        now = int(host_state.now)
+        # ensemble states carry a [R] `now`; the cadence follows the
+        # slowest replica, matching the aggregate probe's `now` lane that
+        # due() decides from
+        now = int(np.min(np.asarray(host_state.now)))
         if self._next is not None:
             self._next = (now // self.interval_ns + 1) * self.interval_ns
         path = os.path.join(self.directory, f"ckpt-{now:020d}.npz")

@@ -32,6 +32,8 @@ def run_from_config(
     checkpoint_interval: "str | None" = None,
     resume: bool = False,
     no_recover: bool = False,
+    replicas: "int | None" = None,
+    replica_seed_stride: "int | None" = None,
 ) -> int:
     try:
         config = load_config_file(path)
@@ -58,6 +60,14 @@ def run_from_config(
         config.general.resume = True
     if no_recover:
         config.experimental.recover = False
+    if replicas is not None:
+        if replicas < 1:
+            raise CliUserError("--replicas must be >= 1")
+        config.general.replicas = replicas
+    if replica_seed_stride is not None:
+        if replica_seed_stride < 1:
+            raise CliUserError("--replica-seed-stride must be >= 1")
+        config.general.replica_seed_stride = replica_seed_stride
     set_level(config.general.log_level)
     if show_config:
         print(json.dumps(config.to_dict(), indent=2, default=str))

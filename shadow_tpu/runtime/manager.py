@@ -76,6 +76,29 @@ class Manager:
         self.graph = self._load_graph()
         self.hosts = self._expand_hosts()
         self.managed_mode = self._validate_process_specs()
+        if config.general.replicas > 1:
+            # ensemble plane (docs/ensemble.md): scripted models on the
+            # device engine only — managed guests are live OS processes
+            # and cannot be replicated on device, and the oracle/serial
+            # schedulers have no replica axis
+            if self.managed_mode:
+                raise ValueError(
+                    "general.replicas > 1 supports scripted-model runs "
+                    "only; managed guests are live OS processes and cannot "
+                    "be replicated on device (docs/ensemble.md)"
+                )
+            if config.experimental.scheduler != "tpu":
+                raise ValueError(
+                    "general.replicas > 1 requires experimental.scheduler: "
+                    "tpu (the ensemble plane vmaps the device engine)"
+                )
+            if config.general.parallelism > 1:
+                raise ValueError(
+                    "general.replicas > 1 runs on a single device (the "
+                    "replica axis is vmapped); it does not compose with "
+                    "general.parallelism > 1 host sharding yet — drop one "
+                    "of the two (docs/ensemble.md)"
+                )
         self.ip = IpAssignment()
         for h in self.hosts:
             if h.ip >= 0:
@@ -228,21 +251,42 @@ class Manager:
             use_netstack=use_netstack,
             bootstrap_end_ns=cfgo.general.bootstrap_end_time_ns,
             use_dynamic_runahead=cfgo.experimental.use_dynamic_runahead,
+            engine=cfgo.experimental.engine,
+            pump_k=cfgo.experimental.pump_k,
             tracker=cfgo.general.tracker,
         )
         ecfg, ckpt, guard, resume_path = self._setup_checkpointing(ecfg)
 
-        sched = make_scheduler(
-            cfgo.experimental.scheduler,
-            model,
-            tables,
-            ecfg,
-            host_node,
-            parallelism=cfgo.general.parallelism,
-            rounds_per_chunk=cfgo.experimental.rounds_per_chunk,
-            tx_bytes_per_interval=tx_refill,
-            rx_bytes_per_interval=rx_refill,
-        )
+        replicas = cfgo.general.replicas
+        if replicas > 1:
+            # Ensemble plane (docs/ensemble.md): R vmapped replicas in one
+            # device program (validated at construction). Same run()
+            # surface as TpuScheduler, so the checkpoint/recovery plumbing
+            # below composes unchanged.
+            from shadow_tpu.runtime.ensemble import EnsembleRunner
+
+            sched = EnsembleRunner(
+                model,
+                tables,
+                ecfg,
+                num_replicas=replicas,
+                seed_stride=cfgo.general.replica_seed_stride,
+                rounds_per_chunk=cfgo.experimental.rounds_per_chunk,
+                tx_bytes_per_interval=tx_refill,
+                rx_bytes_per_interval=rx_refill,
+            )
+        else:
+            sched = make_scheduler(
+                cfgo.experimental.scheduler,
+                model,
+                tables,
+                ecfg,
+                host_node,
+                parallelism=cfgo.general.parallelism,
+                rounds_per_chunk=cfgo.experimental.rounds_per_chunk,
+                tx_bytes_per_interval=tx_refill,
+                rx_bytes_per_interval=rx_refill,
+            )
 
         end = cfgo.general.stop_time_ns
         hb_ns = cfgo.general.heartbeat_interval_ns
@@ -283,7 +327,9 @@ class Manager:
                     f"{fmt_time_ns(probe.now)}{extra}",
                 )
 
-        slog("info", 0, "manager", f"starting: {num_hosts} hosts, scheduler={sched.name}, "
+        rep_note = f"{replicas} replicas, " if replicas > 1 else ""
+        slog("info", 0, "manager", f"starting: {num_hosts} hosts, {rep_note}"
+             f"scheduler={sched.name}, "
              f"runahead={runahead}ns, stop={fmt_time_ns(end)}")
         t0 = time.perf_counter()
         if isinstance(sched, CpuRefScheduler):
@@ -356,9 +402,27 @@ class Manager:
                 "count": len(report),
                 "events": report,
             }
+        host_tensors = None
+        if replicas > 1:
+            # per-replica sections + the aggregate mean/stddev/CI block
+            # (docs/ensemble.md), folded from ONE bulk host_stats fetch
+            # shared with the tracker fold below
+            from shadow_tpu.engine.round import host_stats
+            from shadow_tpu.runtime.ensemble import ensemble_stats
+
+            host_tensors = host_stats(final)
+            results.extra_stats["ensemble"] = ensemble_stats(
+                final,
+                sched.seeds,
+                wall,
+                end / NS_PER_SEC,
+                seed_stride=cfgo.general.replica_seed_stride,
+                host_tensors=host_tensors,
+            )
         self._fold_tracker(
             tracker, results, end,
             final_state=None if isinstance(sched, CpuRefScheduler) else final,
+            host_tensors=host_tensors,
         )
         slog("info", end, "manager",
              f"finished: {results.events_handled} events in {wall:.2f}s wall "
@@ -366,11 +430,14 @@ class Manager:
         self._write_outputs(results)
         return results
 
-    def _fold_tracker(self, tracker, results, end, final_state=None):
+    def _fold_tracker(self, tracker, results, end, final_state=None,
+                      host_tensors=None):
         """The shared run epilogue: fold the tracker registry into
         sim-stats' extra_stats and write the dispatch trace. With a
         final SimState and device counters on, performs the ONE bulk
-        per-host fetch (the heartbeat path fetches only on cadence);
+        per-host fetch (the heartbeat path fetches only on cadence) —
+        `host_tensors` supplies an already-fetched dict (the ensemble
+        stats fold shares its fetch) so the run never pays it twice;
         span-only trackers (--trace-file without --tracker) publish
         phases only."""
         if tracker is None:
@@ -378,7 +445,17 @@ class Manager:
         if tracker.counters and final_state is not None:
             from shadow_tpu.engine.round import host_stats
 
-            tracker.finalize(host_stats(final_state))
+            hs = host_tensors if host_tensors is not None else host_stats(
+                final_state
+            )
+            if self.config.general.replicas > 1:
+                # ensemble states fetch [R, H] tensors: flatten them to
+                # the shape the host-side fold expects (exact per-replica
+                # splits live in the `ensemble` stats block)
+                from shadow_tpu.runtime.ensemble import flatten_host_stats
+
+                hs = flatten_host_stats(hs)
+            tracker.finalize(hs)
         results.extra_stats["tracker"] = tracker.stats_dict()
         trace_path = tracker.write_trace()
         if trace_path:
@@ -457,7 +534,10 @@ class Manager:
             heartbeat_ns=g.heartbeat_interval_ns if g.tracker else 0,
             trace_path=g.trace_file,
             clear_line=progress.clear if progress is not None else None,
-            host_heartbeats=g.tracker,
+            # per-host heartbeat lines name one host per row; an ensemble
+            # run's per-host tensors are [R, H], so heartbeats stay off
+            # there (aggregates still ride the probe; docs/ensemble.md)
+            host_heartbeats=g.tracker and g.replicas <= 1,
             counters=g.tracker,
         )
 

@@ -27,6 +27,8 @@ from __future__ import annotations
 
 import dataclasses
 
+import numpy as np
+
 from shadow_tpu.engine.round import CapacityError, run_until
 from shadow_tpu.engine.state import grow_state, state_from_host, state_to_host
 from shadow_tpu.runtime.checkpoint import StateTap
@@ -111,6 +113,7 @@ def run_until_recovering(
     guard=None,
     runner_factory=None,
     on_recovery=None,
+    grow_fn=None,
 ):
     """run_until with the recovery loop wrapped around it. Returns
     (final_state, recoveries) where recoveries is the list of recovery
@@ -119,8 +122,11 @@ def run_until_recovering(
     scheduler passes a ShardedRunner builder); the default is the
     single-device run_until. `checkpoints`/`guard` ride the same StateTap
     (one shared snapshot per due point). `on_recovery(record)` fires per
-    recovery (bench progress lines)."""
+    recovery (bench progress lines). `grow_fn` overrides the regrow step
+    (default grow_state; the ensemble runner passes the replica-vmapped
+    grow_ensemble_state so the whole [R, ...] batch widens together)."""
     policy = policy or RecoveryPolicy()
+    grow = grow_fn or grow_state
 
     if runner_factory is None:
 
@@ -168,11 +174,12 @@ def run_until_recovering(
             new_cfg = grown_cfg(cur_cfg, err, policy.growth)
             if retainer is not None and retainer.host_state is not None:
                 base = state_from_host(retainer.host_state, cur_st)
-                from_ns = int(base.now)
             else:
                 base = cur_st  # the caller's never-donated entry state
-                from_ns = int(base.now)
-            grown = grow_state(
+            # ensemble states carry a [R] `now`: the rollback point is the
+            # slowest replica's window (the batch replays together)
+            from_ns = int(np.min(np.asarray(base.now)))
+            grown = grow(
                 base,
                 queue_capacity=new_cfg.queue_capacity,
                 outbox_capacity=new_cfg.outbox_capacity,
@@ -184,6 +191,10 @@ def run_until_recovering(
                 "outbox_capacity": new_cfg.outbox_capacity,
                 "replay_from_ns": from_ns,
             }
+            if getattr(err, "replica", None) is not None:
+                # ensemble runs: name the replica that saturated even
+                # though the whole batch rolls back and regrows together
+                record["replica"] = err.replica
             recoveries.append(record)
             slog(
                 "warning",

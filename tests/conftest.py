@@ -53,6 +53,11 @@ SLOW_TESTS = {
     "test_config_bandwidth_reaches_engine",
     "test_determinism_two_runs_identical",
     "test_device_tcp_matches_scalar_oracle",
+    "test_ensemble_matches_single_tgen",
+    "test_ensemble_checkpoint_resume_exact",
+    "test_ensemble_checkpoint_straddling_quiescence_exact",
+    "test_ensemble_recovery_regrows_whole_batch",
+    "test_ensemble_pipelined_matches_sync",
     "test_device_tgen_matches_scalar_oracle",
     "test_dynamic_matches_static_results",
     "test_dynamic_window_covers_more_time",
@@ -87,10 +92,165 @@ SLOW_TESTS = {
 }
 
 
+# ---- managed-guest (LD_PRELOAD shim) availability ----------------------
+# The hostk/hybrid/managed suites run real executables under the
+# LD_PRELOAD shim. In some container images the shim cannot load into
+# guests at all (observed here: `symbol lookup error: libshadow_shim.so:
+# undefined symbol: dlsym` — a glibc linking mismatch — so every guest
+# exits 127; the seed suites fail there pre-existing, CHANGES.md PR 4).
+# Probe ONCE per session — compile a trivial guest and run it under a
+# minimal NetKernel in a subprocess (a subprocess so a hung guest cannot
+# wedge collection) — and when the probe fails, auto-skip the
+# guest-execution tests with the probe's reason instead of failing them
+# one by one. Engine-level suites never skip.
+
+_GUEST_PROBE_SCRIPT = r"""
+import pathlib, subprocess, sys, tempfile
+root = pathlib.Path(sys.argv[1])
+sys.path.insert(0, str(root))
+from shadow_tpu.graph import NetworkGraph, compute_routing
+from shadow_tpu.hostk.kernel import NetKernel, ProcessSpec
+tmp = pathlib.Path(tempfile.mkdtemp(prefix="shim-probe-"))
+src = tmp / "guest.c"
+src.write_text("int main(void) { return 0; }\n")
+exe = tmp / "guest"
+subprocess.run(["cc", "-O0", "-o", str(exe), str(src)], check=True)
+graph = NetworkGraph.from_gml(
+    'graph [ directed 0 node [ id 0 ] '
+    'edge [ source 0 target 0 latency "1 ms" ] ]'
+)
+tables = compute_routing(graph).with_hosts([0])
+k = NetKernel(tables, host_names=["h"], host_nodes=[0], seed=1,
+              data_dir=tmp / "data")
+p = k.add_process(ProcessSpec(host="h", args=[str(exe)]))
+try:
+    k.run(1_000_000_000)
+finally:
+    k.shutdown()
+print("GUEST_OK" if p.exit_code == 0
+      else f"GUEST_BAD: trivial guest exited {p.exit_code} "
+           f"(state {p.state}) under the shim")
+"""
+
+# The seed tests that REQUIRE working guest execution (real binaries
+# under the shim — directly, via the hybrid scheduler, or via the
+# managed CLI): exactly these skip when the probe fails. Their modules
+# also hold engine-level and native-guest tests that pass without the
+# shim, which is why this is a test list, not a module list.
+GUEST_EXEC_TESTS = {
+    "test_cli_managed_end_to_end",
+    "test_cli_serial_scheduler_matches_hybrid",
+    "test_cli_double_run_strace_identical",
+    "test_cli_managed_shutdown_while_blocked",
+    "test_cli_expected_running_killed_at_stop",
+    "test_udp_echo_under_simulated_network",
+    "test_exit_codes_reaped",
+    "test_breadth_under_shim",
+    "test_breadth2_deterministic_views",
+    "test_msg_waitall",
+    "test_cpp_guest_under_shim",
+    "test_dns_apis_under_shim",
+    "test_fd_guest_matches_native",
+    "test_descriptor_families",
+    "test_file_sandbox_and_virtual_devices",
+    "test_urandom_deterministic_per_seed",
+    "test_random_deterministic_per_seed",
+    "test_fork_guest_under_shim",
+    "test_forking_server_serves_three_curls",
+    "test_forking_server_deterministic",
+    "test_fs_breadth_values",
+    "test_raw_futex_semantics",
+    "test_go_patterns",
+    "test_mm_guest_matches_native",
+    "test_mm_ledger_tracks_guest_mappings",
+    "test_fifo_keeps_burst_order",
+    "test_rr_interleaves_sockets",
+    "test_rr_deterministic",
+    "test_raw_clone_thread_adopted",
+    "test_raw_clone_slot_reuse",
+    "test_raw_syscalls_intercepted",
+    "test_unshaped_blast_arrives_at_line_rate",
+    "test_sender_bandwidth_paces_the_burst",
+    "test_receiver_bandwidth_paces_the_burst",
+    "test_tcp_bulk_over_shaped_link",
+    "test_signals_guest_native",
+    "test_signals_guest_under_shim",
+    "test_cross_process_kill",
+    "test_default_disposition_terminates",
+    "test_shutdown_time_uses_sigterm",
+    "test_tcp_echo_small",
+    "test_tcp_bulk_transfer",
+    "test_tcp_retransmission_under_loss",
+    "test_tcp_connection_refused",
+    "test_pcap_capture",
+    "test_tcp_strace_written",
+    "test_threads_guest_under_shim",
+    "test_main_pthread_exit_workers_continue",
+    "test_rdtsc_serves_sim_time",
+    "test_unix_guest_native",
+    "test_unix_guest_under_shim",
+    "test_unix_echo_two_processes_same_host",
+    "test_hybrid_matches_serial_tcp",
+    "test_hybrid_matches_serial_tcp_under_loss",
+    "test_system_curl_fetches_in_sim",
+    "test_system_wget_fetches_in_sim",
+    "test_system_curl_sees_simulated_time",
+    "test_sack_fewer_retransmits_equal_goodput",
+    "test_autotune_tracks_bdp",
+}
+
+
+def _managed_guest_reason():
+    """None when managed guests work here; else a short skip reason.
+    Called at most once per session (pytest_collection_modifyitems)."""
+    import subprocess
+    import sys as _sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    # neutralize the axon plugin for the probe child the way bench.py's
+    # _cpu_env does: the sitecustomize injection hangs backend init when
+    # the relay is down, and the child has no conftest to drop it
+    env.update(PYTHONPATH="", JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    try:
+        r = subprocess.run(
+            [_sys.executable, "-c", _GUEST_PROBE_SCRIPT, root],
+            capture_output=True,
+            text=True,
+            timeout=180,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return "managed-guest probe hung (>180s): guest never completed"
+    if "GUEST_OK" in r.stdout:
+        return None
+    bad = [ln for ln in r.stdout.splitlines() if ln.startswith("GUEST_BAD")]
+    tail = bad or (r.stdout + r.stderr).strip().splitlines()
+    detail = tail[-1][:200] if tail else f"rc={r.returncode}"
+    return (
+        "managed-guest (LD_PRELOAD shim) execution does not work in this "
+        f"environment: {detail}"
+    )
+
+
 def pytest_collection_modifyitems(config, items):
     for item in items:
         if item.name.split("[")[0] in SLOW_TESTS:
             item.add_marker(pytest.mark.slow)
+    guest_items = [
+        i for i in items if item_base_name(i) in GUEST_EXEC_TESTS
+    ]
+    if guest_items:
+        reason = _managed_guest_reason()
+        if reason is not None:
+            marker = pytest.mark.skip(reason=reason)
+            for item in guest_items:
+                item.add_marker(marker)
+
+
+def item_base_name(item) -> str:
+    return item.name.split("[")[0]
 
 
 def pytest_report_header(config):

@@ -1,0 +1,157 @@
+"""Tier-1 CLI smoke for the ensemble plane: `--replicas 2` runs end to
+end and publishes per-replica + aggregate sim-stats sections; resuming a
+replicated run with a mismatched replica count fails with a clear config
+error (the fingerprint covers replicas/engine/tracker), never a shape
+mismatch deep in jax."""
+
+import json
+import pathlib
+
+import pytest
+
+from shadow_tpu.config import load_config_str
+from shadow_tpu.runtime.checkpoint import config_fingerprint
+from shadow_tpu.runtime.cli_run import CliUserError, run_from_config
+
+CONFIG = """
+general:
+  stop_time: 120 ms
+  seed: {seed}
+  data_directory: {data_dir}
+  heartbeat_interval: null
+  tracker: true
+network:
+  graph:
+    type: 1_gbit_switch
+experimental:
+  rounds_per_chunk: 4
+hosts:
+  peer:
+    network_node_id: 0
+    quantity: 8
+    processes:
+      - path: phold
+        args:
+          min_delay: "2 ms"
+          max_delay: "12 ms"
+"""
+
+
+def _write(tmp_path, name, seed=1) -> pathlib.Path:
+    d = tmp_path / name
+    d.mkdir()
+    cfg = d / "shadow.yaml"
+    cfg.write_text(CONFIG.format(data_dir=d / "data", seed=seed))
+    return cfg
+
+
+def _stats(cfg_path: pathlib.Path) -> dict:
+    return json.loads((cfg_path.parent / "data" / "sim-stats.json").read_text())
+
+
+def test_cli_replicas_end_to_end(tmp_path):
+    cfg = _write(tmp_path, "ens")
+    assert run_from_config(str(cfg), replicas=2, replica_seed_stride=3) == 0
+    stats = _stats(cfg)
+    assert stats["scheduler"] == "tpu-ensemble"
+    ens = stats["ensemble"]
+    assert ens["replicas"] == 2 and ens["seed_stride"] == 3
+    per = ens["per_replica"]
+    assert len(per) == 2
+    assert [p["seed"] for p in per] == [1, 4]  # seed + r*stride
+    assert all(p["events_handled"] > 0 for p in per)
+    # top-level counters are the totals across replicas
+    assert stats["events_handled"] == sum(p["events_handled"] for p in per)
+    agg = ens["aggregate"]
+    for metric in ("events_handled", "packets_sent", "bytes_sent"):
+        block = agg[metric]
+        assert {"mean", "stddev", "min", "max", "ci95"} <= set(block)
+        assert block["min"] <= block["mean"] <= block["max"]
+        lo, hi = block["ci95"]
+        assert lo <= block["mean"] <= hi
+    assert ens["wall_seconds_per_replica"] < ens["wall_seconds"]
+    # the tracker fold still publishes (flattened across replicas)
+    assert stats["tracker"]["events_by_kind"]["local"] > 0
+
+
+def test_cli_replicas_resume_mismatch_fails(tmp_path, monkeypatch):
+    """Satellite pin: a checkpointed 2-replica run refuses to resume as a
+    3-replica run — the replica count is in the config fingerprint, so
+    the failure is a one-line config error, not a jax shape explosion."""
+    run_cfg = _write(tmp_path, "run")
+    ckpt_dir = str(tmp_path / "ckpts")
+    monkeypatch.setenv("SHADOW_TPU_TEST_INTERRUPT_AT_NS", str(60_000_000))
+    rc = run_from_config(
+        str(run_cfg),
+        checkpoint_dir=ckpt_dir,
+        checkpoint_interval="20 ms",
+        replicas=2,
+    )
+    assert rc == 130
+    assert sorted(pathlib.Path(ckpt_dir).glob("ckpt-*.npz"))
+    monkeypatch.delenv("SHADOW_TPU_TEST_INTERRUPT_AT_NS")
+
+    with pytest.raises(CliUserError, match="different config"):
+        run_from_config(
+            str(run_cfg), checkpoint_dir=ckpt_dir, resume=True, replicas=3
+        )
+
+    # the matching count resumes fine, bit-exact stats contract aside
+    rc = run_from_config(
+        str(run_cfg), checkpoint_dir=ckpt_dir, resume=True, replicas=2
+    )
+    assert rc == 0
+    assert _stats(run_cfg)["ensemble"]["replicas"] == 2
+
+
+def test_cli_replicas_rejects_parallelism(tmp_path):
+    """Explicit multi-device sharding does not compose with the replica
+    vmap yet: refuse loudly instead of silently running single-device."""
+    cfg = _write(tmp_path, "par")
+    cfg.write_text(cfg.read_text().replace("general:", "general:\n  parallelism: 4"))
+    with pytest.raises(CliUserError, match="parallelism"):
+        run_from_config(str(cfg), replicas=2)
+
+
+def test_cli_replicas_rejects_cpu_ref(tmp_path):
+    cfg = _write(tmp_path, "cpuref")
+    text = cfg.read_text().replace(
+        "experimental:", "experimental:\n  scheduler: cpu-ref"
+    )
+    cfg.write_text(text)
+    with pytest.raises(CliUserError, match="replicas"):
+        run_from_config(str(cfg), replicas=2)
+
+
+def test_fingerprint_covers_determinism_knobs(tmp_path):
+    """The config fingerprint must move with every determinism-relevant
+    option (replicas, seed stride, engine, pump_k, tracker, seed) and
+    stay put for display-only knobs (data_directory, progress)."""
+    base_text = CONFIG.format(data_dir=tmp_path / "d", seed=1)
+    base = config_fingerprint(load_config_str(base_text))
+
+    def fp(mutate):
+        c = load_config_str(base_text)
+        mutate(c)
+        return config_fingerprint(c)
+
+    moved = {
+        "replicas": fp(lambda c: setattr(c.general, "replicas", 2)),
+        "stride": fp(lambda c: setattr(c.general, "replica_seed_stride", 5)),
+        "engine": fp(lambda c: setattr(c.experimental, "engine", "plain")),
+        "pump_k": fp(lambda c: setattr(c.experimental, "pump_k", 4)),
+        "tracker": fp(lambda c: setattr(c.general, "tracker", False)),
+        "seed": fp(lambda c: setattr(c.general, "seed", 2)),
+    }
+    for name, v in moved.items():
+        assert v != base, f"{name} must change the fingerprint"
+    assert len(set(moved.values())) == len(moved)  # and independently
+
+    same = {
+        "data_directory": fp(
+            lambda c: setattr(c.general, "data_directory", "elsewhere")
+        ),
+        "progress": fp(lambda c: setattr(c.general, "progress", True)),
+    }
+    for name, v in same.items():
+        assert v == base, f"{name} must NOT change the fingerprint"

@@ -60,6 +60,50 @@ def test_gml_roundtrip_and_validation():
         NetworkGraph.from_gml('graph [ node [ id 0 ] edge [ source 0 target 0 latency "1 ms" packet_loss 1.5 ] ]')
 
 
+def test_jitter_warns_once_naming_edges():
+    """Nonzero edge jitter is parsed but not applied (reference parity;
+    docs/architecture.md): the first graph with jittered edges logs ONE
+    warning naming them, later parses stay quiet, and jitter-free graphs
+    never warn."""
+    import io
+
+    from shadow_tpu.utils import shadow_log
+
+    gml = """graph [
+      directed 0
+      node [ id 0 ]
+      node [ id 1 ]
+      edge [ source 0 target 0 latency "1 ms" ]
+      edge [ source 0 target 1 latency "2 ms" jitter "1 ms" ]
+    ]"""
+    NetworkGraph._jitter_warned = False
+    buf = io.StringIO()
+    shadow_log.set_sink(buf)
+    try:
+        NetworkGraph.from_gml(gml)
+        shadow_log.flush()  # records drain via the async flusher thread
+        first = buf.getvalue()
+        NetworkGraph.from_gml(gml)  # second parse: no repeat
+        shadow_log.flush()
+        second = buf.getvalue()[len(first):]
+    finally:
+        shadow_log.set_sink(None)
+        NetworkGraph._jitter_warned = False
+    assert "jitter" in first and "0->1" in first and "NOT applied" in first
+    assert "jitter" not in second
+
+    # a jitter-free graph must not arm the warning
+    NetworkGraph._jitter_warned = False
+    buf = io.StringIO()
+    shadow_log.set_sink(buf)
+    try:
+        NetworkGraph.one_gbit_switch()
+        shadow_log.flush()
+    finally:
+        shadow_log.set_sink(None)
+    assert "jitter" not in buf.getvalue()
+
+
 def test_gml_malformed_inputs_raise_value_error():
     for bad in [
         "graph [ node",

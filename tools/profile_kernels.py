@@ -26,6 +26,12 @@ from the compiled chunk's memory analysis with and without state
 donation: donated runs alias the whole SimState in place
 (aliased_bytes ~= state size, copied_bytes ~= the probe).
 
+Part 4 (checkpoint, robustness round): save/restore wall + bytes.
+
+Part 5 (ensemble round): amortized per-replica launch cost vs replica
+count R — wall-clock per replica at R=1/8/32 through the vmapped
+ensemble driver (docs/ensemble.md).
+
   python tools/profile_kernels.py [reps] [engine_hosts]
 
 Env knobs: SHADOW_TPU_PROFILE_WIDTHS (comma list, part 1),
@@ -355,6 +361,92 @@ def profile_checkpoint(hosts: int, reps: int = 3):
     return out
 
 
+def profile_ensemble(reps: int = 3, hosts: int = 0, replica_counts=(1, 8, 32)):
+    """Part 5 (ensemble round): amortized per-replica cost vs R. The
+    ensemble plane's claim is that stacking R replicas under one vmap
+    amortizes the per-chunk dispatch/launch overhead (flat in R) across
+    R worlds — so wall-clock PER REPLICA falls as R grows until compute
+    saturates the backend. Measured on a small phold world (dispatch-
+    bound by construction), with the production run_ensemble_until
+    driver and a Tracker attached: per-R rows report total wall, wall
+    per replica, the chunk-launch span total, and launch wall per
+    replica (the directly-amortized component)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp  # noqa: F401 — backend init ordering
+    import numpy as np
+
+    from shadow_tpu.engine import EngineConfig
+    from shadow_tpu.engine.ensemble import init_ensemble_state, run_ensemble_until
+    from shadow_tpu.graph import NetworkGraph, compute_routing
+    from shadow_tpu.models import PholdModel
+    from shadow_tpu.simtime import NS_PER_MS
+    from shadow_tpu.utils.tracker import Tracker
+
+    h = hosts or (1024 if jax.default_backend() == "tpu" else 128)
+    n_nodes = 8
+    lines = ["graph [", "  directed 0"]
+    for i in range(n_nodes):
+        lines.append(f"  node [ id {i} ]")
+        lines.append(f'  edge [ source {i} target {i} latency "1 ms" ]')
+        lines.append(
+            f'  edge [ source {i} target {(i + 1) % n_nodes} latency "3 ms" ]'
+        )
+    lines.append("]")
+    graph = NetworkGraph.from_gml("\n".join(lines))
+    tables = compute_routing(graph).with_hosts([i % n_nodes for i in range(h)])
+    cfg = EngineConfig(
+        num_hosts=h, runahead_ns=graph.min_latency_ns(), seed=7
+    )
+    model = PholdModel(
+        num_hosts=h, min_delay_ns=1 * NS_PER_MS, max_delay_ns=8 * NS_PER_MS
+    )
+    end = 100 * NS_PER_MS
+    out = {"hosts": h, "sim_ms": 100, "rows": {}}
+    base_per_replica = None
+    for r_count in replica_counts:
+        row = {}
+        try:
+            ens0 = init_ensemble_state(cfg, model, r_count)
+            # compile (fresh executable per R: the batch shape changed)
+            t0 = time.perf_counter()
+            s = run_ensemble_until(ens0, end, model, tables, cfg, rounds_per_chunk=16)
+            jax.block_until_ready(s.events_handled)
+            row["compile_plus_run_s"] = round(time.perf_counter() - t0, 3)
+            walls = []
+            tr = Tracker()
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                s = run_ensemble_until(
+                    ens0, end, model, tables, cfg,
+                    rounds_per_chunk=16, tracker=tr,
+                )
+                jax.block_until_ready(s.events_handled)
+                walls.append(time.perf_counter() - t0)
+            wall = min(walls)
+            launch_s = tr.phase_totals().get("chunk_launch", 0.0) / reps
+            row.update(
+                wall_s=round(wall, 4),
+                wall_per_replica_ms=round(wall / r_count * 1e3, 2),
+                launch_wall_s=round(launch_s, 4),
+                launch_per_replica_ms=round(launch_s / r_count * 1e3, 3),
+                events=int(np.asarray(s.events_handled).sum()),
+            )
+            if base_per_replica is None:
+                base_per_replica = wall / r_count
+            else:
+                row["speedup_per_replica_vs_r1"] = round(
+                    base_per_replica / (wall / r_count), 2
+                )
+        except Exception as e:  # noqa: BLE001 — one R failing (e.g. OOM at
+            # 32 on a small backend) must not kill the smaller rows
+            row["error"] = str(e)[:300]
+        out["rows"][r_count] = row
+        print(json.dumps({"ensemble_r": r_count, **row}), flush=True)
+    return out
+
+
 def main():
     import jax
 
@@ -369,6 +461,7 @@ def main():
     out["engines"] = profile_engines(reps, eng_hosts)
     out["dispatch"] = profile_dispatch(eng_hosts)
     out["checkpoint"] = profile_checkpoint(eng_hosts)
+    out["ensemble"] = profile_ensemble(min(reps, 3))
     print(json.dumps(out), flush=True)
 
 
