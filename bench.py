@@ -192,6 +192,58 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
     eng_env = os.environ.get("SHADOW_TPU_BENCH_ENGINE", "auto")
     engine_choice = None
 
+    # Compile-budget pre-probe (the r05 null fix): BENCH_r05 published
+    # null because ONE rounds_per_chunk=128 compile at full scale blew
+    # the entire 1100 s attempt before any fallback rung ran. Scan
+    # compile cost is ~linear in the scan length, so compiling a TINY
+    # chunk first projects the full-rpc compile wall; if the projection
+    # (times the engines about to compile) doesn't fit the attempt's
+    # deadline, walk 128 -> 32 -> 16 BEFORE paying it. The probe uses
+    # the plain engine, so auto-select mode scales by an extra safety
+    # factor — pump/megakernel lowering (Mosaic) can cost a multiple of
+    # the plain compile, and the guard must err toward smaller chunks:
+    # a too-small rpc costs some dispatch overhead, a too-large one
+    # costs the whole published metric.
+    deadline_s = float(os.environ.get("SHADOW_TPU_BENCH_DEADLINE", 0) or 0)
+    if deadline_s > 0 and rounds_per_chunk > 16:
+        probe_rpc = 4
+        t0p = time.perf_counter()
+        run_until(
+            st0, 10_000_000, model, tables,
+            dataclasses.replace(cfg, engine="plain", pump_k=0),
+            rounds_per_chunk=probe_rpc, tracker=tracker,
+        )
+        probe_wall = time.perf_counter() - t0p
+        # auto: three engine compiles, each of UNKNOWN cost relative to
+        # the plain probe — budget 3 compiles x 2.0 engine-variance
+        # headroom; pinned: one compile of (possibly) a slower engine,
+        # keep the 2.0 headroom
+        n_compiles = (3 if (eng_env == "auto" and pump_env == "auto") else 1) * 2.0
+        budget = deadline_s * 0.45  # leave the rest for the measured run
+        chosen = rounds_per_chunk
+        for cand in (rounds_per_chunk, 32, 16):
+            if cand > rounds_per_chunk:
+                continue
+            chosen = cand
+            if probe_wall * (cand / probe_rpc) * n_compiles <= budget:
+                break
+        print(
+            json.dumps(
+                {
+                    "compile_probe": {
+                        "probe_rpc": probe_rpc,
+                        "probe_wall_s": round(probe_wall, 2),
+                        "deadline_s": deadline_s,
+                        "n_compiles": n_compiles,
+                        "requested_rpc": rounds_per_chunk,
+                        "chosen_rpc": chosen,
+                    }
+                }
+            ),
+            flush=True,
+        )
+        rounds_per_chunk = chosen
+
     def _engine_cfg(name, k):
         # pin the engine by NAME, never implicitly via pump_k: the cfg a
         # trial runs must be the engine its label (and the published
@@ -290,6 +342,9 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
         "rate": sim_sec / wall,
         "wall_s": round(wall, 2),
         "recoveries": len(recoveries),
+        # the rpc actually measured (the compile pre-probe may have
+        # walked it down from the requested value)
+        "rounds_per_chunk": rounds_per_chunk,
         "events": int(np.asarray(st.events_handled).sum()),
         "streams_done": int(np.asarray(st.model.streams_done).sum()),
         "bytes_down": int(np.asarray(st.model.bytes_down).sum()),
@@ -435,6 +490,71 @@ def _measure_ensemble(num_hosts: int, sim_sec: float, replica_counts=(1, 8, 32))
     return out
 
 
+def _measure_sweep(num_hosts: int, jobs: int = 8, capacity: int = 4):
+    """Sweep trial (runs in a disposable child, role=sweep): an 8-job
+    phold seed sweep through the PRODUCTION SweepService
+    (runtime/sweep.py, docs/service.md) — the simulation-as-a-service
+    throughput number. Capacity 4 packs the 8 jobs into two R=4
+    ensemble batches sharing ONE compiled executable through the
+    fingerprint-keyed compile cache, so the trial demonstrates both
+    levers at once: jobs/hour (batching amortization) and the cache hit
+    rate (the second batch pays zero compile)."""
+    import tempfile
+
+    from shadow_tpu.config.sweep import load_sweep_spec
+    from shadow_tpu.runtime.sweep import SweepService
+
+    base = {
+        "general": {"stop_time": "100 ms", "heartbeat_interval": None},
+        "network": {"graph": {"type": "1_gbit_switch"}},
+        "experimental": {"rounds_per_chunk": 16},
+        "hosts": {
+            "peer": {
+                "network_node_id": 0,
+                "quantity": num_hosts,
+                "processes": [
+                    {
+                        "path": "phold",
+                        "args": {"min_delay": "1 ms", "max_delay": "8 ms"},
+                    }
+                ],
+            }
+        },
+    }
+    with tempfile.TemporaryDirectory() as d:
+        spec = load_sweep_spec(
+            {
+                "sweep": {
+                    "name": "bench",
+                    "config": base,
+                    "output_dir": os.path.join(d, "out"),
+                    "capacity": capacity,
+                    "jobs": [{"name": "ph", "seed_range": [0, jobs]}],
+                }
+            }
+        )
+        svc = SweepService(spec)
+        t0 = time.perf_counter()
+        manifest = svc.run()
+        wall = time.perf_counter() - t0
+    return {
+        "hosts": num_hosts,
+        "jobs": jobs,
+        "capacity": capacity,
+        "wall_s": round(wall, 2),
+        "jobs_done": manifest["jobs_done"],
+        "jobs_per_hour": round(manifest["jobs_done"] / wall * 3600, 1)
+        if wall > 0
+        else None,
+        "preemptions": manifest["preemptions"],
+        "compile_cache": manifest["compile_cache"],
+        "batches": [
+            {k: b[k] for k in ("index", "replicas", "status", "wall_seconds")}
+            for b in manifest["batches"]
+        ],
+    }
+
+
 def _child_env(**extra) -> dict:
     env = dict(os.environ)
     env.update({k: str(v) for k, v in extra.items()})
@@ -470,7 +590,11 @@ def _run_attempt(env: dict, timeout_s: float) -> dict:
     {ok, result?, partial?, error?, failure?} where partial carries the
     furthest progress line seen before a crash/timeout and failure is the
     structured {kind, recoveries} record bench JSONs publish for
-    failed/aborted trials."""
+    failed/aborted trials. The child learns its own wall budget via
+    SHADOW_TPU_BENCH_DEADLINE so it can pre-probe compile cost and walk
+    rounds_per_chunk down BEFORE burning the budget (the r05 null)."""
+    env = dict(env)
+    env["SHADOW_TPU_BENCH_DEADLINE"] = str(timeout_s)
     t0 = time.perf_counter()
     try:
         r = subprocess.run(
@@ -493,7 +617,7 @@ def _run_attempt(env: dict, timeout_s: float) -> dict:
         timed_out = True
 
     result, last_progress, engine_trials = None, None, {}
-    last_phases, recoveries = None, []
+    last_phases, recoveries, compile_probe = None, [], None
     for ln in out_lines:
         try:
             obj = json.loads(ln)
@@ -503,6 +627,10 @@ def _run_attempt(env: dict, timeout_s: float) -> dict:
             last_progress = obj
             if obj.get("phases"):
                 last_phases = obj["phases"]
+        elif "compile_probe" in obj:
+            # the rpc-budget decision prints before any big compile, so
+            # even a failed attempt records what was chosen and why
+            compile_probe = obj["compile_probe"]
         elif "backend" in obj:
             result = obj
         elif "recovery" in obj:
@@ -514,7 +642,10 @@ def _run_attempt(env: dict, timeout_s: float) -> dict:
             # so even a timed-out attempt records which engine won
             engine_trials[obj["engine_trial"]] = obj["wall"]
     if result is not None:
-        return {"ok": True, "result": result}
+        out = {"ok": True, "result": result}
+        if compile_probe:
+            out["compile_probe"] = compile_probe
+        return out
     rc = None if timed_out else getattr(r, "returncode", None)
     out = {
         "ok": False,
@@ -525,6 +656,8 @@ def _run_attempt(env: dict, timeout_s: float) -> dict:
         },
         "wall_s": round(time.perf_counter() - t0, 1),
     }
+    if compile_probe:
+        out["compile_probe"] = compile_probe
     if last_progress is not None and last_progress.get("wall", 0) > 0:
         out["partial"] = {
             "sim_s_reached": last_progress["progress"] / NS_PER_SEC,
@@ -559,6 +692,10 @@ def main():
         eh = int(os.environ.get("SHADOW_TPU_BENCH_ENSEMBLE_HOSTS", 128))
         es = float(os.environ.get("SHADOW_TPU_BENCH_ENSEMBLE_SIMSEC", 0.1))
         print(json.dumps({"ensemble": _measure_ensemble(eh, es)}))
+        return
+    if role == "sweep":
+        sh = int(os.environ.get("SHADOW_TPU_BENCH_SWEEP_HOSTS", 128))
+        print(json.dumps({"sweep": _measure_sweep(sh)}))
         return
 
     # ---- orchestrator -------------------------------------------------
@@ -865,6 +1002,41 @@ def main():
                     rows.append(obj["ensemble_row"])
             ensemble = {"rows": rows, "partial": True, "error": "timeout"}
 
+    # ---- sweep trial (sweep-scheduler round, docs/service.md): 8-job
+    # phold seed sweep through the production SweepService — jobs/hour
+    # and the compile-cache hit rate (two R=4 batches, one compile).
+    # SHADOW_TPU_BENCH_SWEEP=0 disables. ----------------------------------
+    sweep = None
+    if os.environ.get("SHADOW_TPU_BENCH_SWEEP", "1") != "0" and _time_left() > 150:
+        sh = int(
+            os.environ.get(
+                "SHADOW_TPU_BENCH_SWEEP_HOSTS", 1024 if tpu_up else 128
+            )
+        )
+        env_extra = dict(
+            SHADOW_TPU_BENCH_ROLE="sweep",
+            SHADOW_TPU_BENCH_SWEEP_HOSTS=sh,
+        )
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=_child_env(**env_extra) if tpu_up else _cpu_env(**env_extra),
+                capture_output=True,
+                text=True,
+                timeout=600 if tpu_up else min(420.0, max(_time_left(), 90.0)),
+            )
+            for ln in r.stdout.strip().splitlines():
+                try:
+                    obj = json.loads(ln)
+                except ValueError:
+                    continue
+                if "sweep" in obj:
+                    sweep = obj["sweep"]
+            if sweep is None:
+                sweep = {"error": f"rc={r.returncode}: {r.stderr[-300:]}"}
+        except subprocess.TimeoutExpired:
+            sweep = {"error": "timeout"}
+
     # optional: the old JAX-on-CPU measurement, for the record only
     cpu_xla = None
     if os.environ.get("SHADOW_TPU_BENCH_CPU_XLA") == "1":
@@ -898,6 +1070,7 @@ def main():
                     "native_baseline": base,
                     **({"scaling": scaling} if scaling else {}),
                     **({"ensemble": ensemble} if ensemble else {}),
+                    **({"sweep": sweep} if sweep else {}),
                     **({"cpu_xla": cpu_xla} if cpu_xla else {}),
                     "attempts": [
                         {k: v for k, v in a.items() if k != "result"} for a in attempts_log

@@ -81,6 +81,26 @@ def main(argv: "list[str] | None" = None) -> int:
         "on a CapacityError instead of regrowing the saturated buffer "
         "and replaying (experimental.recover)",
     )
+    sweep_p = sub.add_parser(
+        "sweep",
+        help="run a declarative parameter sweep: many seeds/variants "
+        "packed into ensemble batches through a priority job queue with "
+        "a compile cache and checkpoint-based preemption "
+        "(docs/service.md)",
+    )
+    sweep_p.add_argument("spec", help="path to a sweep spec YAML")
+    sweep_p.add_argument(
+        "--output-dir",
+        metavar="DIR",
+        help="override the spec's output_dir (per-job data dirs and "
+        "sweep-manifest.json land here)",
+    )
+    sweep_p.add_argument(
+        "--show-plan",
+        action="store_true",
+        help="print the packing decision (jobs -> ensemble batches) as "
+        "JSON and exit without running",
+    )
     sub.add_parser(
         "shm-cleanup",
         help="remove stale shared-memory blocks left by crashed runs "
@@ -103,6 +123,18 @@ def main(argv: "list[str] | None" = None) -> int:
                 no_recover=args.no_recover,
                 replicas=args.replicas,
                 replica_seed_stride=args.replica_seed_stride,
+            )
+        except CliUserError as e:
+            print(f"shadow-tpu: error: {e}", file=sys.stderr)
+            return 1
+    if args.command == "sweep":
+        from shadow_tpu.runtime.cli_run import CliUserError, run_sweep
+
+        try:
+            return run_sweep(
+                args.spec,
+                output_dir=args.output_dir,
+                show_plan=args.show_plan,
             )
         except CliUserError as e:
             print(f"shadow-tpu: error: {e}", file=sys.stderr)

@@ -1,3 +1,4 @@
+from shadow_tpu.config.fingerprint import config_fingerprint, fingerprint_dict
 from shadow_tpu.config.options import (
     ConfigOptions,
     GeneralOptions,
@@ -5,6 +6,7 @@ from shadow_tpu.config.options import (
     NetworkOptions,
     ExperimentalOptions,
     ProcessOptions,
+    deep_merge,
     load_config_file,
     load_config_str,
 )
@@ -16,6 +18,9 @@ __all__ = [
     "NetworkOptions",
     "ExperimentalOptions",
     "ProcessOptions",
+    "config_fingerprint",
+    "deep_merge",
+    "fingerprint_dict",
     "load_config_file",
     "load_config_str",
 ]

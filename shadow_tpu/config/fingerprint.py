@@ -1,0 +1,84 @@
+"""The config fingerprint: ONE definition of "same simulated world".
+
+Three subsystems must agree on what makes two configs the same
+trajectory, or their contracts silently diverge:
+
+  * checkpoint validation (runtime/checkpoint.py) — a checkpoint may
+    only resume the exact config it was saved from;
+  * the sweep scheduler's job packing (runtime/sweep.py) — jobs that
+    differ ONLY in seed are the same compiled world and batch into one
+    ensemble program;
+  * the compile cache (runtime/compile_cache.py) — executables are
+    keyed by the fingerprint modulo seed, because the seed enters the
+    simulation exclusively through the initial PRNG key grid
+    (rng.host_keys/replica_keys), never the traced chunk program.
+
+Hence this lives in `shadow_tpu/config`, below all three. The hash
+covers the full processed config minus the knobs that only affect where
+outputs land or how the run is displayed/checkpointed. `tracker` stays
+IN (it changes the TrackerState leaves); `stop_time` stays in (resume
+must target the same horizon for chunk boundaries to line up);
+`replicas`/`replica_seed_stride` stay in (they change the state's
+leading axis and every replica's derived seed — a resume with a
+mismatched replica count must fail HERE with a clear error, never as a
+shape mismatch deep in jax); `engine`/`pump_k` stay in (the engines are
+bit-identical by contract, but pinning them keeps a resumed run on the
+exact executable the checkpoint was written under).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+# general-section keys that only steer output/display/checkpoint
+# plumbing — excluded from the hash (tests/test_config_fingerprint.py
+# pins both directions)
+_DISPLAY_GENERAL_KEYS = (
+    "data_directory",
+    "progress",
+    "log_level",
+    "trace_file",
+    "heartbeat_interval_ns",
+    "checkpoint_dir",
+    "checkpoint_interval_ns",
+    "resume",
+)
+# experimental-section keys that steer the recovery loop, not the
+# trajectory (rollback-and-regrow replays are leaf-exact by contract)
+_RECOVERY_EXPERIMENTAL_KEYS = (
+    "recover",
+    "recovery_max_retries",
+    "recovery_snapshot_chunks",
+)
+
+
+def fingerprint_dict(config) -> dict:
+    """The processed-config dict the fingerprint actually hashes (the
+    trajectory-pinning subset). Exposed so tests and tools can see WHAT
+    is covered without reverse-engineering the hash."""
+    d = config.to_dict()
+    g = d.get("general", {})
+    for k in _DISPLAY_GENERAL_KEYS:
+        g.pop(k, None)
+    e = d.get("experimental", {})
+    for k in _RECOVERY_EXPERIMENTAL_KEYS:
+        e.pop(k, None)
+    return d
+
+
+def config_fingerprint(config, *, exclude_seed: bool = False) -> str:
+    """Hash of everything that pins the simulated trajectory.
+
+    `exclude_seed=True` drops `general.seed` from the hash — the
+    "same world modulo seed" key the sweep scheduler packs jobs by and
+    the compile cache keys executables by (the seed never enters the
+    traced chunk program; see module docstring). Checkpoint validation
+    always uses the full hash.
+    """
+    d = fingerprint_dict(config)
+    if exclude_seed:
+        d.get("general", {}).pop("seed", None)
+    return hashlib.sha256(
+        json.dumps(d, sort_keys=True, default=str).encode()
+    ).hexdigest()

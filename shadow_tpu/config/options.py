@@ -27,6 +27,20 @@ from shadow_tpu.simtime import parse_time_ns
 from shadow_tpu.units import parse_bandwidth_bits_per_sec
 
 
+def deep_merge(base: dict, overrides: dict) -> dict:
+    """Recursive dict merge, overrides winning: nested mappings merge
+    key-by-key, anything else (scalars, lists) replaces wholesale. Used
+    by the sweep spec (config/sweep.py) to derive per-job configs from a
+    base scenario; returns a new dict, inputs untouched."""
+    out = dict(base)
+    for k, v in overrides.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
 def _drop_extension_fields(obj):
     """Strip `x-...` keys anywhere in the tree (reference main.rs:272-291)."""
     if isinstance(obj, dict):

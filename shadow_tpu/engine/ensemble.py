@@ -84,6 +84,7 @@ from shadow_tpu.engine.state import (
     grow_state,
     init_state,
     state_to_host,
+    trace_static_cfg,
 )
 
 # probe lanes that aggregate across replicas as sums; the rest are
@@ -197,6 +198,22 @@ _run_ensemble_chunk_jit = jax.jit(
 )
 
 
+def lower_ensemble_chunk(st, end, rounds_per_chunk, model, tables, cfg):
+    """The compiled-executable reuse seam (runtime/compile_cache.py):
+    AOT-lower the ensemble chunk for this state's shapes. The returned
+    Lowered's .compile() yields an executable called as
+    `exe(st, end, tables)` (statics baked in, input state donated) that
+    `run_ensemble_until` accepts via its `launch` override — so a sweep can pay ONE compile
+    for N same-shape jobs and hold the executable across batches. The
+    static cfg is canonicalized through trace_static_cfg (the seed never
+    enters the traced program), so worlds differing only in seed lower
+    to the identical key."""
+    cfg = trace_static_cfg(ensemble_engine_cfg(cfg))
+    return jax.jit(
+        _run_ensemble_chunk, static_argnums=(2, 3, 5), donate_argnums=(0,)
+    ).lower(st, jnp.asarray(end, jnp.int64), rounds_per_chunk, model, tables, cfg)
+
+
 def _aggregate_probe(rows: np.ndarray) -> ChunkProbe:
     """Collapse the [R, PROBE_LANES] probe to one ChunkProbe for
     progress/heartbeat/checkpoint-cadence consumers: counter lanes sum
@@ -291,14 +308,17 @@ def _finish(out: SimState, final_rows: "dict[int, np.ndarray]") -> SimState:
 
 def _drive_ensemble(
     launch, st, end_time, max_chunks, on_chunk, pipeline, desc,
-    tracker=None, on_state=None,
+    tracker=None, on_state=None, on_rows=None,
 ):
     """The ensemble twin of engine/round.py `_drive`: same depth-2
     pipeline and donation discipline, same two-phase checkpoint commit,
     but the probe is [R, PROBE_LANES] and every termination decision
     reduces per replica. Per-host heartbeats are not emitted here (the
     per-host tensors are [R, H]; the manager disables them for ensemble
-    runs — docs/ensemble.md)."""
+    runs — docs/ensemble.md). `on_rows(rows)` receives the raw
+    [R, PROBE_LANES] numpy probe each chunk, BEFORE aggregation — the
+    sweep scheduler's per-job progress stream (one row per job, zero
+    extra device syncs; runtime/sweep.py)."""
     R = num_replicas(st)
     # Replicas quiescent at ENTRY (a resumed checkpoint whose batch was
     # only partially done) are pre-recorded from the entry state itself:
@@ -328,6 +348,8 @@ def _drive_ensemble(
         fetched += 1
         if int(rows[:, PROBE_OVERFLOW].sum()):
             raise _replica_capacity_error(rows)
+        if on_rows is not None:
+            on_rows(rows)
         probe = _aggregate_probe(rows)
         if on_chunk is not None:
             on_chunk(probe)
@@ -399,6 +421,8 @@ def run_ensemble_until(
     pipeline: bool = True,
     tracker=None,
     on_state=None,
+    on_rows=None,
+    launch=None,
 ) -> SimState:
     """Host-side ensemble driver: chunked vmapped device scans until no
     replica has work left before end_time. `st` is an init_ensemble_state
@@ -407,7 +431,14 @@ def run_ensemble_until(
     ensemble_engine_cfg, so engine="megakernel" transparently runs the
     pump microscan. Everything else matches run_until: depth-2 pipeline,
     donated chunk states, ChunkProbe on_chunk callbacks (aggregated
-    across replicas), tracker spans, on_state checkpoint taps."""
+    across replicas), tracker spans, on_state checkpoint taps.
+    `on_rows(rows)` streams the raw per-replica probe (see
+    _drive_ensemble). `launch` overrides the chunk dispatch with a
+    pre-compiled executable: a callable `exe(st, end, tables) ->
+    (st, probe)` (lower_ensemble_chunk + .compile(), via the sweep
+    scheduler's compile cache) — it must have been lowered for exactly
+    this state shape and a trace_static_cfg-canonicalized version of
+    this cfg."""
     cfg = ensemble_engine_cfg(cfg)
     validate_runahead(cfg, tables)
     num_replicas(st)  # loud on a non-ensemble state
@@ -418,11 +449,25 @@ def run_ensemble_until(
     with _tspan(tracker, "donate_copy"):
         st = st.donatable()
 
-    def launch(s):
-        return _run_ensemble_chunk_jit(s, end, rounds_per_chunk, model, tables, cfg)
+    if launch is None:
+        # seed is canonicalized out of the static cfg so the process-wide
+        # jit cache, like the AOT path, reuses one executable across
+        # same-shape worlds that differ only in seed
+        jit_cfg = trace_static_cfg(cfg)
+
+        def launch(s):
+            return _run_ensemble_chunk_jit(
+                s, end, rounds_per_chunk, model, tables, jit_cfg
+            )
+
+    else:
+        exe = launch
+
+        def launch(s):
+            return exe(s, end, tables)
 
     return _drive_ensemble(
         launch, st, end_time, max_chunks, on_chunk, pipeline,
         desc=f"{max_chunks}x{rounds_per_chunk} rounds",
-        tracker=tracker, on_state=on_state,
+        tracker=tracker, on_state=on_state, on_rows=on_rows,
     )

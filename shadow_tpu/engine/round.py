@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from shadow_tpu import equeue, netstack, rng
-from shadow_tpu.engine.state import EngineConfig, SimState
+from shadow_tpu.engine.state import EngineConfig, SimState, trace_static_cfg
 from shadow_tpu.events import KIND_PACKET, pack_tie
 from shadow_tpu.graph.routing import RoutingTables
 from shadow_tpu.netstack import AUX_SHAPED_BIT, AUX_SIZE_MASK
@@ -1237,8 +1237,13 @@ def run_until(
     with _tspan(tracker, "donate_copy"):
         st = st.donatable()  # the caller's buffers are never donated
 
+    # the seed never enters the traced chunk (it lives in the state's key
+    # grid), so canonicalizing it out of the static cfg lets same-shape
+    # worlds that differ only in seed share one compiled executable
+    jit_cfg = trace_static_cfg(cfg)
+
     def launch(s):
-        return _run_chunk_jit(s, end, rounds_per_chunk, model, tables, cfg)
+        return _run_chunk_jit(s, end, rounds_per_chunk, model, tables, jit_cfg)
 
     return _drive(
         launch, st, end_time, max_chunks, on_chunk, pipeline,

@@ -145,6 +145,21 @@ class EngineConfig:
             raise ValueError("megakernel_tile must be 0 or divide num_hosts")
 
 
+def trace_static_cfg(cfg: EngineConfig) -> EngineConfig:
+    """The executable-reuse seam: `cfg` with every trace-irrelevant field
+    canonicalized, for use as a jit static argument / compile-cache key.
+
+    The seed enters the simulation exclusively through the initial PRNG
+    key grid built on the host (rng.host_keys / rng.replica_keys at
+    init_state / init_ensemble_state time) — no engine, model, or
+    netstack code reads `cfg.seed` inside a traced chunk. Canonicalizing
+    it to 0 here means two worlds differing ONLY in seed hash to the
+    same jit cache key and reuse one compiled chunk executable, which is
+    what lets a sweep of N seeds pay one XLA compile
+    (runtime/compile_cache.py; docs/service.md)."""
+    return dataclasses.replace(cfg, seed=0)
+
+
 @flax.struct.dataclass
 class Outbox:
     """Per-host staging area for packets emitted during a round.

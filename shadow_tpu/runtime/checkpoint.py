@@ -30,7 +30,6 @@ instead of a lost run.
 from __future__ import annotations
 
 import glob
-import hashlib
 import json
 import os
 import signal
@@ -39,6 +38,11 @@ import threading
 import jax
 import numpy as np
 
+# one definition of "same simulated world", shared with the sweep
+# scheduler's packing key and the compile cache (config/fingerprint.py);
+# re-exported here because this module is where checkpoint consumers
+# historically import it from
+from shadow_tpu.config.fingerprint import config_fingerprint  # noqa: F401
 from shadow_tpu.engine.state import SimState, state_from_host
 from shadow_tpu.utils.shadow_log import slog
 
@@ -48,39 +52,6 @@ CHECKPOINT_VERSION = 1
 class CheckpointError(ValueError):
     """A checkpoint could not be used: wrong version, wrong config
     fingerprint, or a corrupt/mismatched leaf set."""
-
-
-def config_fingerprint(config) -> str:
-    """Hash of everything that pins the simulated trajectory: the full
-    processed config minus the knobs that only affect where outputs land
-    or how the run is displayed/checkpointed. `tracker` stays IN (it
-    changes the TrackerState leaves); `stop_time` stays in (resume must
-    target the same horizon for chunk boundaries to line up); `replicas`/
-    `replica_seed_stride` stay in (they change the state's leading axis
-    and every replica's derived seed — a resume with a mismatched replica
-    count must fail HERE with a clear error, never as a shape mismatch
-    deep in jax); `engine`/`pump_k` stay in (the engines are bit-identical
-    by contract, but pinning them keeps a resumed run on the exact
-    executable the checkpoint was written under)."""
-    d = config.to_dict()
-    g = d.get("general", {})
-    for k in (
-        "data_directory",
-        "progress",
-        "log_level",
-        "trace_file",
-        "heartbeat_interval_ns",
-        "checkpoint_dir",
-        "checkpoint_interval_ns",
-        "resume",
-    ):
-        g.pop(k, None)
-    e = d.get("experimental", {})
-    for k in ("recover", "recovery_max_retries", "recovery_snapshot_chunks"):
-        e.pop(k, None)
-    return hashlib.sha256(
-        json.dumps(d, sort_keys=True, default=str).encode()
-    ).hexdigest()
 
 
 def save_checkpoint(path: str, host_state: SimState, meta: dict) -> str:

@@ -94,3 +94,39 @@ def run_from_config(
     if results.unexpected_final_states:
         return 1
     return 0 if results.packets_unroutable == 0 else 1
+
+
+def run_sweep(
+    spec_path: str,
+    output_dir: "str | None" = None,
+    show_plan: bool = False,
+) -> int:
+    """`shadow-tpu sweep` implementation: expand + pack + (optionally)
+    execute a sweep spec (docs/service.md). Exit 0 when every job
+    completed cleanly — a job that finished with unroutable packets
+    counts against the exit code exactly as its standalone
+    `shadow-tpu run` would."""
+    from shadow_tpu.config.sweep import load_sweep_file
+    from shadow_tpu.runtime.sweep import SweepService, render_report
+
+    try:
+        spec = load_sweep_file(spec_path, output_dir=output_dir)
+    except (ValueError, OSError, yaml.YAMLError) as e:
+        raise CliUserError(f"invalid sweep spec: {e}") from e
+    try:
+        service = SweepService(spec)
+    except ValueError as e:
+        raise CliUserError(str(e)) from e
+    if show_plan:
+        print(json.dumps(service.plan(), indent=2))
+        return 0
+    try:
+        manifest = service.run()
+    except (ValueError, OSError) as e:
+        raise CliUserError(str(e)) from e
+    print(render_report(manifest))
+    clean = (
+        manifest["jobs_done"] == manifest["jobs_total"]
+        and manifest["jobs_unroutable"] == 0
+    )
+    return 0 if clean else 1

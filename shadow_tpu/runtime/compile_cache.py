@@ -1,0 +1,103 @@
+"""Fingerprint-keyed compile cache: N same-shape jobs, one XLA compile.
+
+The sweep scheduler (runtime/sweep.py) runs many jobs whose configs
+differ only in seed — the same traced program, the same executable. XLA
+compilation is the dominant fixed cost of a small/medium run (the
+BENCH_r05 null came from one compile blowing the whole budget), so the
+service compiles each distinct world ONCE and reuses the executable
+across every batch that shares it:
+
+  * the user-facing key is the config fingerprint **modulo seed**
+    (config/fingerprint.py `config_fingerprint(cfg, exclude_seed=True)`)
+    plus the batch replica count and rounds_per_chunk — what the sweep
+    spec can distinguish;
+  * the cache appends the state's shape/dtype signature and the
+    canonicalized static EngineConfig (engine/state.py trace_static_cfg)
+    to every key, so even a too-coarse caller key can never alias two
+    different programs — a mismatch compiles a second entry instead of
+    running the wrong executable;
+  * entries are AOT-compiled (engine/ensemble.py lower_ensemble_chunk →
+    .compile()), so "compile" is an explicit, timed event: `misses`
+    counts real XLA compiles, `hits` counts executables reused, and the
+    sweep manifest publishes both (the tier-1 test asserts an 8-job
+    sweep pays exactly one).
+
+Scope: one cache per SweepService (in-process, this run). Persistent
+on-disk caching is jax's own compilation-cache territory, not ours.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+
+
+def state_signature(st) -> tuple:
+    """Shape/dtype signature of a state pytree — the part of the jit
+    cache key the fingerprint does not cover once buffers have been
+    regrown past their config values (rollback-and-regrow)."""
+    leaves = jax.tree.leaves(st)
+    sig = []
+    for l in leaves:
+        try:
+            sig.append((tuple(l.shape), str(l.dtype)))
+        except (AttributeError, TypeError):
+            sig.append((None, str(type(l).__name__)))
+    return tuple(sig)
+
+
+class CompileCache:
+    """Executable cache + compile accounting for chunk programs.
+
+    `get(key, st, build)` returns the cached executable for
+    (key, shapes(st), static cfg) or compiles one via `build()`
+    (timed, counted as a miss). `stats()` is the block the sweep
+    manifest publishes.
+    """
+
+    def __init__(self):
+        self._entries: dict = {}
+        self.hits = 0
+        self.misses = 0
+        self.compile_seconds = 0.0
+        self.compile_walls: "list[float]" = []
+
+    def _full_key(self, key, st, static_cfg) -> tuple:
+        return (key, static_cfg, state_signature(st))
+
+    def get(self, key, st, static_cfg, build):
+        """The executable for this (caller key, state shapes, static
+        cfg), compiling at most once per distinct full key. `build()`
+        must return the callable executable (e.g.
+        lower_ensemble_chunk(...).compile())."""
+        fk = self._full_key(key, st, static_cfg)
+        exe = self._entries.get(fk)
+        if exe is not None:
+            self.hits += 1
+            return exe
+        t0 = time.perf_counter()
+        exe = build()
+        wall = time.perf_counter() - t0
+        self.misses += 1
+        self.compile_seconds += wall
+        self.compile_walls.append(round(wall, 4))
+        self._entries[fk] = exe
+        return exe
+
+    @property
+    def compiles(self) -> int:
+        return self.misses
+
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    def stats(self) -> dict:
+        return {
+            "compiles": self.misses,
+            "hits": self.hits,
+            "hit_rate": round(self.hit_rate(), 4),
+            "compile_seconds": round(self.compile_seconds, 4),
+            "compile_walls": self.compile_walls,
+        }

@@ -56,6 +56,9 @@ class EnsembleRunner:
         rounds_per_chunk: int = 256,
         tx_bytes_per_interval=None,
         rx_bytes_per_interval=None,
+        compile_cache=None,
+        cache_key=None,
+        on_rows=None,
     ):
         if num_replicas < 1:
             raise ValueError("num_replicas must be >= 1")
@@ -70,6 +73,14 @@ class EnsembleRunner:
         self.rounds_per_chunk = rounds_per_chunk
         self.tx_bytes_per_interval = tx_bytes_per_interval
         self.rx_bytes_per_interval = rx_bytes_per_interval
+        # Sweep-scheduler seams (runtime/sweep.py): an AOT compile cache
+        # (runtime/compile_cache.py) keyed under `cache_key` (the config
+        # fingerprint modulo seed) so same-shape batches share one
+        # executable, and a per-replica probe-row stream for sync-free
+        # per-job progress.
+        self.compile_cache = compile_cache
+        self.cache_key = cache_key
+        self.on_rows = on_rows
 
     @property
     def seeds(self) -> "list[int]":
@@ -88,6 +99,28 @@ class EnsembleRunner:
             rx_bytes_per_interval=self.rx_bytes_per_interval,
         )
 
+    def _launch_for(self, st, end_time_ns: int, cfg):
+        """The compile-cache lookup: an AOT-compiled chunk executable for
+        this (fingerprint-modulo-seed key, state shapes, static cfg), or
+        None to use the process-wide jit cache. Recovery regrows change
+        the state shapes, so a regrown replay keys (and compiles) its own
+        entry instead of aliasing the old executable."""
+        if self.compile_cache is None:
+            return None
+        from shadow_tpu.engine.ensemble import lower_ensemble_chunk
+        from shadow_tpu.engine.state import trace_static_cfg
+
+        static_cfg = trace_static_cfg(ensemble_engine_cfg(cfg))
+        return self.compile_cache.get(
+            (self.cache_key, self.rounds_per_chunk),
+            st,
+            static_cfg,
+            lambda: lower_ensemble_chunk(
+                st, end_time_ns, self.rounds_per_chunk, self.model,
+                self.tables, cfg,
+            ).compile(),
+        )
+
     def _runner_factory(self, end_time_ns: int, on_chunk, max_chunks, tracker):
         def factory(cfg):
             def run(st, on_state=None):
@@ -96,6 +129,8 @@ class EnsembleRunner:
                     rounds_per_chunk=self.rounds_per_chunk,
                     max_chunks=max_chunks, on_chunk=on_chunk,
                     tracker=tracker, on_state=on_state,
+                    on_rows=self.on_rows,
+                    launch=self._launch_for(st, end_time_ns, cfg),
                 )
 
             return run

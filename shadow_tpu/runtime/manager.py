@@ -51,6 +51,23 @@ class HostInstance:
 
 
 @dataclasses.dataclass
+class ScriptedWorld:
+    """Everything a scripted-model run needs besides a scheduler: the
+    built model, routing tables, resolved EngineConfig, and the shaping
+    refill vectors. Extracted from Manager.run so other drivers — the
+    sweep scheduler service (runtime/sweep.py) foremost — build the
+    exact world the CLI would, through the exact validation."""
+
+    model: object
+    tables: object
+    ecfg: EngineConfig
+    tx_refill: "object | None"
+    rx_refill: "object | None"
+    host_node: "list[int]"
+    runahead_ns: int
+
+
+@dataclasses.dataclass
 class SimResults:
     hosts: "list[HostInstance]"
     events_handled: int
@@ -205,12 +222,18 @@ class Manager:
             ra = min(self.graph.min_latency_ns(), tables.min_path_latency_ns())
         return ra
 
-    def run(self) -> SimResults:
+    def build_world(self) -> ScriptedWorld:
+        """Build the scripted-model world: validate the model specs,
+        compute routing, resolve the runahead window and shaping
+        refills, and assemble the EngineConfig. The seam the sweep
+        scheduler (runtime/sweep.py) drives batches through."""
         cfgo = self.config
         num_hosts = len(self.hosts)
-
         if self.managed_mode:
-            return self._run_managed()
+            raise ValueError(
+                "build_world() is for scripted-model runs; managed "
+                "executables go through Manager.run()"
+            )
 
         model_names = {h.model_name for h in self.hosts}
         if len(model_names) != 1:
@@ -255,7 +278,28 @@ class Manager:
             pump_k=cfgo.experimental.pump_k,
             tracker=cfgo.general.tracker,
         )
-        ecfg, ckpt, guard, resume_path = self._setup_checkpointing(ecfg)
+        return ScriptedWorld(
+            model=model,
+            tables=tables,
+            ecfg=ecfg,
+            tx_refill=tx_refill,
+            rx_refill=rx_refill,
+            host_node=host_node,
+            runahead_ns=runahead,
+        )
+
+    def run(self) -> SimResults:
+        cfgo = self.config
+        num_hosts = len(self.hosts)
+
+        if self.managed_mode:
+            return self._run_managed()
+
+        world = self.build_world()
+        model, tables = world.model, world.tables
+        host_node, runahead = world.host_node, world.runahead_ns
+        tx_refill, rx_refill = world.tx_refill, world.rx_refill
+        ecfg, ckpt, guard, resume_path = self._setup_checkpointing(world.ecfg)
 
         replicas = cfgo.general.replicas
         if replicas > 1:

@@ -1,0 +1,586 @@
+"""Sweep scheduler service: a multi-tenant job queue over the ensemble
+and checkpoint planes (docs/service.md).
+
+The reference's flagship methodology (Jansen et al., "Once is Never
+Enough", USENIX Security 2021) needs MANY repeated experiments per
+conclusion, and five planes of this repo already exist to serve that —
+bit-exact checkpoints, capacity recovery, the vmapped ensemble runner,
+the sync-free tracker probe. This module is the layer that composes
+them into one serving system:
+
+  * **Expansion** (config/sweep.py): a declarative spec expands into
+    per-seed SweepJobs, each a fully validated single-world config.
+  * **Packing** (`pack_jobs`, pure): jobs with the same config
+    fingerprint **modulo seed** are the same compiled world; runs of
+    seeds in arithmetic progression fold into ONE ensemble batch
+    (replica r ≡ seed base + r*stride, the exactness contract of
+    engine/ensemble.py), capped at the spec's capacity.
+  * **Compile cache** (runtime/compile_cache.py): batch executables are
+    AOT-compiled once per (fingerprint-modulo-seed, R, rounds_per_chunk)
+    and reused — N same-shape jobs pay one XLA compile, including a
+    preempted batch's resume.
+  * **Priority + preemption**: batches run highest-priority-first on a
+    deterministic virtual clock (cumulative sim-time executed, advanced
+    from the per-chunk probe — zero extra device syncs). When a
+    higher-priority batch arrives mid-run, the running batch writes a
+    verified final checkpoint through the existing CheckpointManager/
+    StateTap machinery and re-queues; its later resume is bit-exact
+    (the same machinery tests/test_robustness.py pins).
+  * **Reporting**: every job gets a standalone-equivalent
+    `sim-stats.json` (replica slice ≡ single run, so the file matches a
+    `shadow-tpu run` of that seed modulo wall-clock), and the sweep
+    writes `sweep-manifest.json` — per-job status/progress/recoveries,
+    per-batch packing and preemption records, compile-cache counters,
+    and cross-job aggregate tables.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import json
+import os
+import time
+
+import numpy as np
+
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.config.sweep import SweepJob, SweepSpec
+from shadow_tpu.engine.round import (
+    PROBE_EVENTS,
+    PROBE_NOW,
+    CapacityError,
+    RunInterrupted,
+    host_stats,
+)
+from shadow_tpu.runtime.compile_cache import CompileCache
+from shadow_tpu.runtime.manager import Manager, SimResults
+from shadow_tpu.simtime import NS_PER_SEC, fmt_time_ns
+from shadow_tpu.utils.shadow_log import slog
+
+
+@dataclasses.dataclass
+class Batch:
+    """One packed unit of device work: an ordered run of jobs whose
+    seeds form an arithmetic progression, executed as one [R]-replica
+    ensemble program (job i is replica i, seeded base_seed + i*stride)."""
+
+    jobs: "list[SweepJob]"
+    base_seed: int
+    stride: int
+    priority: int
+    arrival_ns: int
+    group_key: str
+    index: int = -1
+    # mutable execution record
+    preemptions: int = 0
+    resume_ckpt: "str | None" = None
+    status: str = "pending"
+    wall_seconds: float = 0.0
+    recoveries: int = 0
+    error: "str | None" = None
+
+    @property
+    def replicas(self) -> int:
+        return len(self.jobs)
+
+    def describe(self) -> dict:
+        return {
+            "index": self.index,
+            "group": self.group_key[:12],
+            "jobs": [j.name for j in self.jobs],
+            "replicas": self.replicas,
+            "base_seed": self.base_seed,
+            "seed_stride": self.stride,
+            "priority": self.priority,
+            "arrival_ns": self.arrival_ns,
+        }
+
+
+def pack_jobs(jobs: "list[SweepJob]", capacity: int = 8) -> "list[Batch]":
+    """The packing decision, as a pure function of the job list (unit-
+    testable without devices — tests/test_sweep_pack.py).
+
+    Jobs group by (fingerprint-modulo-seed, priority, arrival): only
+    identical worlds batch, and a batch must be schedulable as one unit.
+    Within a group, seeds sort ascending and fold into maximal
+    arithmetic-progression runs — the ensemble plane's seeding contract
+    is replica r = base + r*stride (rng.replica_keys), so only an AP of
+    seeds can ride one [R] program — capped at `capacity` replicas.
+    Deterministic: equal inputs always produce the same batch list, in
+    priority-then-arrival order."""
+    if capacity < 1:
+        raise ValueError("capacity must be >= 1")
+    groups: "dict[tuple, list[SweepJob]]" = {}
+    for j in jobs:
+        groups.setdefault((j.group_key, j.priority, j.arrival_ns), []).append(j)
+    batches: "list[Batch]" = []
+    for (gk, prio, arr) in sorted(groups, key=lambda k: (-k[1], k[2], k[0])):
+        js = sorted(groups[(gk, prio, arr)], key=lambda j: j.seed)
+        i = 0
+        while i < len(js):
+            run = [js[i]]
+            stride = 1
+            if i + 1 < len(js):
+                stride = js[i + 1].seed - js[i].seed
+                # stride 0 = the same seed twice (two spec entries over
+                # one world): replica streams must be distinct, so those
+                # jobs run as separate batches
+                if stride > 0:
+                    k = i + 1
+                    while (
+                        k < len(js)
+                        and len(run) < capacity
+                        and js[k].seed == run[-1].seed + stride
+                    ):
+                        run.append(js[k])
+                        k += 1
+            if len(run) == 1:
+                stride = 1
+            batches.append(
+                Batch(
+                    jobs=run,
+                    base_seed=run[0].seed,
+                    stride=stride,
+                    priority=prio,
+                    arrival_ns=arr,
+                    group_key=gk,
+                )
+            )
+            i += len(run)
+    for i, b in enumerate(batches):
+        b.index = i
+    return batches
+
+
+class _PreemptGuard:
+    """The scheduler-owned twin of runtime/checkpoint.py InterruptGuard:
+    same `fired()` surface StateTap consults, armed by the service when
+    a higher-priority batch becomes runnable instead of by a signal. The
+    driver then takes the identical code path — verified final
+    checkpoint, RunInterrupted — that makes resume bit-exact."""
+
+    def __init__(self):
+        self._armed = False
+
+    def arm(self) -> None:
+        self._armed = True
+
+    def fired(self, now_ns: int) -> bool:
+        return self._armed
+
+
+class _Preempted(Exception):
+    pass
+
+
+class SweepService:
+    """Executes a SweepSpec: packs, queues, runs, preempts, reports.
+    One instance per sweep; the compile cache lives for its lifetime."""
+
+    def __init__(self, spec: SweepSpec):
+        self.spec = spec
+        self.cache = CompileCache()
+        self.batches = pack_jobs(spec.jobs, spec.capacity)
+        self.clock_ns = 0  # virtual clock: cumulative sim-time executed
+        self.job_progress: "dict[str, dict]" = {
+            j.name: {"now_ns": 0, "events": 0} for j in spec.jobs
+        }
+        self.job_records: "dict[str, dict]" = {}
+        # Validate every distinct world up front (construction = world
+        # validation, one representative job per fingerprint group), so a
+        # bad scenario fails as a one-line config error BEFORE any batch
+        # has burned a compile — and keep the built Manager: per-job
+        # output writing reuses it instead of re-expanding the world N
+        # times (the hosts/graph/IP expansion is seed-independent).
+        self._group_mgr: "dict[str, Manager]" = {}
+        for j in spec.jobs:
+            if j.group_key in self._group_mgr:
+                continue
+            mgr = Manager(j.config)
+            if mgr.managed_mode:
+                raise ValueError(
+                    f"sweep.jobs.{j.entry}: sweeps run scripted-model "
+                    "scenarios only (the jobs batch onto the device "
+                    "engine); managed executables run via `shadow-tpu run`"
+                )
+            if j.config.experimental.scheduler != "tpu":
+                raise ValueError(
+                    f"sweep.jobs.{j.entry}: sweeps require "
+                    "experimental.scheduler: tpu (jobs batch through the "
+                    "vmapped ensemble plane)"
+                )
+            self._group_mgr[j.group_key] = mgr
+
+    # --- planning --------------------------------------------------------
+
+    def plan(self) -> dict:
+        """The packing decision without running anything (--show-plan)."""
+        return {
+            "sweep": self.spec.name,
+            "jobs": len(self.spec.jobs),
+            "capacity": self.spec.capacity,
+            "batches": [b.describe() for b in self.batches],
+        }
+
+    # --- execution -------------------------------------------------------
+
+    def run(self) -> dict:
+        """Drain the queue: highest priority first among arrived batches,
+        preempting a lower-priority run when a higher one arrives.
+        Returns (and writes) the sweep manifest."""
+        t0 = time.perf_counter()
+        os.makedirs(self.spec.output_dir, exist_ok=True)
+        pending = list(self.batches)
+        while pending:
+            ready = [b for b in pending if b.arrival_ns <= self.clock_ns]
+            if not ready:
+                # idle queue: fast-forward the virtual clock to the next
+                # arrival (nothing is executing, so no sim time passes)
+                self.clock_ns = min(b.arrival_ns for b in pending)
+                continue
+            batch = min(ready, key=lambda b: (-b.priority, b.arrival_ns, b.index))
+            pending.remove(batch)
+            try:
+                self._run_batch(batch, pending)
+            except _Preempted:
+                batch.preemptions += 1
+                batch.status = "preempted"
+                slog(
+                    "info", self.clock_ns, "sweep",
+                    f"batch {batch.index} preempted "
+                    f"(checkpoint: {batch.resume_ckpt or 'none — restarts'})",
+                )
+                pending.append(batch)
+        manifest = self._manifest(time.perf_counter() - t0)
+        path = os.path.join(self.spec.output_dir, "sweep-manifest.json")
+        with open(path, "w") as f:
+            json.dump(manifest, f, indent=2)
+        return manifest
+
+    def _batch_config(self, batch: Batch) -> ConfigOptions:
+        """The ensemble config a batch runs under: the first job's
+        resolved raw config with the replica axis folded in. Sound
+        because every job in the batch shares the fingerprint modulo
+        seed — the configs are identical except for the seed."""
+        raw = copy.deepcopy(batch.jobs[0].raw_config)
+        g = raw.setdefault("general", {})
+        g["seed"] = batch.base_seed
+        g["replicas"] = batch.replicas
+        g["replica_seed_stride"] = batch.stride
+        g["data_directory"] = self._batch_dir(batch)
+        return ConfigOptions.from_dict(raw)
+
+    def _batch_dir(self, batch: Batch) -> str:
+        return os.path.join(self.spec.output_dir, "batches", f"b{batch.index:03d}")
+
+    def _run_batch(self, batch: Batch, pending: "list[Batch]") -> None:
+        from shadow_tpu.config.fingerprint import config_fingerprint
+        from shadow_tpu.runtime.checkpoint import (
+            CheckpointManager,
+            load_checkpoint,
+            peek_checkpoint_meta,
+        )
+        from shadow_tpu.runtime.ensemble import EnsembleRunner
+        from shadow_tpu.runtime.recovery import RecoveryPolicy
+
+        cfgo = self._batch_config(batch)
+        mgr = Manager(cfgo)  # construction = world validation
+        world = mgr.build_world()
+        end = cfgo.general.stop_time_ns
+        fingerprint = config_fingerprint(cfgo)
+
+        # a preempted run may have regrown its buffers: resume at the
+        # checkpoint's recorded widths (Manager._setup_checkpointing does
+        # the same for --resume)
+        ecfg = world.ecfg
+        if batch.resume_ckpt is not None:
+            meta = peek_checkpoint_meta(batch.resume_ckpt)
+            overrides = {}
+            qc, oc = meta.get("queue_capacity"), meta.get("outbox_capacity")
+            if qc and oc:
+                overrides.update(queue_capacity=qc, outbox_capacity=oc)
+            for knob in ("deliver_lanes", "a2a_capacity"):
+                if knob in meta:
+                    overrides[knob] = meta[knob]
+            if any(overrides.get(k) != getattr(ecfg, k) for k in overrides):
+                ecfg = dataclasses.replace(ecfg, **overrides)
+
+        rows_map = {j.name: r for r, j in enumerate(batch.jobs)}
+
+        def on_rows(rows):
+            # raw [R, PROBE_LANES] probe: one row per job, already
+            # fetched by the driver — per-job progress costs zero syncs
+            for name, r in rows_map.items():
+                self.job_progress[name] = {
+                    "now_ns": int(rows[r, PROBE_NOW]),
+                    "events": int(rows[r, PROBE_EVENTS]),
+                }
+
+        runner = EnsembleRunner(
+            world.model,
+            world.tables,
+            ecfg,
+            num_replicas=batch.replicas,
+            seed_stride=batch.stride,
+            rounds_per_chunk=cfgo.experimental.rounds_per_chunk,
+            tx_bytes_per_interval=world.tx_refill,
+            rx_bytes_per_interval=world.rx_refill,
+            compile_cache=self.cache,
+            cache_key=batch.group_key,
+            on_rows=on_rows,
+        )
+
+        start_state = None
+        start_now = 0
+        if batch.resume_ckpt is not None:
+            start_state, meta = load_checkpoint(
+                batch.resume_ckpt, runner.initial_state(), fingerprint
+            )
+            start_now = int(meta["now_ns"])
+            slog("info", start_now, "sweep",
+                 f"batch {batch.index} resuming from {batch.resume_ckpt}")
+
+        ckpt_dir = os.path.join(self._batch_dir(batch), "ckpts")
+        # interval 0: no periodic cadence — the only writes are the
+        # verified final checkpoint a preemption commits
+        ckpt = CheckpointManager(ckpt_dir, 0, fingerprint)
+        guard = _PreemptGuard()
+        recovery = None
+        if cfgo.experimental.recover:
+            recovery = RecoveryPolicy(
+                max_recoveries=cfgo.experimental.recovery_max_retries,
+                snapshot_interval_chunks=cfgo.experimental.recovery_snapshot_chunks,
+            )
+
+        last_now = [start_now]
+        hb_ns = cfgo.general.heartbeat_interval_ns
+        last_hb = [0]
+
+        def on_chunk(probe):
+            # the aggregated probe's `now` follows the slowest replica;
+            # its delta is the sim time this batch just executed
+            self.clock_ns += max(0, probe.now - last_now[0])
+            last_now[0] = probe.now
+            if any(
+                b.arrival_ns <= self.clock_ns and b.priority > batch.priority
+                for b in pending
+            ):
+                guard.arm()
+            if hb_ns > 0 and self.clock_ns - last_hb[0] >= hb_ns:
+                last_hb[0] = self.clock_ns
+                slog(
+                    "info", probe.now, "sweep",
+                    f"batch {batch.index} [{batch.jobs[0].entry}] "
+                    f"{batch.replicas} job(s): sim time {fmt_time_ns(probe.now)}, "
+                    f"{probe.events_handled} events "
+                    f"(service clock {fmt_time_ns(self.clock_ns)})",
+                )
+
+        slog(
+            "info", self.clock_ns, "sweep",
+            f"batch {batch.index} starting: jobs "
+            f"{[j.name for j in batch.jobs]} (R={batch.replicas}, "
+            f"base seed {batch.base_seed}, stride {batch.stride}, "
+            f"priority {batch.priority})",
+        )
+        t0 = time.perf_counter()
+        try:
+            final = runner.run(
+                end,
+                on_chunk=on_chunk,
+                start_state=start_state,
+                checkpoints=ckpt,
+                guard=guard,
+                recovery=recovery,
+            )
+        except RunInterrupted:
+            batch.wall_seconds += time.perf_counter() - t0
+            batch.resume_ckpt = CheckpointManager.latest_path(ckpt_dir)
+            raise _Preempted()
+        except CapacityError as e:
+            batch.wall_seconds += time.perf_counter() - t0
+            batch.status = "failed"
+            batch.error = str(e)
+            for job in batch.jobs:
+                self.job_records[job.name] = self._job_record(
+                    job, batch, status="failed", error=str(e)
+                )
+            slog("warning", self.clock_ns, "sweep",
+                 f"batch {batch.index} failed: {e}")
+            return
+        batch.wall_seconds += time.perf_counter() - t0
+        batch.status = "done"
+        batch.recoveries = len(runner.recovery_report)
+        self._write_batch_outputs(batch, final, end, runner.recovery_report)
+
+    # --- per-job outputs -------------------------------------------------
+
+    def _write_batch_outputs(self, batch, final, end, recovery_report) -> None:
+        from shadow_tpu.engine.ensemble import replica_slice
+
+        hs = host_stats(final)  # ONE bulk fetch for the whole batch
+        wall_per_job = batch.wall_seconds / batch.replicas
+        for r, job in enumerate(batch.jobs):
+            sl_hs = {k: np.asarray(v)[r] for k, v in hs.items()}
+            self._write_job(
+                job, replica_slice(final, r), sl_hs, end, wall_per_job,
+                recovery_report,
+            )
+            self.job_records[job.name] = self._job_record(
+                job, batch, status="done",
+                stats={
+                    "events_handled": int(sl_hs["events_handled"].sum()),
+                    "packets_sent": int(sl_hs["packets_sent"].sum()),
+                    "packets_dropped": int(sl_hs["packets_dropped"].sum()),
+                    "packets_unroutable": int(
+                        sl_hs["packets_unroutable"].sum()
+                    ),
+                    "bytes_sent": int(sl_hs["bytes_sent"].sum()),
+                },
+                wall_seconds=round(wall_per_job, 4),
+            )
+
+    def _write_job(self, job, final_slice, sl_hs, end, wall, recovery_report):
+        """Publish one job's data dir exactly as a standalone
+        `shadow-tpu run` of that seed would: sim-stats.json (the replica
+        slice is leaf-identical to the standalone final state, so every
+        counter matches; wall-clock fields necessarily differ),
+        processed-config.json, and the hosts file. The group's validated
+        Manager is reused with the job's config swapped in — host
+        expansion and IP assignment are seed-independent, so the world
+        is never re-built per job."""
+        jmgr = self._group_mgr[job.group_key]
+        jmgr.config = job.config
+        results = SimResults(
+            hosts=jmgr.hosts,
+            events_handled=int(sl_hs["events_handled"].sum()),
+            packets_sent=int(sl_hs["packets_sent"].sum()),
+            packets_dropped=int(sl_hs["packets_dropped"].sum()),
+            packets_unroutable=int(sl_hs["packets_unroutable"].sum()),
+            wall_seconds=wall,
+            sim_seconds=end / NS_PER_SEC,
+            scheduler="tpu",
+        )
+        if recovery_report:
+            results.extra_stats["recovery"] = {
+                "count": len(recovery_report),
+                "events": list(recovery_report),
+            }
+        if job.config.general.tracker:
+            from shadow_tpu.utils.tracker import Tracker
+
+            tracker = Tracker(counters=True, host_heartbeats=False)
+            jmgr._fold_tracker(
+                tracker, results, end, final_state=final_slice,
+                host_tensors=sl_hs,
+            )
+        jmgr._write_outputs(results)
+
+    def _job_record(self, job, batch, status, stats=None, error=None,
+                    wall_seconds=None) -> dict:
+        rec = {
+            "name": job.name,
+            "entry": job.entry,
+            "seed": job.seed,
+            "priority": job.priority,
+            "arrival_ns": job.arrival_ns,
+            "group": job.group_key[:12],
+            "batch": batch.index,
+            "status": status,
+            "data_directory": job.config.general.data_directory,
+            "preemptions": batch.preemptions,
+            "recoveries": batch.recoveries,
+            "progress": dict(self.job_progress[job.name]),
+        }
+        if wall_seconds is not None:
+            rec["wall_seconds"] = wall_seconds
+        if stats:
+            rec["stats"] = stats
+        if error:
+            rec["error"] = error[:300]
+        return rec
+
+    # --- reporting -------------------------------------------------------
+
+    def _manifest(self, wall: float) -> dict:
+        from shadow_tpu.runtime.ensemble import _agg
+
+        jobs = [
+            self.job_records.get(
+                j.name,
+                {"name": j.name, "status": "not-run"},
+            )
+            for j in self.spec.jobs
+        ]
+        done = [r for r in jobs if r.get("status") == "done"]
+        aggregate = {}
+        by_entry: "dict[str, list[dict]]" = {}
+        for r in done:
+            by_entry.setdefault(r["entry"], []).append(r)
+        for entry, rs in sorted(by_entry.items()):
+            aggregate[entry] = {
+                metric: _agg([r["stats"][metric] for r in rs])
+                for metric in ("events_handled", "packets_sent", "bytes_sent")
+            }
+        return {
+            "sweep": self.spec.name,
+            "output_dir": self.spec.output_dir,
+            "wall_seconds": round(wall, 4),
+            "service_clock_ns": self.clock_ns,
+            "jobs_total": len(self.spec.jobs),
+            "jobs_done": len(done),
+            "jobs_failed": sum(1 for r in jobs if r.get("status") == "failed"),
+            # standalone-parity signal: `shadow-tpu run` exits nonzero on
+            # unroutable packets, so the sweep's exit code must too
+            "jobs_unroutable": sum(
+                1
+                for r in done
+                if r.get("stats", {}).get("packets_unroutable", 0) > 0
+            ),
+            "preemptions": sum(b.preemptions for b in self.batches),
+            "compile_cache": self.cache.stats(),
+            "batches": [
+                {**b.describe(), "status": b.status,
+                 "wall_seconds": round(b.wall_seconds, 4),
+                 "preemptions": b.preemptions, "recoveries": b.recoveries,
+                 **({"error": b.error[:300]} if b.error else {})}
+                for b in self.batches
+            ],
+            "jobs": jobs,
+            "aggregate": aggregate,
+        }
+
+
+def render_report(manifest: dict) -> str:
+    """The human-readable sweep-level report: one line per job plus the
+    cross-job aggregate tables and the compile-cache accounting."""
+    lines = [
+        f"sweep {manifest['sweep']}: {manifest['jobs_done']}/"
+        f"{manifest['jobs_total']} jobs done, "
+        f"{manifest['jobs_failed']} failed, "
+        f"{manifest['preemptions']} preemption(s), "
+        f"{manifest['wall_seconds']:.2f}s wall",
+        f"compile cache: {manifest['compile_cache']['compiles']} compile(s), "
+        f"{manifest['compile_cache']['hits']} hit(s) "
+        f"(hit rate {manifest['compile_cache']['hit_rate']:.2f}, "
+        f"{manifest['compile_cache']['compile_seconds']:.2f}s compiling)",
+        f"{'job':<24} {'seed':>5} {'prio':>4} {'batch':>5} {'status':<9} "
+        f"{'events':>10} {'packets':>9}",
+    ]
+    for r in manifest["jobs"]:
+        s = r.get("stats", {})
+        lines.append(
+            f"{r.get('name', '?'):<24} {r.get('seed', '?'):>5} "
+            f"{r.get('priority', 0):>4} {r.get('batch', '-'):>5} "
+            f"{r.get('status', '?'):<9} "
+            f"{s.get('events_handled', '-'):>10} "
+            f"{s.get('packets_sent', '-'):>9}"
+        )
+    for entry, table in manifest.get("aggregate", {}).items():
+        ev = table["events_handled"]
+        lines.append(
+            f"aggregate [{entry}]: events mean={ev['mean']} "
+            f"stddev={ev['stddev']} ci95={ev['ci95']}"
+        )
+    return "\n".join(lines)

@@ -1,0 +1,97 @@
+"""Unit tests for the public config fingerprint helper
+(shadow_tpu/config/fingerprint.py): ONE definition shared by checkpoint
+validation, the sweep scheduler's packing key, and the compile cache."""
+
+from shadow_tpu.config import config_fingerprint, fingerprint_dict, load_config_str
+from shadow_tpu.runtime import checkpoint as ckpt_mod
+
+CONFIG = """
+general:
+  stop_time: 1 s
+  seed: {seed}
+  data_directory: d1
+hosts:
+  peer:
+    network_node_id: 0
+    quantity: 4
+    processes:
+      - path: phold
+        args:
+          min_delay: "2 ms"
+          max_delay: "12 ms"
+"""
+
+
+def _cfg(seed=1):
+    return load_config_str(CONFIG.format(seed=seed))
+
+
+def test_checkpoint_module_reexports_the_same_function():
+    """runtime/checkpoint.py and the config package must share ONE
+    definition — the compile cache and checkpoint validation key off the
+    identical hash."""
+    assert ckpt_mod.config_fingerprint is config_fingerprint
+
+
+def test_fingerprint_stable_and_seed_sensitive():
+    assert config_fingerprint(_cfg(1)) == config_fingerprint(_cfg(1))
+    assert config_fingerprint(_cfg(1)) != config_fingerprint(_cfg(2))
+
+
+def test_exclude_seed_groups_worlds_modulo_seed():
+    """The sweep packing / compile-cache key: seeds collapse, every
+    other trajectory knob still separates."""
+    a, b = _cfg(1), _cfg(2)
+    assert config_fingerprint(a, exclude_seed=True) == config_fingerprint(
+        b, exclude_seed=True
+    )
+    c = _cfg(1)
+    c.experimental.pump_k = 4
+    assert config_fingerprint(a, exclude_seed=True) != config_fingerprint(
+        c, exclude_seed=True
+    )
+    d = _cfg(1)
+    d.general.stop_time_ns *= 2
+    assert config_fingerprint(a, exclude_seed=True) != config_fingerprint(
+        d, exclude_seed=True
+    )
+
+
+def test_display_knobs_do_not_move_the_hash():
+    a = _cfg(1)
+    b = _cfg(1)
+    b.general.data_directory = "elsewhere"
+    b.general.progress = True
+    b.general.log_level = "debug"
+    b.general.checkpoint_dir = "ckpts"
+    b.general.resume = True
+    b.experimental.recover = False
+    b.experimental.recovery_max_retries = 9
+    assert config_fingerprint(a) == config_fingerprint(b)
+
+
+def test_trajectory_knobs_move_the_hash():
+    base = config_fingerprint(_cfg(1))
+    for mutate in (
+        lambda c: setattr(c.general, "replicas", 2),
+        lambda c: setattr(c.general, "replica_seed_stride", 5),
+        lambda c: setattr(c.general, "tracker", True),
+        lambda c: setattr(c.experimental, "engine", "plain"),
+        lambda c: setattr(c.experimental, "queue_capacity", 128),
+    ):
+        c = _cfg(1)
+        mutate(c)
+        assert config_fingerprint(c) != base
+
+
+def test_fingerprint_dict_drops_exactly_the_display_keys():
+    d = fingerprint_dict(_cfg(1))
+    g = d["general"]
+    for k in ("data_directory", "progress", "log_level", "trace_file",
+              "checkpoint_dir", "resume"):
+        assert k not in g
+    assert "seed" in g and "stop_time_ns" in g and "tracker" in g
+    e = d["experimental"]
+    for k in ("recover", "recovery_max_retries", "recovery_snapshot_chunks"):
+        assert k not in e
+    assert "engine" in e and "pump_k" in e

@@ -1,0 +1,215 @@
+"""Device-free unit tests for the sweep scheduler's pure seams: the
+pack_jobs batching decision (runtime/sweep.py), the compile cache's
+keying/counting (runtime/compile_cache.py), and sweep spec expansion
+(config/sweep.py)."""
+
+import numpy as np
+import pytest
+
+from shadow_tpu.config.sweep import SweepJob, load_sweep_spec
+from shadow_tpu.runtime.compile_cache import CompileCache, state_signature
+from shadow_tpu.runtime.sweep import pack_jobs
+
+
+def _job(seed, group="g1", priority=0, arrival=0):
+    return SweepJob(
+        name=f"j-s{seed}",
+        entry="j",
+        seed=seed,
+        priority=priority,
+        arrival_ns=arrival,
+        config=None,
+        raw_config={},
+        group_key=group,
+    )
+
+
+# --- pack_jobs ----------------------------------------------------------
+
+
+def test_pack_consecutive_seeds_one_batch():
+    batches = pack_jobs([_job(s) for s in range(8)], capacity=8)
+    assert len(batches) == 1
+    b = batches[0]
+    assert b.replicas == 8 and b.base_seed == 0 and b.stride == 1
+
+
+def test_pack_caps_at_capacity():
+    batches = pack_jobs([_job(s) for s in range(8)], capacity=3)
+    assert [b.replicas for b in batches] == [3, 3, 2]
+    assert [b.base_seed for b in batches] == [0, 3, 6]
+    assert all(b.stride == 1 for b in batches)
+
+
+def test_pack_arithmetic_progression_stride():
+    """Replica r of an ensemble MUST be seeded base + r*stride
+    (rng.replica_keys), so only arithmetic progressions may fold."""
+    batches = pack_jobs([_job(s) for s in (3, 5, 7)], capacity=8)
+    assert len(batches) == 1
+    assert batches[0].stride == 2 and batches[0].base_seed == 3
+
+
+def test_pack_non_progression_splits():
+    batches = pack_jobs([_job(s) for s in (1, 4, 6)], capacity=8)
+    # greedy from the sorted front: [1, 4] (stride 3), then [6]
+    assert [(b.base_seed, b.replicas, b.stride) for b in batches] == [
+        (1, 2, 3),
+        (6, 1, 1),
+    ]
+
+
+def test_pack_groups_by_fingerprint_and_priority():
+    jobs = [_job(0, "gA"), _job(1, "gA"), _job(0, "gB"), _job(2, "gA", priority=5)]
+    batches = pack_jobs(jobs, capacity=8)
+    # different fingerprints and different priorities never share a batch
+    assert len(batches) == 3
+    assert batches[0].priority == 5  # priority order in the plan
+    keys = {(b.group_key, b.priority) for b in batches}
+    assert keys == {("gA", 0), ("gB", 0), ("gA", 5)}
+
+
+def test_pack_deterministic_and_indexed():
+    jobs = [_job(s) for s in (9, 1, 5, 3, 7)]
+    a = pack_jobs(jobs, capacity=4)
+    b = pack_jobs(list(reversed(jobs)), capacity=4)
+    assert [(x.base_seed, x.replicas, x.stride) for x in a] == [
+        (y.base_seed, y.replicas, y.stride) for y in b
+    ]
+    assert [x.index for x in a] == list(range(len(a)))
+    # seeds 1,3,5,7 fold (stride 2, cap 4); 9 overflows to its own batch
+    assert [(x.base_seed, x.replicas) for x in a] == [(1, 4), (9, 1)]
+
+
+def test_pack_duplicate_seed_across_entries_stays_separate():
+    """Two spec entries over the same world with the same seed: replica
+    streams must be distinct (stride >= 1), so they run as separate
+    batches — never a stride-0 'progression'."""
+    a = _job(0)
+    b = _job(0)
+    b.name, b.entry = "k-s0", "k"
+    batches = pack_jobs([a, b, _job(1)], capacity=8)
+    assert sorted(x.replicas for x in batches) == [1, 2]
+    assert all(x.stride >= 1 for x in batches)
+
+
+def test_pack_rejects_bad_capacity():
+    with pytest.raises(ValueError, match="capacity"):
+        pack_jobs([_job(0)], capacity=0)
+
+
+# --- CompileCache -------------------------------------------------------
+
+
+def _state(shape=(4, 8)):
+    return {"a": np.zeros(shape, np.int64), "b": np.zeros(shape[0], np.float32)}
+
+
+def test_compile_cache_counts_hits_and_misses():
+    cache = CompileCache()
+    built = []
+
+    def build():
+        built.append(1)
+        return "exe%d" % len(built)
+
+    st = _state()
+    assert cache.get("k", st, "cfg", build) == "exe1"
+    assert cache.get("k", st, "cfg", build) == "exe1"  # hit: same everything
+    assert (cache.misses, cache.hits) == (1, 1)
+    assert len(built) == 1
+    assert cache.stats()["compiles"] == 1
+    assert cache.stats()["hit_rate"] == 0.5
+
+
+def test_compile_cache_shape_mismatch_never_aliases():
+    """A too-coarse caller key must compile a second entry, never run
+    the wrong executable: the cache appends the state signature."""
+    cache = CompileCache()
+    n = [0]
+
+    def build():
+        n[0] += 1
+        return f"exe{n[0]}"
+
+    assert cache.get("k", _state((4, 8)), "cfg", build) == "exe1"
+    # same caller key, regrown buffers -> different shapes -> fresh entry
+    assert cache.get("k", _state((4, 16)), "cfg", build) == "exe2"
+    # same shapes, different static cfg -> fresh entry
+    assert cache.get("k", _state((4, 8)), "cfg2", build) == "exe3"
+    assert cache.misses == 3 and cache.hits == 0
+
+
+def test_state_signature_covers_shape_and_dtype():
+    assert state_signature(_state((4, 8))) != state_signature(_state((4, 16)))
+    a = {"a": np.zeros(4, np.int64)}
+    b = {"a": np.zeros(4, np.int32)}
+    assert state_signature(a) != state_signature(b)
+
+
+# --- spec expansion -----------------------------------------------------
+
+BASE = {
+    "general": {"stop_time": "100 ms"},
+    "hosts": {
+        "peer": {
+            "network_node_id": 0,
+            "quantity": 4,
+            "processes": [
+                {"path": "phold", "args": {"min_delay": "2 ms", "max_delay": "9 ms"}}
+            ],
+        }
+    },
+}
+
+
+def test_spec_expands_seeds_and_groups_modulo_seed(tmp_path):
+    spec = load_sweep_spec(
+        {
+            "sweep": {
+                "name": "t",
+                "output_dir": str(tmp_path / "out"),
+                "config": BASE,
+                "jobs": [
+                    {"name": "a", "seed_range": [0, 3]},
+                    {"name": "b", "seeds": [5], "overrides": {
+                        "experimental": {"pump_k": 4}}},
+                ],
+            }
+        }
+    )
+    assert [j.name for j in spec.jobs] == ["a-s0", "a-s1", "a-s2", "b-s5"]
+    groups = {j.group_key for j in spec.jobs if j.entry == "a"}
+    assert len(groups) == 1  # seeds collapse to one world
+    (bg,) = {j.group_key for j in spec.jobs if j.entry == "b"}
+    assert bg not in groups  # the override is a different world
+    # per-job configs resolved: seed and data dir are job-specific
+    j = spec.jobs[1]
+    assert j.config.general.seed == 1
+    assert j.config.general.data_directory.endswith("jobs/a-s1")
+
+
+def test_spec_rejects_replicas_duplicates_and_empty(tmp_path):
+    with pytest.raises(ValueError, match="replicas"):
+        load_sweep_spec(
+            {
+                "sweep": {
+                    "config": {**BASE, "general": {"stop_time": "1 s", "replicas": 2}},
+                    "jobs": [{"name": "a", "seeds": [0]}],
+                }
+            }
+        )
+    with pytest.raises(ValueError, match="duplicate seeds"):
+        load_sweep_spec(
+            {"sweep": {"config": BASE,
+                       "jobs": [{"name": "a", "seeds": [0, 0]}]}}
+        )
+    with pytest.raises(ValueError, match="duplicate sweep job name"):
+        load_sweep_spec(
+            {"sweep": {"config": BASE,
+                       "jobs": [{"name": "a", "seeds": [0]},
+                                {"name": "a", "seeds": [1]}]}}
+        )
+    with pytest.raises(ValueError, match="jobs"):
+        load_sweep_spec({"sweep": {"config": BASE, "jobs": []}})
+    with pytest.raises(ValueError, match="exactly one of"):
+        load_sweep_spec({"sweep": {"jobs": [{"name": "a", "seeds": [0]}]}})

@@ -32,6 +32,13 @@ Part 5 (ensemble round): amortized per-replica launch cost vs replica
 count R — wall-clock per replica at R=1/8/32 through the vmapped
 ensemble driver (docs/ensemble.md).
 
+Part 6 (sweep-scheduler round, docs/service.md): cold-compile vs
+cache-hit dispatch wall for the fingerprint-keyed compile cache — the
+AOT compile a world's FIRST batch pays, the ~free executable lookup
+every later same-shape batch pays, and one cached-chunk dispatch — plus
+amortized per-job wall vs sweep size (1/2/4/8 jobs through the
+production SweepService).
+
   python tools/profile_kernels.py [reps] [engine_hosts]
 
 Env knobs: SHADOW_TPU_PROFILE_WIDTHS (comma list, part 1),
@@ -447,6 +454,129 @@ def profile_ensemble(reps: int = 3, hosts: int = 0, replica_counts=(1, 8, 32)):
     return out
 
 
+def profile_sweep(hosts: int = 0, capacity: int = 4):
+    """Part 6 (sweep-scheduler round): what the compile cache buys.
+
+    Cold vs hit: the first batch of a distinct world pays one AOT
+    compile (lower_ensemble_chunk + .compile(), a CompileCache miss);
+    every later same-shape batch acquires the executable from the cache
+    (a dict lookup) — measured against one cached-chunk dispatch wall so
+    the saving is in context. Then sweeps of 1/2/4/8 jobs run through
+    the production SweepService and report wall per job: the amortized
+    per-job overhead the service's packing + caching exist to shrink."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from shadow_tpu.config.sweep import load_sweep_spec
+    from shadow_tpu.engine import EngineConfig
+    from shadow_tpu.engine.ensemble import (
+        ensemble_engine_cfg,
+        init_ensemble_state,
+        lower_ensemble_chunk,
+    )
+    from shadow_tpu.engine.state import trace_static_cfg
+    from shadow_tpu.graph import NetworkGraph, compute_routing
+    from shadow_tpu.models import PholdModel
+    from shadow_tpu.runtime.compile_cache import CompileCache
+    from shadow_tpu.runtime.sweep import SweepService
+    from shadow_tpu.simtime import NS_PER_MS
+
+    h = hosts or (1024 if jax.default_backend() == "tpu" else 128)
+    graph = NetworkGraph.from_gml(
+        "graph [\n  directed 0\n"
+        + "".join(
+            f"  node [ id {i} ]\n"
+            f'  edge [ source {i} target {i} latency "1 ms" ]\n'
+            f'  edge [ source {i} target {(i + 1) % 8} latency "3 ms" ]\n'
+            for i in range(8)
+        )
+        + "]"
+    )
+    tables = compute_routing(graph).with_hosts([i % 8 for i in range(h)])
+    cfg = EngineConfig(num_hosts=h, runahead_ns=graph.min_latency_ns(), seed=7)
+    model = PholdModel(
+        num_hosts=h, min_delay_ns=1 * NS_PER_MS, max_delay_ns=8 * NS_PER_MS
+    )
+    end, rpc = 100 * NS_PER_MS, 16
+    out = {"hosts": h, "capacity": capacity}
+
+    # --- cold compile vs cache hit ---------------------------------------
+    cache = CompileCache()
+    ens0 = init_ensemble_state(cfg, model, capacity)
+    static = trace_static_cfg(ensemble_engine_cfg(cfg))
+
+    def build():
+        return lower_ensemble_chunk(ens0, end, rpc, model, tables, cfg).compile()
+
+    t0 = time.perf_counter()
+    exe = cache.get("world", ens0, static, build)
+    out["cold_compile_s"] = round(time.perf_counter() - t0, 3)
+    t0 = time.perf_counter()
+    exe = cache.get("world", ens0, static, build)
+    out["cache_hit_lookup_s"] = round(time.perf_counter() - t0, 6)
+    st = ens0.donatable()
+    end_arr = jnp.asarray(end, jnp.int64)
+    st, probe = exe(st, end_arr, tables)  # warm dispatch (donates st)
+    jax.block_until_ready(probe)
+    st2 = ens0.donatable()
+    t0 = time.perf_counter()
+    st2, probe = exe(st2, end_arr, tables)
+    jax.block_until_ready(probe)
+    out["cached_chunk_dispatch_s"] = round(time.perf_counter() - t0, 4)
+    assert cache.misses == 1 and cache.hits == 1
+
+    # --- amortized per-job overhead vs sweep size ------------------------
+    base = {
+        "general": {"stop_time": "100 ms", "heartbeat_interval": None},
+        "network": {"graph": {"type": "1_gbit_switch"}},
+        "experimental": {"rounds_per_chunk": rpc},
+        "hosts": {
+            "peer": {
+                "network_node_id": 0,
+                "quantity": h,
+                "processes": [
+                    {
+                        "path": "phold",
+                        "args": {"min_delay": "1 ms", "max_delay": "8 ms"},
+                    }
+                ],
+            }
+        },
+    }
+    rows = []
+    for jobs in (1, 2, 4, 8):
+        with tempfile.TemporaryDirectory() as d:
+            spec = load_sweep_spec(
+                {
+                    "sweep": {
+                        "config": base,
+                        "output_dir": os.path.join(d, "out"),
+                        "capacity": capacity,
+                        "jobs": [{"name": "ph", "seed_range": [0, jobs]}],
+                    }
+                }
+            )
+            svc = SweepService(spec)
+            t0 = time.perf_counter()
+            manifest = svc.run()
+            wall = time.perf_counter() - t0
+        rows.append(
+            {
+                "jobs": jobs,
+                "wall_s": round(wall, 3),
+                "wall_per_job_s": round(wall / jobs, 3),
+                "compiles": manifest["compile_cache"]["compiles"],
+                "cache_hits": manifest["compile_cache"]["hits"],
+            }
+        )
+        print(json.dumps({"sweep_size": rows[-1]}), flush=True)
+    out["per_sweep_size"] = rows
+    print(json.dumps({"sweep": out}), flush=True)
+    return out
+
+
 def main():
     import jax
 
@@ -462,6 +592,7 @@ def main():
     out["dispatch"] = profile_dispatch(eng_hosts)
     out["checkpoint"] = profile_checkpoint(eng_hosts)
     out["ensemble"] = profile_ensemble(min(reps, 3))
+    out["sweep"] = profile_sweep()
     print(json.dumps(out), flush=True)
 
 
