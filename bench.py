@@ -167,7 +167,9 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
     lower) is recorded and skipped, never fatal. "plain"/"pump"/
     "megakernel" pins the engine. SHADOW_TPU_BENCH_PUMP_K: an integer
     pins engine=auto at that pump_k (0 = plain; the retry-ladder/CPU
-    knob — exactly one compile)."""
+    knob — exactly one compile). SHADOW_TPU_BENCH_WATCHDOG_S arms the
+    chunk-dispatch watchdog for the main measurement (0 = off); armed
+    re-dispatches land in watchdog_redispatches."""
     import dataclasses
 
     import jax
@@ -317,22 +319,45 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
         )
 
     # the main measurement runs under rollback-and-regrow recovery
-    # (runtime/recovery.py): a capacity blowup at scale regrows the
-    # saturated buffer and replays instead of killing the trial — each
-    # recovery prints a salvageable {"recovery": ...} line the parent
-    # folds into the attempt's structured failure/recovery fields
-    st, recoveries = run_until_recovering(
-        st0,
-        end,
-        model,
-        tables,
-        cfg,
-        rounds_per_chunk=rounds_per_chunk,
-        max_chunks=1_000_000,
-        on_chunk=on_chunk,
-        tracker=tracker,
-        policy=RecoveryPolicy(max_recoveries=2),
-        on_recovery=lambda rec: print(json.dumps({"recovery": rec}), flush=True),
+    # (runtime/recovery.py) AND the engine fallback ladder
+    # (runtime/chaos.py): a capacity blowup at scale regrows the
+    # saturated buffer and replays, a compile failure falls one engine
+    # rung, a watchdog expiry re-dispatches — each event prints a
+    # salvage line ({"recovery": ...} / {"engine_fallback": ...}) the
+    # parent folds into the attempt's structured failure/recovery
+    # fields, so a degraded measurement is VISIBLY degraded in
+    # BENCH_*.json, never silently slower
+    from shadow_tpu.runtime.chaos import run_with_engine_ladder
+
+    # SHADOW_TPU_BENCH_WATCHDOG_S arms the chunk-dispatch watchdog in the
+    # measurement child (0 = off, the default: a contended-CPU smoke has
+    # legitimate multi-second chunks) — when armed, a re-dispatch prints
+    # a salvage line and lands in watchdog_redispatches below
+    watchdog_s = float(os.environ.get("SHADOW_TPU_BENCH_WATCHDOG_S", 0) or 0)
+
+    def attempt(eng_cfg):
+        return run_until_recovering(
+            st0,
+            end,
+            model,
+            tables,
+            eng_cfg,
+            rounds_per_chunk=rounds_per_chunk,
+            max_chunks=1_000_000,
+            on_chunk=on_chunk,
+            tracker=tracker,
+            watchdog_s=watchdog_s,
+            policy=RecoveryPolicy(max_recoveries=2),
+            on_recovery=lambda rec: print(
+                json.dumps({"recovery": rec}), flush=True
+            ),
+        )
+
+    (st, recoveries), fallbacks = run_with_engine_ladder(
+        cfg, attempt,
+        on_fallback=lambda rec: print(
+            json.dumps({"engine_fallback": rec}), flush=True
+        ),
     )
     jax.block_until_ready(st.events_handled)
     wall = time.perf_counter() - t0
@@ -342,6 +367,10 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
         "rate": sim_sec / wall,
         "wall_s": round(wall, 2),
         "recoveries": len(recoveries),
+        "watchdog_redispatches": sum(
+            1 for r in recoveries if r.get("kind") == "watchdog"
+        ),
+        "engine_fallbacks": fallbacks,
         # the rpc actually measured (the compile pre-probe may have
         # walked it down from the requested value)
         "rounds_per_chunk": rounds_per_chunk,
@@ -618,6 +647,7 @@ def _run_attempt(env: dict, timeout_s: float) -> dict:
 
     result, last_progress, engine_trials = None, None, {}
     last_phases, recoveries, compile_probe = None, [], None
+    engine_fallbacks = []
     for ln in out_lines:
         try:
             obj = json.loads(ln)
@@ -637,6 +667,10 @@ def _run_attempt(env: dict, timeout_s: float) -> dict:
             # rollback-and-regrow events print as they happen, so even a
             # later-killed attempt records how many times it recovered
             recoveries.append(obj["recovery"])
+        elif "engine_fallback" in obj:
+            # salvage line: the fallback ladder fired — even a killed
+            # attempt records that it was running a downgraded engine
+            engine_fallbacks.append(obj["engine_fallback"])
         elif "engine_trial" in obj and "wall" in obj:
             # auto-select trial timings print before the main run starts,
             # so even a timed-out attempt records which engine won
@@ -653,6 +687,10 @@ def _run_attempt(env: dict, timeout_s: float) -> dict:
         "failure": {
             "kind": _classify_failure(timed_out, rc, err_tail),
             "recoveries": len(recoveries),
+            "watchdog_redispatches": sum(
+                1 for r in recoveries if r.get("kind") == "watchdog"
+            ),
+            "engine_fallbacks": engine_fallbacks,
         },
         "wall_s": round(time.perf_counter() - t0, 1),
     }

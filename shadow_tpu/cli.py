@@ -81,6 +81,34 @@ def main(argv: "list[str] | None" = None) -> int:
         "on a CapacityError instead of regrowing the saturated buffer "
         "and replaying (experimental.recover)",
     )
+    run_p.add_argument(
+        "--chunk-watchdog",
+        type=float,
+        metavar="SECONDS",
+        help="arm the chunk-dispatch watchdog: a chunk whose completion "
+        "(deadline-bounded probe fetch; launches are async) exceeds "
+        "SECONDS is abandoned and "
+        "re-dispatched from the retained clean snapshot, counted like a "
+        "recovery (experimental.chunk_watchdog_s; 0 = off; "
+        "docs/robustness.md)",
+    )
+    run_p.add_argument(
+        "--chaos-seed",
+        type=int,
+        metavar="N",
+        help="seed for the chaos plane's own PRNG stream (resolves "
+        "'at=auto' fault sites deterministically; chaos.seed; "
+        "docs/robustness.md 'Chaos testing')",
+    )
+    run_p.add_argument(
+        "--chaos-fault",
+        action="append",
+        metavar="SPEC",
+        help="inject a deterministic fault: KIND[@AT][:key=val...], e.g. "
+        "'capacity@2', 'stall@1:stall_s=0.5', 'compile:target=megakernel' "
+        "(repeatable; kinds: capacity, stall, compile, ckpt-corrupt, "
+        "ckpt-truncate, worker-kill, worker-hang, preempt; chaos.faults)",
+    )
     sweep_p = sub.add_parser(
         "sweep",
         help="run a declarative parameter sweep: many seeds/variants "
@@ -123,6 +151,9 @@ def main(argv: "list[str] | None" = None) -> int:
                 no_recover=args.no_recover,
                 replicas=args.replicas,
                 replica_seed_stride=args.replica_seed_stride,
+                chunk_watchdog=args.chunk_watchdog,
+                chaos_seed=args.chaos_seed,
+                chaos_faults=args.chaos_fault,
             )
         except CliUserError as e:
             print(f"shadow-tpu: error: {e}", file=sys.stderr)

@@ -45,11 +45,13 @@ _DISPLAY_GENERAL_KEYS = (
     "resume",
 )
 # experimental-section keys that steer the recovery loop, not the
-# trajectory (rollback-and-regrow replays are leaf-exact by contract)
+# trajectory (rollback-and-regrow replays are leaf-exact by contract;
+# the chunk-dispatch watchdog re-dispatches the same chunks)
 _RECOVERY_EXPERIMENTAL_KEYS = (
     "recover",
     "recovery_max_retries",
     "recovery_snapshot_chunks",
+    "chunk_watchdog_s",
 )
 
 
@@ -64,6 +66,10 @@ def fingerprint_dict(config) -> dict:
     e = d.get("experimental", {})
     for k in _RECOVERY_EXPERIMENTAL_KEYS:
         e.pop(k, None)
+    # the chaos plane injects host-side faults, never a trajectory: a
+    # chaos run that completes is leaf-identical to the fault-free run,
+    # so its checkpoints must resume under either config
+    d.pop("chaos", None)
     return d
 
 

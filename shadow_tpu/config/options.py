@@ -27,6 +27,22 @@ from shadow_tpu.simtime import parse_time_ns
 from shadow_tpu.units import parse_bandwidth_bits_per_sec
 
 
+# the chaos plane's injectable fault catalog (runtime/chaos.py builds
+# FaultPlans from these; defined here so runtime/chaos.py and this
+# module share one catalog without a circular top-level import —
+# ChaosOptions.from_dict lazily borrows FaultSpec for value validation)
+FAULT_KINDS = (
+    "capacity",
+    "stall",
+    "compile",
+    "ckpt-corrupt",
+    "ckpt-truncate",
+    "worker-kill",
+    "worker-hang",
+    "preempt",
+)
+
+
 def deep_merge(base: dict, overrides: dict) -> dict:
     """Recursive dict merge, overrides winning: nested mappings merge
     key-by-key, anything else (scalars, lists) replaces wholesale. Used
@@ -202,6 +218,12 @@ class ExperimentalOptions:
     recover: bool = True
     recovery_max_retries: int = 4
     recovery_snapshot_chunks: int = 32
+    # Chunk-dispatch watchdog (docs/robustness.md): wall-clock seconds a
+    # single chunk dispatch (launch + probe fetch) may take before the
+    # driver abandons the in-flight chunk and re-dispatches from the
+    # retained clean snapshot (counted like a recovery in sim-stats).
+    # 0 = off. CLI: --chunk-watchdog.
+    chunk_watchdog_s: float = 0.0
 
     @classmethod
     def from_dict(cls, d: dict) -> "ExperimentalOptions":
@@ -235,9 +257,12 @@ class ExperimentalOptions:
             "recover",
             "recovery_max_retries",
             "recovery_snapshot_chunks",
+            "chunk_watchdog_s",
         ):
             if k in d:
                 setattr(out, k, d.pop(k))
+        if out.chunk_watchdog_s < 0:
+            raise ValueError("experimental.chunk_watchdog_s must be >= 0")
         if out.strace_logging_mode is False:  # YAML 1.1 parses bare `off` as False
             out.strace_logging_mode = "off"
         if out.strace_logging_mode not in ("off", "standard", "deterministic"):
@@ -261,6 +286,61 @@ class ExperimentalOptions:
                 "(expected 'auto', 'plain', 'pump', or 'megakernel')"
             )
         _reject_unknown("experimental", d)
+        return out
+
+
+@dataclasses.dataclass
+class ChaosOptions:
+    """Deterministic fault injection (docs/robustness.md "Chaos
+    testing"; runtime/chaos.py). `seed` feeds the plan's own PRNG
+    stream (resolves `at: auto` trigger draws reproducibly); `faults`
+    is a list of fault mappings: `kind` (required, one of FAULT_KINDS),
+    `at` (site ordinal, int | "auto" | null = first opportunity),
+    `target` (engine / worker / sweep-job name), `count` (firings,
+    -1 = persistent), `stall_s` (kind=stall only). The section is
+    excluded from the config fingerprint: a chaos run that completes is
+    leaf-identical to the fault-free run, so its checkpoints must
+    resume under either config. CLI: --chaos-seed / --chaos-fault."""
+
+    seed: int = 0
+    faults: list = dataclasses.field(default_factory=list)
+
+    _FAULT_KEYS = ("kind", "at", "target", "count", "stall_s")
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ChaosOptions":
+        out = cls()
+        out.seed = int(d.pop("seed", 0))
+        faults = d.pop("faults", []) or []
+        if not isinstance(faults, list):
+            raise ValueError("chaos.faults must be a list of fault mappings")
+        # lazy: runtime/chaos.py imports FAULT_KINDS from this module, so
+        # the dependency can only run config -> runtime at call time
+        from shadow_tpu.runtime.chaos import FaultSpec
+
+        for f in faults:
+            if not isinstance(f, dict):
+                raise ValueError("chaos.faults entries must be mappings")
+            f = dict(f)
+            kind = f.get("kind")
+            if kind not in FAULT_KINDS:
+                raise ValueError(
+                    f"chaos.faults: unknown kind {kind!r} "
+                    f"(expected one of {sorted(FAULT_KINDS)})"
+                )
+            unknown = sorted(set(f) - set(cls._FAULT_KEYS))
+            if unknown:
+                raise ValueError(f"unknown key(s) in chaos fault: {unknown}")
+            # validate values eagerly against the one authoritative
+            # definition (FaultSpec), so a bad `at:`/`count:`/`stall_s:`
+            # is a one-line config error at load time, not a traceback
+            # mid-run when the plan is built
+            try:
+                FaultSpec(**f)
+            except (TypeError, ValueError) as e:
+                raise ValueError(f"chaos.faults entry {f!r}: {e}") from e
+            out.faults.append(f)
+        _reject_unknown("chaos", d)
         return out
 
 
@@ -364,6 +444,7 @@ class ConfigOptions:
     network: NetworkOptions
     experimental: ExperimentalOptions
     hosts: "list[HostOptions]"
+    chaos: ChaosOptions = dataclasses.field(default_factory=ChaosOptions)
 
     @classmethod
     def from_dict(cls, raw: dict) -> "ConfigOptions":
@@ -375,6 +456,7 @@ class ConfigOptions:
         general = GeneralOptions.from_dict(dict(raw.pop("general")))
         network = NetworkOptions.from_dict(dict(raw.pop("network", {}) or {}))
         experimental = ExperimentalOptions.from_dict(dict(raw.pop("experimental", {}) or {}))
+        chaos = ChaosOptions.from_dict(dict(raw.pop("chaos", {}) or {}))
         defaults = dict(raw.pop("host_option_defaults", {}) or {})
         hosts = [
             HostOptions.from_dict(name, dict(h or {}), defaults)
@@ -383,7 +465,8 @@ class ConfigOptions:
         _reject_unknown("config", raw)
         if general.stop_time_ns <= 0:
             raise ValueError("general.stop_time must be > 0")
-        return cls(general=general, network=network, experimental=experimental, hosts=hosts)
+        return cls(general=general, network=network, experimental=experimental,
+                   hosts=hosts, chaos=chaos)
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)

@@ -11,6 +11,8 @@ sweep:
   # ...or the scenario inline:
   # config: { general: {...}, hosts: {...} }
   capacity: 8                # max jobs packed into one ensemble batch
+  retry_max: 1               # per-job retries after a failed batch splits
+  retry_backoff_s: 0.0       # wall backoff base, doubling per attempt
   jobs:
     - name: light            # required, unique per spec
       seeds: [0, 1, 2]       # explicit seed list, and/or
@@ -70,6 +72,13 @@ class SweepSpec:
     output_dir: str
     capacity: int
     jobs: "list[SweepJob]"
+    # Degradation ladder (docs/service.md "Retries and quarantine"): a
+    # failed multi-job batch is split and its jobs retried individually,
+    # each up to retry_max times with retry_backoff_s * 2^(attempt-1)
+    # wall seconds between attempts; a job still failing past the budget
+    # is quarantined so the rest of the sweep completes.
+    retry_max: int = 1
+    retry_backoff_s: float = 0.0
 
 
 def _expand_seeds(entry_name: str, d: dict) -> "list[int]":
@@ -106,6 +115,12 @@ def load_sweep_spec(
     capacity = int(s.pop("capacity", 8))
     if capacity < 1:
         raise ValueError("sweep.capacity must be >= 1")
+    retry_max = int(s.pop("retry_max", 1))
+    if retry_max < 0:
+        raise ValueError("sweep.retry_max must be >= 0")
+    retry_backoff_s = float(s.pop("retry_backoff_s", 0.0))
+    if retry_backoff_s < 0:
+        raise ValueError("sweep.retry_backoff_s must be >= 0")
 
     base_cfg = s.pop("config", None)
     base_path = s.pop("base", None)
@@ -144,6 +159,13 @@ def load_sweep_spec(
         overrides = e.pop("overrides", {}) or {}
         if not isinstance(overrides, dict):
             raise ValueError(f"sweep.jobs.{ename}.overrides must be a mapping")
+        if "chaos" in overrides:
+            raise ValueError(
+                f"sweep.jobs.{ename}.overrides: chaos is sweep-global "
+                "(the service installs ONE FaultPlan for the whole sweep) "
+                "— put the chaos section in the base scenario, or use "
+                "target= to restrict a fault to this entry's jobs"
+            )
         if e:
             raise ValueError(f"unknown key(s) in sweep.jobs.{ename}: {sorted(e)}")
         merged = deep_merge(base_cfg, overrides)
@@ -172,7 +194,9 @@ def load_sweep_spec(
                     group_key=config_fingerprint(cfg, exclude_seed=True),
                 )
             )
-    return SweepSpec(name=name, output_dir=out_dir, capacity=capacity, jobs=jobs)
+    return SweepSpec(name=name, output_dir=out_dir, capacity=capacity,
+                     jobs=jobs, retry_max=retry_max,
+                     retry_backoff_s=retry_backoff_s)
 
 
 def load_sweep_file(path: str, output_dir: "str | None" = None) -> SweepSpec:

@@ -71,9 +71,12 @@ from shadow_tpu.engine.round import (
     ChunkProbe,
     RunInterrupted,
     _capacity_error,
+    _fetch_probe,
+    _launch_chunk0,
     _tspan,
     bootstrap,
     check_capacity,
+    effective_engine,
     run_rounds_scan,
     state_probe,
     validate_runahead,
@@ -309,6 +312,7 @@ def _finish(out: SimState, final_rows: "dict[int, np.ndarray]") -> SimState:
 def _drive_ensemble(
     launch, st, end_time, max_chunks, on_chunk, pipeline, desc,
     tracker=None, on_state=None, on_rows=None,
+    watchdog_s: float = 0.0, engine: str = "pump",
 ):
     """The ensemble twin of engine/round.py `_drive`: same depth-2
     pipeline and donation discipline, same two-phase checkpoint commit,
@@ -318,7 +322,11 @@ def _drive_ensemble(
     runs — docs/ensemble.md). `on_rows(rows)` receives the raw
     [R, PROBE_LANES] numpy probe each chunk, BEFORE aggregation — the
     sweep scheduler's per-job progress stream (one row per job, zero
-    extra device syncs; runtime/sweep.py)."""
+    extra device syncs; runtime/sweep.py). `watchdog_s`/`engine` and
+    the chaos capacity/stall/compile hooks mirror engine/round.py
+    `_drive` — the degradation ladder covers both drivers."""
+    from shadow_tpu.runtime import chaos
+
     R = num_replicas(st)
     # Replicas quiescent at ENTRY (a resumed checkpoint whose batch was
     # only partially done) are pre-recorded from the entry state itself:
@@ -332,8 +340,7 @@ def _drive_ensemble(
         for r in range(R)
         if int(entry_rows[r, PROBE_NEXT_TIME]) >= end_time
     }
-    with _tspan(tracker, "compile+launch", chunk=0):
-        pend_st, pend_probe = launch(st)
+    pend_st, pend_probe = _launch_chunk0(launch, st, tracker, engine)
     launched = 1
     fetched = 0
     pending_snap = None
@@ -344,8 +351,11 @@ def _drive_ensemble(
                 nxt = launch(pend_st)
             launched += 1
         with _tspan(tracker, "probe_fetch", chunk=fetched):
-            rows = np.asarray(jax.device_get(pend_probe))
+            rows = np.asarray(_fetch_probe(pend_probe, watchdog_s, fetched))
         fetched += 1
+        injected = chaos.fire("capacity", at=fetched - 1)
+        if injected is not None:
+            raise chaos.injected_capacity_error(fetched - 1, injected)
         if int(rows[:, PROBE_OVERFLOW].sum()):
             raise _replica_capacity_error(rows)
         if on_rows is not None:
@@ -423,6 +433,7 @@ def run_ensemble_until(
     on_state=None,
     on_rows=None,
     launch=None,
+    watchdog_s: float = 0.0,
 ) -> SimState:
     """Host-side ensemble driver: chunked vmapped device scans until no
     replica has work left before end_time. `st` is an init_ensemble_state
@@ -470,4 +481,5 @@ def run_ensemble_until(
         launch, st, end_time, max_chunks, on_chunk, pipeline,
         desc=f"{max_chunks}x{rounds_per_chunk} rounds",
         tracker=tracker, on_state=on_state, on_rows=on_rows,
+        watchdog_s=watchdog_s, engine=effective_engine(ensemble_engine_cfg(cfg)),
     )

@@ -25,6 +25,8 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import threading
+import time
 from typing import Optional
 
 import jax
@@ -949,6 +951,47 @@ class RunInterrupted(RuntimeError):
     returned — resume from the checkpoint instead."""
 
 
+class WatchdogExpired(RuntimeError):
+    """A chunk dispatch (launch + probe fetch) exceeded the configured
+    watchdog deadline (experimental.chunk_watchdog_s). The in-flight
+    chunk is abandoned; runtime/recovery.py rolls back to the retained
+    clean snapshot and re-dispatches, counting it like a recovery in
+    sim-stats (docs/robustness.md). Past the recovery budget it
+    propagates as a structured failure — never an indefinite hang."""
+
+    def __init__(self, chunk: int, deadline_s: float):
+        super().__init__(
+            f"chunk {chunk} dispatch exceeded the {deadline_s:.3g}s "
+            "watchdog deadline; abandoning the in-flight chunk"
+        )
+        self.chunk = chunk
+        self.deadline_s = deadline_s
+
+
+class EngineCompileError(RuntimeError):
+    """The selected engine failed to compile/trace its chunk program.
+    The engines are leaf-exact bit-identical, so this is recoverable by
+    degradation: runtime/chaos.py run_with_engine_ladder falls one rung
+    (megakernel → pump → plain), logging the reason; only a plain-engine
+    failure is terminal."""
+
+    def __init__(self, engine: str, cause: "BaseException | None" = None):
+        super().__init__(
+            f"{engine} engine failed to compile its chunk program: "
+            f"{cause if cause is not None else 'injected fault (chaos plane)'}"
+        )
+        self.engine = engine
+
+
+def effective_engine(cfg) -> str:
+    """The engine an "auto" config actually runs (pump when pump_k > 0,
+    else plain) — the name chaos `compile` faults target and engine
+    fallback records report (runtime/chaos.py)."""
+    if cfg.engine != "auto":
+        return cfg.engine
+    return "pump" if cfg.pump_k > 0 else "plain"
+
+
 def check_capacity(st: SimState) -> None:
     """Fail loudly if fixed-slot capacity was exhausted: past that point the
     simulation has silently dropped events and no longer matches the
@@ -1051,8 +1094,64 @@ def _tspan(tracker, name, **args):
     return tracker.span(name, **args)
 
 
+def _fetch_probe(arr, watchdog_s: float, chunk_idx: int):
+    """Fetch a chunk's probe, under the chunk-dispatch watchdog when one
+    is configured (experimental.chunk_watchdog_s > 0): the blocking
+    device_get runs in a helper thread bounded by the deadline, so a
+    wedged dispatch surfaces as WatchdogExpired instead of blocking the
+    driver forever. Watchdog off = the plain blocking fetch, no thread.
+    The chaos plane's `stall` fault injects its delay here — inside the
+    watchdog-measured region — which is how the watchdog is exercised
+    deterministically (tests/test_chaos.py)."""
+    from shadow_tpu.runtime import chaos
+
+    t0 = time.perf_counter()
+    stall = chaos.fire("stall", at=chunk_idx)
+    if stall is not None:
+        time.sleep(stall.stall_s)
+    if watchdog_s <= 0:
+        return jax.device_get(arr)
+    remaining = watchdog_s - (time.perf_counter() - t0)
+    if remaining <= 0:
+        raise WatchdogExpired(chunk_idx, watchdog_s)
+    box: list = []
+    fetcher = threading.Thread(
+        target=lambda: box.append(_try_get(arr)), daemon=True
+    )
+    fetcher.start()
+    fetcher.join(remaining)
+    if not box:
+        raise WatchdogExpired(chunk_idx, watchdog_s)
+    ok, val = box[0]
+    if not ok:
+        raise val
+    return val
+
+
+def _try_get(arr):
+    try:
+        return True, jax.device_get(arr)
+    except BaseException as e:  # surfaced in the caller's thread
+        return False, e
+
+
+def _launch_chunk0(launch, st, tracker, engine: str):
+    """Chunk 0's launch is where the engine's chunk program traces and
+    compiles: wrap it in the shared compile seam (runtime/chaos.py
+    compile_seam) so a compile/trace failure (or an injected `compile`
+    chaos fault) surfaces as a typed EngineCompileError the fallback
+    ladder can act on. Driver-level exceptions pass through untouched —
+    only the first launch is compile territory."""
+    from shadow_tpu.runtime import chaos
+
+    with chaos.compile_seam(engine):
+        with _tspan(tracker, "compile+launch", chunk=0):
+            return launch(st)
+
+
 def _drive(launch, st, end_time, max_chunks, on_chunk, pipeline, desc,
-           tracker=None, on_state=None, capacity_detail=None):
+           tracker=None, on_state=None, capacity_detail=None,
+           watchdog_s: float = 0.0, engine: str = "plain"):
     """The shared chunk-dispatch loop behind run_until and
     ShardedRunner.run_until.
 
@@ -1089,9 +1188,20 @@ def _drive(launch, st, end_time, max_chunks, on_chunk, pipeline, desc,
     snapshot can never contain silently-dropped events. `capacity_detail`
     (sharded driver) turns a live state into a per-shard overflow
     breakdown appended to the CapacityError.
+
+    `watchdog_s` > 0 arms the chunk-dispatch watchdog: a probe fetch
+    that exceeds the deadline raises WatchdogExpired (the in-flight
+    chunk is abandoned; runtime/recovery.py re-dispatches from the
+    retained clean snapshot). `engine` labels the engine whose chunk
+    program chunk 0 compiles — a compile/trace failure there raises a
+    typed EngineCompileError for the fallback ladder. Both, plus the
+    chaos plane's capacity/stall injections, are consulted through
+    runtime/chaos.py hooks that cost one global read when no fault plan
+    is installed.
     """
-    with _tspan(tracker, "compile+launch", chunk=0):
-        pend_st, pend_probe = launch(st)
+    from shadow_tpu.runtime import chaos
+
+    pend_st, pend_probe = _launch_chunk0(launch, st, tracker, engine)
     launched = 1
     fetched = 0  # index of the chunk whose probe is fetched next
     pending_snap = None  # (chunk_idx, host_state) awaiting its own probe
@@ -1102,8 +1212,13 @@ def _drive(launch, st, end_time, max_chunks, on_chunk, pipeline, desc,
                 nxt = launch(pend_st)  # donates pend_st; device stays busy
             launched += 1
         with _tspan(tracker, "probe_fetch", chunk=fetched):
-            probe = ChunkProbe.from_array(jax.device_get(pend_probe))
+            probe = ChunkProbe.from_array(
+                _fetch_probe(pend_probe, watchdog_s, fetched)
+            )
         fetched += 1
+        injected = chaos.fire("capacity", at=fetched - 1)
+        if injected is not None:
+            raise chaos.injected_capacity_error(fetched - 1, injected)
         if probe.overflow:
             err = _capacity_error(
                 probe.overflow,
@@ -1210,6 +1325,7 @@ def run_until(
     pipeline: bool = True,
     tracker=None,
     on_state=None,
+    watchdog_s: float = 0.0,
 ) -> SimState:
     """Host-side driver: chunked device scans until no work remains before
     end_time. Single-device variant; the sharded driver lives in
@@ -1249,6 +1365,7 @@ def run_until(
         launch, st, end_time, max_chunks, on_chunk, pipeline,
         desc=f"{max_chunks}x{rounds_per_chunk} rounds",
         tracker=tracker, on_state=on_state,
+        watchdog_s=watchdog_s, engine=effective_engine(cfg),
     )
 
 

@@ -45,6 +45,7 @@ from shadow_tpu.engine.round import (
     _peek_next_time,
     _tspan,
     check_capacity,
+    effective_engine,
     run_rounds_scan,
     state_probe,
     validate_runahead,
@@ -198,6 +199,7 @@ class ShardedRunner:
         pipeline: bool = True,
         tracker=None,
         on_state=None,
+        watchdog_s: float = 0.0,
     ) -> SimState:
         """Sharded chunk driver: the same depth-2 async dispatch pipeline
         as engine/round.py run_until (donated state, probe-only syncs,
@@ -226,4 +228,5 @@ class ShardedRunner:
             desc=f"{max_chunks}x{self.rounds_per_chunk} rounds (sharded)",
             tracker=tracker, on_state=on_state,
             capacity_detail=self._capacity_detail,
+            watchdog_s=watchdog_s, engine=effective_engine(self.cfg),
         )

@@ -30,6 +30,7 @@ instead of a lost run.
 from __future__ import annotations
 
 import glob
+import hashlib
 import json
 import os
 import signal
@@ -51,12 +52,28 @@ CHECKPOINT_VERSION = 1
 
 class CheckpointError(ValueError):
     """A checkpoint could not be used: wrong version, wrong config
-    fingerprint, or a corrupt/mismatched leaf set."""
+    fingerprint, a failed integrity check, or a corrupt/truncated
+    file."""
+
+
+def _payload_digest(leaves) -> str:
+    """SHA-256 over the leaf payload in leaf order (dtype + shape +
+    bytes per leaf, so a reinterpretation can never collide). Written
+    into the meta by save_checkpoint, re-derived and compared on load —
+    a flipped byte surfaces as a named CheckpointError instead of a
+    silently different trajectory."""
+    h = hashlib.sha256()
+    for leaf in leaves:
+        a = np.ascontiguousarray(np.asarray(leaf))
+        h.update(f"{a.dtype}:{a.shape}:".encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
 
 
 def save_checkpoint(path: str, host_state: SimState, meta: dict) -> str:
     """Write a host (state_to_host) snapshot atomically. `meta` must carry
-    at least the fingerprint; version/leaf bookkeeping is added here."""
+    at least the fingerprint; version/leaf bookkeeping and the payload
+    integrity digest are added here."""
     leaves, _ = jax.tree.flatten(host_state)
     paths = [
         jax.tree_util.keystr(p)
@@ -67,6 +84,7 @@ def save_checkpoint(path: str, host_state: SimState, meta: dict) -> str:
         version=CHECKPOINT_VERSION,
         num_leaves=len(leaves),
         leaf_paths=paths,
+        sha256=_payload_digest(leaves),
         # recorded so resume can rebuild the template at the RIGHT widths
         # even after rollback-and-regrow grew them past the config values
         # (shape[-1] is the capacity axis for single [H, Q] and ensemble
@@ -85,33 +103,80 @@ def save_checkpoint(path: str, host_state: SimState, meta: dict) -> str:
 
 def peek_checkpoint_meta(path: str) -> dict:
     """Read only the meta record (no leaf arrays): resume uses this to
-    learn the saved buffer capacities before building the template."""
-    with np.load(path, allow_pickle=False) as z:
-        return json.loads(str(z["__meta__"][()]))
+    learn the saved buffer capacities before building the template. A
+    truncated or corrupt file raises a CheckpointError naming it, never
+    a bare zipfile.BadZipFile."""
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            return json.loads(str(z["__meta__"][()]))
+    except Exception as e:
+        raise CheckpointError(
+            f"checkpoint {path} is unreadable (corrupt or truncated): "
+            f"{type(e).__name__}: {e}"
+        ) from e
+
+
+def verify_checkpoint(path: str) -> "str | None":
+    """Full integrity check: structural readability plus the sha-256
+    payload digest. Returns None when the file is sound, else a short
+    reason — CheckpointManager.latest_path uses this to skip corrupt
+    files and fall back to the newest valid one."""
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            meta = json.loads(str(z["__meta__"][()]))
+            leaves = [z[f"leaf_{i:05d}"] for i in range(meta["num_leaves"])]
+    except Exception as e:
+        return f"unreadable (corrupt or truncated): {type(e).__name__}"
+    digest = meta.get("sha256")
+    if digest is not None and _payload_digest(leaves) != digest:
+        return "payload failed its sha-256 integrity check"
+    return None
 
 
 def load_checkpoint(
-    path: str, like: SimState, fingerprint: "str | None" = None
+    path: str, like: SimState, fingerprint: "str | None" = None,
+    check_digest: bool = True,
 ) -> "tuple[SimState, dict]":
     """Load a checkpoint back into a device SimState shaped like the
     template (a freshly built initial state for the same config).
     Validates the format version, the config fingerprint (when given),
-    and every leaf shape/dtype via state_from_host."""
-    with np.load(path, allow_pickle=False) as z:
-        meta = json.loads(str(z["__meta__"][()]))
-        if meta.get("version") != CHECKPOINT_VERSION:
-            raise CheckpointError(
-                f"checkpoint {path} has format version {meta.get('version')}, "
-                f"this build reads version {CHECKPOINT_VERSION}"
-            )
-        if fingerprint is not None and meta.get("fingerprint") != fingerprint:
-            raise CheckpointError(
-                f"checkpoint {path} was written for a different config "
-                f"(fingerprint {str(meta.get('fingerprint'))[:12]}… != "
-                f"{fingerprint[:12]}…); resume must use the exact config "
-                "the checkpoint was saved from"
-            )
-        leaves = [z[f"leaf_{i:05d}"] for i in range(meta["num_leaves"])]
+    the sha-256 payload digest, and every leaf shape/dtype via
+    state_from_host. `check_digest=False` skips re-hashing the payload —
+    for callers whose path just came from `CheckpointManager.latest_path`,
+    which verified the digest moments ago (resume would otherwise read
+    and hash the full payload twice)."""
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            meta = json.loads(str(z["__meta__"][()]))
+            if meta.get("version") != CHECKPOINT_VERSION:
+                raise CheckpointError(
+                    f"checkpoint {path} has format version {meta.get('version')}, "
+                    f"this build reads version {CHECKPOINT_VERSION}"
+                )
+            if fingerprint is not None and meta.get("fingerprint") != fingerprint:
+                raise CheckpointError(
+                    f"checkpoint {path} was written for a different config "
+                    f"(fingerprint {str(meta.get('fingerprint'))[:12]}… != "
+                    f"{fingerprint[:12]}…); resume must use the exact config "
+                    "the checkpoint was saved from"
+                )
+            leaves = [z[f"leaf_{i:05d}"] for i in range(meta["num_leaves"])]
+    except CheckpointError:
+        raise
+    except Exception as e:
+        # zipfile.BadZipFile on truncation, KeyError on a missing entry,
+        # json/OS errors — all mean the same thing to a resume: this
+        # file cannot be trusted, and the error must name it
+        raise CheckpointError(
+            f"checkpoint {path} is unreadable (corrupt or truncated): "
+            f"{type(e).__name__}: {e}"
+        ) from e
+    digest = meta.get("sha256")
+    if check_digest and digest is not None and _payload_digest(leaves) != digest:
+        raise CheckpointError(
+            f"checkpoint {path} failed its sha-256 integrity check: the "
+            "payload was modified or corrupted after it was written"
+        )
     t_leaves, treedef = jax.tree.flatten(like)
     if len(leaves) != len(t_leaves):
         raise CheckpointError(
@@ -168,6 +233,17 @@ class CheckpointManager:
             meta["deliver_lanes"] = self.engine_cfg.deliver_lanes
             meta["a2a_capacity"] = self.engine_cfg.a2a_capacity
         save_checkpoint(path, host_state, meta)
+        # chaos seam (runtime/chaos.py): `at` counts this manager's
+        # writes; the damage lands after the atomic commit, simulating
+        # post-write corruption the integrity check must catch
+        from shadow_tpu.runtime import chaos
+
+        if chaos.active() is not None:
+            ordinal = len(self.written)
+            if chaos.fire("ckpt-corrupt", at=ordinal) is not None:
+                chaos.damage_file(path, truncate=False)
+            if chaos.fire("ckpt-truncate", at=ordinal) is not None:
+                chaos.damage_file(path, truncate=True)
         self.written.append(path)
         slog("info", now, "checkpoint",
              f"wrote {'final ' if final else ''}checkpoint {path}")
@@ -183,9 +259,24 @@ class CheckpointManager:
                 pass
 
     @staticmethod
-    def latest_path(directory: str) -> "str | None":
+    def latest_path(directory: str, verify: bool = True) -> "str | None":
+        """Newest USABLE checkpoint: candidates are walked newest-first
+        and each is integrity-checked (structure + sha-256 digest); a
+        corrupt/truncated file is skipped with a warning and the next
+        older one is tried — a single bad write can no longer take the
+        whole resume path down. `verify=False` restores the raw
+        lexical-newest lookup."""
         found = sorted(glob.glob(os.path.join(directory, "ckpt-*.npz")))
-        return found[-1] if found else None
+        for path in reversed(found):
+            if not verify:
+                return path
+            reason = verify_checkpoint(path)
+            if reason is None:
+                return path
+            slog("warning", 0, "checkpoint",
+                 f"skipping checkpoint {path}: {reason}; "
+                 "falling back to the previous one")
+        return None
 
 
 class InterruptGuard:

@@ -13,7 +13,12 @@ import sys
 import yaml
 
 from shadow_tpu.config import load_config_file
-from shadow_tpu.engine.round import CapacityError, RunInterrupted
+from shadow_tpu.engine.round import (
+    CapacityError,
+    EngineCompileError,
+    RunInterrupted,
+    WatchdogExpired,
+)
 from shadow_tpu.runtime.checkpoint import CheckpointError
 from shadow_tpu.runtime.manager import Manager
 from shadow_tpu.utils.shadow_log import set_level
@@ -34,6 +39,9 @@ def run_from_config(
     no_recover: bool = False,
     replicas: "int | None" = None,
     replica_seed_stride: "int | None" = None,
+    chunk_watchdog: "float | None" = None,
+    chaos_seed: "int | None" = None,
+    chaos_faults: "list[str] | None" = None,
 ) -> int:
     try:
         config = load_config_file(path)
@@ -68,6 +76,19 @@ def run_from_config(
         if replica_seed_stride < 1:
             raise CliUserError("--replica-seed-stride must be >= 1")
         config.general.replica_seed_stride = replica_seed_stride
+    if chunk_watchdog is not None:
+        if chunk_watchdog < 0:
+            raise CliUserError("--chunk-watchdog must be >= 0")
+        config.experimental.chunk_watchdog_s = chunk_watchdog
+    if chaos_seed is not None:
+        config.chaos.seed = chaos_seed
+    for arg in chaos_faults or []:
+        from shadow_tpu.runtime.chaos import parse_fault_arg
+
+        try:
+            config.chaos.faults.append(parse_fault_arg(arg))
+        except ValueError as e:
+            raise CliUserError(f"invalid --chaos-fault {arg!r}: {e}") from e
     set_level(config.general.log_level)
     if show_config:
         print(json.dumps(config.to_dict(), indent=2, default=str))
@@ -78,7 +99,10 @@ def run_from_config(
         raise CliUserError(str(e)) from e
     try:
         results = manager.run()
-    except CapacityError as e:
+    except (CapacityError, WatchdogExpired, EngineCompileError) as e:
+        # the degradation ladder's terminal rungs: recovery budget
+        # exhausted, watchdog past its retries, or the plain engine
+        # failing too — all structured, named failures, never a traceback
         raise CliUserError(str(e)) from e
     except RunInterrupted as e:
         # not a user error: the run stopped on request with a final
@@ -103,8 +127,9 @@ def run_sweep(
 ) -> int:
     """`shadow-tpu sweep` implementation: expand + pack + (optionally)
     execute a sweep spec (docs/service.md). Exit 0 when every job
-    completed cleanly — a job that finished with unroutable packets
-    counts against the exit code exactly as its standalone
+    completed cleanly — any job ending `failed` or `quarantined` makes
+    the process exit non-zero, and a job that finished with unroutable
+    packets counts against the exit code exactly as its standalone
     `shadow-tpu run` would."""
     from shadow_tpu.config.sweep import load_sweep_file
     from shadow_tpu.runtime.sweep import SweepService, render_report
@@ -127,6 +152,8 @@ def run_sweep(
     print(render_report(manifest))
     clean = (
         manifest["jobs_done"] == manifest["jobs_total"]
+        and manifest["jobs_failed"] == 0
+        and manifest["jobs_quarantined"] == 0
         and manifest["jobs_unroutable"] == 0
     )
     return 0 if clean else 1

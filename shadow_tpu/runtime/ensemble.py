@@ -59,6 +59,7 @@ class EnsembleRunner:
         compile_cache=None,
         cache_key=None,
         on_rows=None,
+        watchdog_s: float = 0.0,
     ):
         if num_replicas < 1:
             raise ValueError("num_replicas must be >= 1")
@@ -81,6 +82,7 @@ class EnsembleRunner:
         self.compile_cache = compile_cache
         self.cache_key = cache_key
         self.on_rows = on_rows
+        self.watchdog_s = watchdog_s
 
     @property
     def seeds(self) -> "list[int]":
@@ -108,18 +110,24 @@ class EnsembleRunner:
         if self.compile_cache is None:
             return None
         from shadow_tpu.engine.ensemble import lower_ensemble_chunk
+        from shadow_tpu.engine.round import effective_engine
         from shadow_tpu.engine.state import trace_static_cfg
+        from shadow_tpu.runtime import chaos
 
         static_cfg = trace_static_cfg(ensemble_engine_cfg(cfg))
-        return self.compile_cache.get(
-            (self.cache_key, self.rounds_per_chunk),
-            st,
-            static_cfg,
-            lambda: lower_ensemble_chunk(
-                st, end_time_ns, self.rounds_per_chunk, self.model,
-                self.tables, cfg,
-            ).compile(),
-        )
+        eng = effective_engine(static_cfg)
+        # the AOT twin of _drive's chunk-0 wrap: a compile/trace failure
+        # here must reach the same fallback ladder
+        with chaos.compile_seam(eng):
+            return self.compile_cache.get(
+                (self.cache_key, self.rounds_per_chunk),
+                st,
+                static_cfg,
+                lambda: lower_ensemble_chunk(
+                    st, end_time_ns, self.rounds_per_chunk, self.model,
+                    self.tables, cfg,
+                ).compile(),
+            )
 
     def _runner_factory(self, end_time_ns: int, on_chunk, max_chunks, tracker):
         def factory(cfg):
@@ -131,6 +139,7 @@ class EnsembleRunner:
                     tracker=tracker, on_state=on_state,
                     on_rows=self.on_rows,
                     launch=self._launch_for(st, end_time_ns, cfg),
+                    watchdog_s=self.watchdog_s,
                 )
 
             return run
@@ -142,8 +151,11 @@ class EnsembleRunner:
             recovery=None):
         """Run the whole batch to end_time_ns (the driver stops when the
         SLOWEST replica quiesces; finished replicas idle as identity
-        no-ops). Mirrors TpuScheduler.run, with the regrow step vmapped
-        over the replica axis."""
+        no-ops). Mirrors TpuScheduler.run — including the engine
+        fallback ladder (already at pump under vmap, so the only rung
+        left is pump → plain; bit-identical either way) — with the
+        regrow step vmapped over the replica axis."""
+        from shadow_tpu.runtime.chaos import run_with_engine_ladder
         from shadow_tpu.runtime.recovery import (
             RecoveryPolicy,
             run_until_recovering,
@@ -152,19 +164,34 @@ class EnsembleRunner:
         st = start_state if start_state is not None else self.initial_state()
         self.recovery_report = []
         factory = self._runner_factory(end_time_ns, on_chunk, max_chunks, tracker)
-        if recovery is None and checkpoints is None and guard is None:
-            return factory(self.cfg)(st)
-        final, report = run_until_recovering(
-            st,
-            end_time_ns,
-            cfg=self.cfg,
-            tracker=tracker,
-            policy=recovery or RecoveryPolicy(max_recoveries=0),
-            checkpoints=checkpoints,
-            guard=guard,
-            runner_factory=factory,
-            grow_fn=grow_ensemble_state,
-        )
+
+        def attempt(cfg):
+            if recovery is None and checkpoints is None and guard is None:
+                return factory(cfg)(st), []
+            return run_until_recovering(
+                st,
+                end_time_ns,
+                cfg=cfg,
+                tracker=tracker,
+                policy=recovery or RecoveryPolicy(max_recoveries=0),
+                checkpoints=checkpoints,
+                guard=guard,
+                runner_factory=factory,
+                grow_fn=grow_ensemble_state,
+            )
+
+        self.engine_fallbacks: "list[dict]" = []
+        try:
+            (final, report), _ = run_with_engine_ladder(
+                self.cfg, attempt,
+                on_fallback=self.engine_fallbacks.append,
+            )
+        except Exception as err:
+            # keep the partial degradation record on failure: recoveries
+            # ride the terminal exception (runtime/recovery.py), fallbacks
+            # accumulated live via on_fallback above
+            self.recovery_report = list(getattr(err, "recoveries", []))
+            raise
         self.recovery_report = report
         return final
 

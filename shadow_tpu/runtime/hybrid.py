@@ -416,6 +416,7 @@ class ParallelHybridScheduler:
         # holds)
         self.phase_wall: dict = {}
         self.device_passes = 0
+        self._windows_sent = 0  # window-broadcast ordinal (chaos `at` site)
         self._horizon: "int | None" = None
         self._probe = None  # fetched probe of the latest pass
         # optional utils/tracker.py registry: every _phase interval
@@ -749,9 +750,43 @@ class ParallelHybridScheduler:
         self.inflight -= len(t)
         self._phase("drain_records", t0)
 
+    def _inject_worker_faults(self) -> None:
+        """Chaos seam (runtime/chaos.py): a `worker-kill` fault SIGKILLs
+        and a `worker-hang` fault SIGSTOPs worker `target` ("workerN")
+        before window broadcast number `at` — exercising exactly the
+        dead-worker (_WorkerDied → respawn + replay) and hung-worker
+        (bounded recv timeout → kill + respawn) supervision paths. No
+        plan installed = one global read."""
+        from shadow_tpu.runtime import chaos
+
+        if chaos.active() is None:
+            return
+        import os as _os
+        import signal as _signal
+
+        for w, (proc, _conn) in enumerate(self._workers):
+            if not proc.is_alive():
+                # don't let fire() burn the fault's budget (and publish
+                # it as fired) on a worker that is already dead — the
+                # spec stays armed for the respawned worker instead
+                continue
+            for kind, sig in (
+                ("worker-kill", _signal.SIGKILL),
+                ("worker-hang", _signal.SIGSTOP),
+            ):
+                spec = chaos.fire(kind, at=self._windows_sent,
+                                  tags=(f"worker{w}",))
+                if spec is not None:
+                    try:
+                        _os.kill(proc.pid, sig)
+                    except OSError:
+                        pass  # raced a real death — supervisor handles it
+
     def _run_windows(self, end_ns: int, inclusive: bool) -> "list[tuple]":
         """All workers execute [.., end_ns) concurrently; returns the
         merged send list (metadata only; payloads cached for routing)."""
+        self._inject_worker_faults()
+        self._windows_sent += 1
         t0 = _walltime.perf_counter()
         replies = self._broadcast(
             ("run_window", end_ns, inclusive, self._horizon), "sends"

@@ -289,6 +289,29 @@ class Manager:
         )
 
     def run(self) -> SimResults:
+        """Run the simulation, with the chaos plane installed when the
+        config's `chaos:` section declares faults (docs/robustness.md
+        "Chaos testing"). The plan is process-global for the duration of
+        the run — every seam (drivers, checkpoint writer, hybrid
+        supervision) consults it through runtime/chaos.py fire()."""
+        from shadow_tpu.runtime import chaos
+
+        plan = chaos.plan_from_config(self.config.chaos)
+        if plan is None:
+            return self._run()
+        with chaos.installed(plan):
+            return self._run()
+
+    def _fold_chaos(self, results: SimResults) -> None:
+        """Publish what the installed fault plan actually injected: a
+        chaos run must be visibly a chaos run in sim-stats.json."""
+        from shadow_tpu.runtime import chaos
+
+        plan = chaos.active()
+        if plan is not None:
+            results.extra_stats["chaos"] = plan.report()
+
+    def _run(self) -> SimResults:
         cfgo = self.config
         num_hosts = len(self.hosts)
 
@@ -318,6 +341,7 @@ class Manager:
                 rounds_per_chunk=cfgo.experimental.rounds_per_chunk,
                 tx_bytes_per_interval=tx_refill,
                 rx_bytes_per_interval=rx_refill,
+                watchdog_s=cfgo.experimental.chunk_watchdog_s,
             )
         else:
             sched = make_scheduler(
@@ -330,6 +354,7 @@ class Manager:
                 rounds_per_chunk=cfgo.experimental.rounds_per_chunk,
                 tx_bytes_per_interval=tx_refill,
                 rx_bytes_per_interval=rx_refill,
+                watchdog_s=cfgo.experimental.chunk_watchdog_s,
             )
 
         end = cfgo.general.stop_time_ns
@@ -383,8 +408,11 @@ class Manager:
             if resume_path is not None:
                 from shadow_tpu.runtime.checkpoint import load_checkpoint
 
+                # resume_path came from latest_path, which verified the
+                # sha-256 digest moments ago — skip the second full hash
                 resume_state, meta = load_checkpoint(
-                    resume_path, sched.initial_state(), ckpt.fingerprint
+                    resume_path, sched.initial_state(), ckpt.fingerprint,
+                    check_digest=False,
                 )
                 slog("info", meta["now_ns"], "manager",
                      f"resuming from checkpoint {resume_path} "
@@ -446,6 +474,16 @@ class Manager:
                 "count": len(report),
                 "events": report,
             }
+        fallbacks = getattr(sched, "engine_fallbacks", [])
+        watchdogs = sum(1 for r in report if r.get("kind") == "watchdog")
+        if fallbacks or watchdogs:
+            # the degradation ladder acted: a degraded run must be
+            # VISIBLY degraded (docs/robustness.md), never silently slower
+            results.extra_stats["degraded"] = {
+                "engine_fallbacks": list(fallbacks),
+                "watchdog_redispatches": watchdogs,
+            }
+        self._fold_chaos(results)
         host_tensors = None
         if replicas > 1:
             # per-replica sections + the aggregate mean/stddev/CI block
@@ -724,6 +762,7 @@ class Manager:
             extra_stats=stats,
         )
         self._fold_tracker(tracker, results, end)
+        self._fold_chaos(results)
         slog("info", end, "manager",
              f"finished: {stats['syscalls_handled']} syscalls, "
              f"{stats['packets_sent']} packets in {wall:.2f}s wall")
@@ -816,6 +855,7 @@ class Manager:
             extra_stats=stats,
         )
         self._fold_tracker(tracker, results, end)
+        self._fold_chaos(results)
         slog("info", end, "manager",
              f"finished: {stats['syscalls_handled']} syscalls, "
              f"{stats['packets_sent']} packets in {wall:.2f}s wall")

@@ -29,7 +29,7 @@ import dataclasses
 
 import numpy as np
 
-from shadow_tpu.engine.round import CapacityError, run_until
+from shadow_tpu.engine.round import CapacityError, WatchdogExpired, run_until
 from shadow_tpu.engine.state import grow_state, state_from_host, state_to_host
 from shadow_tpu.runtime.checkpoint import StateTap
 from shadow_tpu.utils.shadow_log import slog
@@ -114,6 +114,7 @@ def run_until_recovering(
     runner_factory=None,
     on_recovery=None,
     grow_fn=None,
+    watchdog_s: float = 0.0,
 ):
     """run_until with the recovery loop wrapped around it. Returns
     (final_state, recoveries) where recoveries is the list of recovery
@@ -144,6 +145,7 @@ def run_until_recovering(
                     pipeline=pipeline,
                     tracker=tracker,
                     on_state=on_state,
+                    watchdog_s=watchdog_s,
                 )
 
             return run
@@ -168,10 +170,14 @@ def run_until_recovering(
         try:
             final = runner_factory(cur_cfg)(cur_st, on_state=tap)
             return final, recoveries
-        except CapacityError as err:
+        except (CapacityError, WatchdogExpired) as err:
             if len(recoveries) >= policy.max_recoveries:
+                # terminal: surface what the run survived before it died,
+                # so a degraded-then-failed run stays visibly degraded
+                # (sweep manifests read this off the exception)
+                err.recoveries = list(recoveries)
                 raise
-            new_cfg = grown_cfg(cur_cfg, err, policy.growth)
+            is_watchdog = isinstance(err, WatchdogExpired)
             if retainer is not None and retainer.host_state is not None:
                 base = state_from_host(retainer.host_state, cur_st)
             else:
@@ -179,34 +185,59 @@ def run_until_recovering(
             # ensemble states carry a [R] `now`: the rollback point is the
             # slowest replica's window (the batch replays together)
             from_ns = int(np.min(np.asarray(base.now)))
-            grown = grow(
-                base,
-                queue_capacity=new_cfg.queue_capacity,
-                outbox_capacity=new_cfg.outbox_capacity,
-            )
-            record = {
-                "queue_overflow": getattr(err, "queue_overflow", 0),
-                "outbox_overflow": getattr(err, "outbox_overflow", 0),
-                "queue_capacity": new_cfg.queue_capacity,
-                "outbox_capacity": new_cfg.outbox_capacity,
-                "replay_from_ns": from_ns,
-            }
-            if getattr(err, "replica", None) is not None:
-                # ensemble runs: name the replica that saturated even
-                # though the whole batch rolls back and regrows together
-                record["replica"] = err.replica
-            recoveries.append(record)
-            slog(
-                "warning",
-                from_ns,
-                "recovery",
-                f"capacity exhausted (queue_ov={record['queue_overflow']}, "
-                f"outbox_ov={record['outbox_overflow']}); rolling back to "
-                f"sim time {from_ns} ns and regrowing to "
-                f"queue_capacity={new_cfg.queue_capacity}, "
-                f"outbox_capacity={new_cfg.outbox_capacity} "
-                f"(recovery {len(recoveries)}/{policy.max_recoveries})",
-            )
+            if is_watchdog:
+                # the dispatch stalled, not the buffers: abandon the
+                # in-flight chunk, keep the shapes, re-dispatch from the
+                # retained clean snapshot (docs/robustness.md watchdog)
+                new_cfg, grown = cur_cfg, base
+                record = {
+                    "kind": "watchdog",
+                    "chunk": err.chunk,
+                    "deadline_s": err.deadline_s,
+                    "replay_from_ns": from_ns,
+                }
+                slog(
+                    "warning", from_ns, "recovery",
+                    f"chunk {err.chunk} dispatch blew the "
+                    f"{err.deadline_s:.3g}s watchdog; abandoning the "
+                    f"in-flight chunk and re-dispatching from sim time "
+                    f"{from_ns} ns "
+                    f"(recovery {len(recoveries) + 1}/{policy.max_recoveries})",
+                )
+                recoveries.append(record)
+            else:
+                new_cfg = grown_cfg(cur_cfg, err, policy.growth)
+                grown = grow(
+                    base,
+                    queue_capacity=new_cfg.queue_capacity,
+                    outbox_capacity=new_cfg.outbox_capacity,
+                )
+                record = {
+                    "kind": "capacity",
+                    "queue_overflow": getattr(err, "queue_overflow", 0),
+                    "outbox_overflow": getattr(err, "outbox_overflow", 0),
+                    "queue_capacity": new_cfg.queue_capacity,
+                    "outbox_capacity": new_cfg.outbox_capacity,
+                    "replay_from_ns": from_ns,
+                }
+                if getattr(err, "injected", False):
+                    record["injected"] = True  # chaos plane, not real load
+                if getattr(err, "replica", None) is not None:
+                    # ensemble runs: name the replica that saturated even
+                    # though the whole batch rolls back and regrows together
+                    record["replica"] = err.replica
+                recoveries.append(record)
+                slog(
+                    "warning",
+                    from_ns,
+                    "recovery",
+                    f"capacity exhausted (queue_ov={record['queue_overflow']}, "
+                    f"outbox_ov={record['outbox_overflow']}); rolling back to "
+                    f"sim time {from_ns} ns and regrowing to "
+                    f"queue_capacity={new_cfg.queue_capacity}, "
+                    f"outbox_capacity={new_cfg.outbox_capacity} "
+                    f"(recovery {len(recoveries)}/{policy.max_recoveries})",
+                )
             if tracker is not None and hasattr(tracker, "record_recovery"):
                 tracker.record_recovery(record)
             if on_recovery is not None:

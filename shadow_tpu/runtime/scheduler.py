@@ -29,13 +29,15 @@ class TpuScheduler:
     name = "tpu"
 
     def __init__(self, model, tables: RoutingTables, cfg: EngineConfig, *, parallelism: int = 0, rounds_per_chunk: int = 256,
-                 tx_bytes_per_interval=None, rx_bytes_per_interval=None):
+                 tx_bytes_per_interval=None, rx_bytes_per_interval=None,
+                 watchdog_s: float = 0.0):
         self.model = model
         self.tables = tables
         self.cfg = cfg
         self.rounds_per_chunk = rounds_per_chunk
         self.tx_bytes_per_interval = tx_bytes_per_interval
         self.rx_bytes_per_interval = rx_bytes_per_interval
+        self.watchdog_s = watchdog_s
         devices = jax.devices()
         n = parallelism if parallelism > 0 else len(devices)
         n = min(n, len(devices))
@@ -87,6 +89,7 @@ class TpuScheduler:
                     return runner.run_until(
                         st, end_time_ns, max_chunks=max_chunks,
                         on_chunk=on_chunk, tracker=tracker, on_state=on_state,
+                        watchdog_s=self.watchdog_s,
                     )
 
             else:
@@ -97,6 +100,7 @@ class TpuScheduler:
                         rounds_per_chunk=self.rounds_per_chunk,
                         max_chunks=max_chunks, on_chunk=on_chunk,
                         tracker=tracker, on_state=on_state,
+                        watchdog_s=self.watchdog_s,
                     )
 
             return run
@@ -110,31 +114,47 @@ class TpuScheduler:
         replaces the bootstrapped t=0 state; `checkpoints` /`guard` tap
         chunk-boundary states (runtime/checkpoint.py); `recovery` (a
         RecoveryPolicy, None = fail-fast) turns CapacityError into
-        rollback-and-regrow. The recovery report of the last run is left
-        on self.recovery_report."""
-        from shadow_tpu.runtime.recovery import run_until_recovering
+        rollback-and-regrow. A compile/trace failure of the selected
+        engine walks the fallback ladder (megakernel → pump → plain,
+        bit-identical results) instead of failing the run; the fallback
+        records of the last run are left on self.engine_fallbacks and
+        the recovery report on self.recovery_report."""
+        from shadow_tpu.runtime.chaos import run_with_engine_ladder
+        from shadow_tpu.runtime.recovery import (
+            RecoveryPolicy,
+            run_until_recovering,
+        )
 
         st = start_state if start_state is not None else self.initial_state()
         self.recovery_report = []
-        if recovery is None and checkpoints is None and guard is None:
-            # the plain path: no taps, no recovery wrapper
-            return self._runner_factory(
-                end_time_ns, on_chunk, max_chunks, tracker
-            )(self.cfg)(st)
-        from shadow_tpu.runtime.recovery import RecoveryPolicy
+        factory = self._runner_factory(end_time_ns, on_chunk, max_chunks, tracker)
 
-        final, report = run_until_recovering(
-            st,
-            end_time_ns,
-            cfg=self.cfg,
-            tracker=tracker,
-            policy=recovery or RecoveryPolicy(max_recoveries=0),
-            checkpoints=checkpoints,
-            guard=guard,
-            runner_factory=self._runner_factory(
-                end_time_ns, on_chunk, max_chunks, tracker
-            ),
-        )
+        def attempt(cfg):
+            if recovery is None and checkpoints is None and guard is None:
+                # the plain path: no taps, no recovery wrapper
+                return factory(cfg)(st), []
+            return run_until_recovering(
+                st,
+                end_time_ns,
+                cfg=cfg,
+                tracker=tracker,
+                policy=recovery or RecoveryPolicy(max_recoveries=0),
+                checkpoints=checkpoints,
+                guard=guard,
+                runner_factory=factory,
+            )
+
+        self.engine_fallbacks: "list[dict]" = []
+        try:
+            (final, report), _ = run_with_engine_ladder(
+                self.cfg, attempt,
+                on_fallback=self.engine_fallbacks.append,
+            )
+        except Exception as err:
+            # keep the partial degradation record on failure (mirrors
+            # EnsembleRunner.run): recoveries ride the terminal exception
+            self.recovery_report = list(getattr(err, "recoveries", []))
+            raise
         self.recovery_report = report
         return final
 
@@ -173,11 +193,13 @@ class CpuRefScheduler:
 
 
 def make_scheduler(name: str, model, tables, cfg, host_node, parallelism=0, rounds_per_chunk=256,
-                   tx_bytes_per_interval=None, rx_bytes_per_interval=None):
+                   tx_bytes_per_interval=None, rx_bytes_per_interval=None,
+                   watchdog_s=0.0):
     if name == "tpu":
         return TpuScheduler(model, tables, cfg, parallelism=parallelism, rounds_per_chunk=rounds_per_chunk,
                             tx_bytes_per_interval=tx_bytes_per_interval,
-                            rx_bytes_per_interval=rx_bytes_per_interval)
+                            rx_bytes_per_interval=rx_bytes_per_interval,
+                            watchdog_s=watchdog_s)
     if name == "cpu-ref":
         return CpuRefScheduler(model, tables, cfg, host_node,
                                tx_bytes_per_interval=tx_bytes_per_interval,

@@ -50,7 +50,9 @@ from shadow_tpu.engine.round import (
     PROBE_EVENTS,
     PROBE_NOW,
     CapacityError,
+    EngineCompileError,
     RunInterrupted,
+    WatchdogExpired,
     host_stats,
 )
 from shadow_tpu.runtime.compile_cache import CompileCache
@@ -79,6 +81,8 @@ class Batch:
     wall_seconds: float = 0.0
     recoveries: int = 0
     error: "str | None" = None
+    failure: "str | None" = None  # structured kind: capacity/watchdog/...
+    engine_fallbacks: "list[dict]" = dataclasses.field(default_factory=list)
 
     @property
     def replicas(self) -> int:
@@ -174,6 +178,20 @@ class _Preempted(Exception):
     pass
 
 
+def _failure_kind(err: BaseException) -> str:
+    from shadow_tpu.runtime.checkpoint import CheckpointError
+
+    if isinstance(err, CapacityError):
+        return "capacity"
+    if isinstance(err, WatchdogExpired):
+        return "watchdog"
+    if isinstance(err, EngineCompileError):
+        return "compile"
+    if isinstance(err, CheckpointError):
+        return "checkpoint"
+    return type(err).__name__
+
+
 class SweepService:
     """Executes a SweepSpec: packs, queues, runs, preempts, reports.
     One instance per sweep; the compile cache lives for its lifetime."""
@@ -187,6 +205,9 @@ class SweepService:
             j.name: {"now_ns": 0, "events": 0} for j in spec.jobs
         }
         self.job_records: "dict[str, dict]" = {}
+        # per-job failed attempts (the retry/quarantine ladder's budget
+        # counter; docs/service.md "Retries and quarantine")
+        self.job_attempts: "dict[str, int]" = {}
         # Validate every distinct world up front (construction = world
         # validation, one representative job per fingerprint group), so a
         # bad scenario fails as a one-line config error BEFORE any batch
@@ -227,11 +248,42 @@ class SweepService:
 
     def run(self) -> dict:
         """Drain the queue: highest priority first among arrived batches,
-        preempting a lower-priority run when a higher one arrives.
-        Returns (and writes) the sweep manifest."""
+        preempting a lower-priority run when a higher one arrives. A
+        failed batch walks the degradation ladder (split → per-job retry
+        with exponential backoff → quarantine) instead of voiding the
+        sweep — one poison job must never take down the other N−1.
+        Returns (and writes) the sweep manifest.
+
+        When the base scenario carries a `chaos:` section, its FaultPlan
+        is installed once for the whole sweep (chaos is excluded from
+        the packing fingerprint, so it is sweep-global by construction);
+        fault `target`s match job names via the ambient tags each batch
+        scopes."""
+        import contextlib
+
+        from shadow_tpu.runtime import chaos
+
+        plan = (
+            chaos.plan_from_config(self.spec.jobs[0].config.chaos)
+            if self.spec.jobs else None
+        )
+        ctx = (
+            chaos.installed(plan) if plan is not None
+            else contextlib.nullcontext()
+        )
         t0 = time.perf_counter()
         os.makedirs(self.spec.output_dir, exist_ok=True)
-        pending = list(self.batches)
+        with ctx:
+            self._drain(list(self.batches))
+        manifest = self._manifest(time.perf_counter() - t0)
+        if plan is not None:
+            manifest["chaos"] = plan.report()
+        path = os.path.join(self.spec.output_dir, "sweep-manifest.json")
+        with open(path, "w") as f:
+            json.dump(manifest, f, indent=2)
+        return manifest
+
+    def _drain(self, pending: "list[Batch]") -> None:
         while pending:
             ready = [b for b in pending if b.arrival_ns <= self.clock_ns]
             if not ready:
@@ -252,11 +304,84 @@ class SweepService:
                     f"(checkpoint: {batch.resume_ckpt or 'none — restarts'})",
                 )
                 pending.append(batch)
-        manifest = self._manifest(time.perf_counter() - t0)
-        path = os.path.join(self.spec.output_dir, "sweep-manifest.json")
-        with open(path, "w") as f:
-            json.dump(manifest, f, indent=2)
-        return manifest
+            except Exception as e:
+                # EVERY batch error — typed ladder failures (capacity /
+                # watchdog / compile / checkpoint) and untyped runtime
+                # errors alike — walks the split/retry/quarantine ladder:
+                # one poison job must never void the other N−1 or leave
+                # the sweep without a manifest (_failure_kind falls back
+                # to the exception's class name for the manifest record).
+                # KeyboardInterrupt/SystemExit are BaseException and
+                # still abort the sweep.
+                self._handle_failure(batch, e, pending)
+
+    def _requeue_job(self, job: SweepJob, like: Batch) -> Batch:
+        """A fresh single-job batch for a retry/split: same scheduling
+        class as the failed batch, restarted from scratch (a failure
+        voids any preemption checkpoint the attempt left behind)."""
+        nb = Batch(
+            jobs=[job],
+            base_seed=job.seed,
+            stride=1,
+            priority=like.priority,
+            arrival_ns=like.arrival_ns,
+            group_key=like.group_key,
+            index=len(self.batches),
+        )
+        self.batches.append(nb)
+        return nb
+
+    def _handle_failure(self, batch: Batch, err: BaseException,
+                        pending: "list[Batch]") -> None:
+        """The split → retry-with-backoff → quarantine ladder. A multi-
+        job batch failure says nothing about WHICH job poisoned it:
+        split it and retry the jobs individually (an injected or real
+        fault that rode one job now fails only that job's batch). A
+        single-job failure burns one unit of the job's retry_max budget;
+        past the budget the job's terminal status lands in the manifest
+        with its failure kind and the rest of the sweep proceeds:
+        `quarantined` for a repeat offender (it failed again after a
+        retry), plain `failed` when retry_max is 0 and the first failure
+        was terminal."""
+        kind = _failure_kind(err)
+        batch.error = str(err)
+        batch.failure = kind
+        if batch.replicas > 1:
+            batch.status = "split"
+            slog(
+                "warning", self.clock_ns, "sweep",
+                f"batch {batch.index} failed ({kind}); splitting its "
+                f"{batch.replicas} jobs into individual retries",
+            )
+            for job in batch.jobs:
+                pending.append(self._requeue_job(job, batch))
+            return
+        job = batch.jobs[0]
+        attempts = self.job_attempts.get(job.name, 0) + 1
+        self.job_attempts[job.name] = attempts
+        batch.status = "failed"
+        if attempts <= self.spec.retry_max:
+            backoff = self.spec.retry_backoff_s * (2 ** (attempts - 1))
+            slog(
+                "warning", self.clock_ns, "sweep",
+                f"job {job.name} failed ({kind}); retrying "
+                f"(attempt {attempts}/{self.spec.retry_max}"
+                + (f", backoff {backoff:.3g}s" if backoff else "") + ")",
+            )
+            if backoff > 0:
+                time.sleep(backoff)
+            pending.append(self._requeue_job(job, batch))
+            return
+        status = "quarantined" if attempts > 1 else "failed"
+        self.job_records[job.name] = self._job_record(
+            job, batch, status=status, error=str(err), failure=kind,
+        )
+        slog(
+            "warning", self.clock_ns, "sweep",
+            f"job {job.name} {status} after {attempts} failed "
+            f"attempt(s) (last failure: {kind}) — the rest of the sweep "
+            "continues",
+        )
 
     def _batch_config(self, batch: Batch) -> ConfigOptions:
         """The ensemble config a batch runs under: the first job's
@@ -329,13 +454,17 @@ class SweepService:
             compile_cache=self.cache,
             cache_key=batch.group_key,
             on_rows=on_rows,
+            watchdog_s=cfgo.experimental.chunk_watchdog_s,
         )
 
         start_state = None
         start_now = 0
         if batch.resume_ckpt is not None:
+            # resume_ckpt came from latest_path, which verified the
+            # sha-256 digest moments ago — skip the second full hash
             start_state, meta = load_checkpoint(
-                batch.resume_ckpt, runner.initial_state(), fingerprint
+                batch.resume_ckpt, runner.initial_state(), fingerprint,
+                check_digest=False,
             )
             start_now = int(meta["now_ns"])
             slog("info", start_now, "sweep",
@@ -356,8 +485,11 @@ class SweepService:
         last_now = [start_now]
         hb_ns = cfgo.general.heartbeat_interval_ns
         last_hb = [0]
+        chunk_idx = [0]
 
         def on_chunk(probe):
+            from shadow_tpu.runtime import chaos
+
             # the aggregated probe's `now` follows the slowest replica;
             # its delta is the sim time this batch just executed
             self.clock_ns += max(0, probe.now - last_now[0])
@@ -367,6 +499,13 @@ class SweepService:
                 for b in pending
             ):
                 guard.arm()
+            # chaos `preempt` fault: arm the guard with no higher-priority
+            # arrival at all — a storm of these exercises repeated
+            # checkpoint/requeue/resume cycles (each resume is bit-exact,
+            # so the storm cannot change any job's published stats)
+            if chaos.fire("preempt", at=chunk_idx[0]) is not None:
+                guard.arm()
+            chunk_idx[0] += 1
             if hb_ns > 0 and self.clock_ns - last_hb[0] >= hb_ns:
                 last_hb[0] = self.clock_ns
                 slog(
@@ -384,34 +523,46 @@ class SweepService:
             f"base seed {batch.base_seed}, stride {batch.stride}, "
             f"priority {batch.priority})",
         )
+        from shadow_tpu.runtime import chaos
+
         t0 = time.perf_counter()
         try:
-            final = runner.run(
-                end,
-                on_chunk=on_chunk,
-                start_state=start_state,
-                checkpoints=ckpt,
-                guard=guard,
-                recovery=recovery,
-            )
+            # ambient tags = this batch's job names, so a chaos fault
+            # with `target: <job>` fires only in batches carrying it —
+            # the poison-job selector (docs/robustness.md)
+            with chaos.scoped_tags(*[j.name for j in batch.jobs]):
+                final = runner.run(
+                    end,
+                    on_chunk=on_chunk,
+                    start_state=start_state,
+                    checkpoints=ckpt,
+                    guard=guard,
+                    recovery=recovery,
+                )
         except RunInterrupted:
             batch.wall_seconds += time.perf_counter() - t0
+            # latest_path integrity-checks candidates newest-first and
+            # falls back to an older valid checkpoint, so one damaged
+            # final write (chaos ckpt-corrupt, real bit-rot) costs a
+            # partial replay, not the job. The extra read+hash of the
+            # just-written file is the accepted price of that contract.
             batch.resume_ckpt = CheckpointManager.latest_path(ckpt_dir)
             raise _Preempted()
-        except CapacityError as e:
+        except Exception:
+            # the split/retry/quarantine ladder lives in _drain — this
+            # frame keeps the wall accounting honest and preserves what
+            # the batch survived before dying (a quarantined poison job
+            # that went through 4 regrows must show recoveries: 4, not 0)
             batch.wall_seconds += time.perf_counter() - t0
-            batch.status = "failed"
-            batch.error = str(e)
-            for job in batch.jobs:
-                self.job_records[job.name] = self._job_record(
-                    job, batch, status="failed", error=str(e)
-                )
-            slog("warning", self.clock_ns, "sweep",
-                 f"batch {batch.index} failed: {e}")
-            return
+            batch.recoveries = len(getattr(runner, "recovery_report", []))
+            batch.engine_fallbacks = list(
+                getattr(runner, "engine_fallbacks", [])
+            )
+            raise
         batch.wall_seconds += time.perf_counter() - t0
         batch.status = "done"
         batch.recoveries = len(runner.recovery_report)
+        batch.engine_fallbacks = list(getattr(runner, "engine_fallbacks", []))
         self._write_batch_outputs(batch, final, end, runner.recovery_report)
 
     # --- per-job outputs -------------------------------------------------
@@ -478,7 +629,7 @@ class SweepService:
         jmgr._write_outputs(results)
 
     def _job_record(self, job, batch, status, stats=None, error=None,
-                    wall_seconds=None) -> dict:
+                    wall_seconds=None, failure=None) -> dict:
         rec = {
             "name": job.name,
             "entry": job.entry,
@@ -493,10 +644,14 @@ class SweepService:
             "recoveries": batch.recoveries,
             "progress": dict(self.job_progress[job.name]),
         }
+        if job.name in self.job_attempts:
+            rec["failed_attempts"] = self.job_attempts[job.name]
         if wall_seconds is not None:
             rec["wall_seconds"] = wall_seconds
         if stats:
             rec["stats"] = stats
+        if failure:
+            rec["failure"] = failure
         if error:
             rec["error"] = error[:300]
         return rec
@@ -531,6 +686,9 @@ class SweepService:
             "jobs_total": len(self.spec.jobs),
             "jobs_done": len(done),
             "jobs_failed": sum(1 for r in jobs if r.get("status") == "failed"),
+            "jobs_quarantined": sum(
+                1 for r in jobs if r.get("status") == "quarantined"
+            ),
             # standalone-parity signal: `shadow-tpu run` exits nonzero on
             # unroutable packets, so the sweep's exit code must too
             "jobs_unroutable": sum(
@@ -544,6 +702,9 @@ class SweepService:
                 {**b.describe(), "status": b.status,
                  "wall_seconds": round(b.wall_seconds, 4),
                  "preemptions": b.preemptions, "recoveries": b.recoveries,
+                 **({"failure": b.failure} if b.failure else {}),
+                 **({"engine_fallbacks": b.engine_fallbacks}
+                    if b.engine_fallbacks else {}),
                  **({"error": b.error[:300]} if b.error else {})}
                 for b in self.batches
             ],
@@ -559,6 +720,7 @@ def render_report(manifest: dict) -> str:
         f"sweep {manifest['sweep']}: {manifest['jobs_done']}/"
         f"{manifest['jobs_total']} jobs done, "
         f"{manifest['jobs_failed']} failed, "
+        f"{manifest.get('jobs_quarantined', 0)} quarantined, "
         f"{manifest['preemptions']} preemption(s), "
         f"{manifest['wall_seconds']:.2f}s wall",
         f"compile cache: {manifest['compile_cache']['compiles']} compile(s), "
@@ -570,12 +732,19 @@ def render_report(manifest: dict) -> str:
     ]
     for r in manifest["jobs"]:
         s = r.get("stats", {})
+        # failed/quarantined jobs print their structured failure kind —
+        # the stdout report mirrors sweep-manifest.json
+        tail = ""
+        if r.get("failure"):
+            tail = f"  [{r['failure']}]"
+        elif r.get("status") not in ("done", None) and r.get("error"):
+            tail = f"  [{r['error'][:40]}]"
         lines.append(
             f"{r.get('name', '?'):<24} {r.get('seed', '?'):>5} "
             f"{r.get('priority', 0):>4} {r.get('batch', '-'):>5} "
             f"{r.get('status', '?'):<9} "
             f"{s.get('events_handled', '-'):>10} "
-            f"{s.get('packets_sent', '-'):>9}"
+            f"{s.get('packets_sent', '-'):>9}{tail}"
         )
     for entry, table in manifest.get("aggregate", {}).items():
         ev = table["events_handled"]

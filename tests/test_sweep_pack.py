@@ -213,3 +213,12 @@ def test_spec_rejects_replicas_duplicates_and_empty(tmp_path):
         load_sweep_spec({"sweep": {"config": BASE, "jobs": []}})
     with pytest.raises(ValueError, match="exactly one of"):
         load_sweep_spec({"sweep": {"jobs": [{"name": "a", "seeds": [0]}]}})
+    # chaos is sweep-global (one FaultPlan per sweep): a per-entry chaos
+    # override would be silently ignored, so it is rejected loudly
+    with pytest.raises(ValueError, match="chaos is sweep-global"):
+        load_sweep_spec(
+            {"sweep": {"config": BASE,
+                       "jobs": [{"name": "a", "seeds": [0],
+                                 "overrides": {"chaos": {
+                                     "faults": [{"kind": "capacity"}]}}}]}}
+        )
