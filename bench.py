@@ -128,6 +128,11 @@ def _build_world(num_hosts: int, seed: int = 7):
         # free: the next window re-opens over the leftovers and per-host
         # pop order is unchanged.
         max_iters_per_round=256,
+        # tracker plane on (~0% burst overhead, PR 3): every trial's JSON
+        # publishes the adaptive-window width distribution, live-lane
+        # occupancy and round live/idle split, so a regression in
+        # adaptivity is visible in the BENCH_r* trajectory
+        tracker=True,
     )
     model = TgenModel(
         num_hosts=num_hosts,
@@ -194,57 +199,56 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
     eng_env = os.environ.get("SHADOW_TPU_BENCH_ENGINE", "auto")
     engine_choice = None
 
-    # Compile-budget pre-probe (the r05 null fix): BENCH_r05 published
-    # null because ONE rounds_per_chunk=128 compile at full scale blew
-    # the entire 1100 s attempt before any fallback rung ran. Scan
-    # compile cost is ~linear in the scan length, so compiling a TINY
-    # chunk first projects the full-rpc compile wall; if the projection
-    # (times the engines about to compile) doesn't fit the attempt's
-    # deadline, walk 128 -> 32 -> 16 BEFORE paying it. The probe uses
-    # the plain engine, so auto-select mode scales by an extra safety
-    # factor — pump/megakernel lowering (Mosaic) can cost a multiple of
-    # the plain compile, and the guard must err toward smaller chunks:
-    # a too-small rpc costs some dispatch overhead, a too-large one
-    # costs the whole published metric.
+    # Compile-budget autotuner (runtime/autotune.py — the r05 null fix,
+    # generalized): BENCH_r05 published null because ONE
+    # rounds_per_chunk=128 compile at full scale blew the entire 1100 s
+    # attempt before any fallback rung ran. Scan compile cost is ~linear
+    # in the scan length, so a TINY-chunk probe projects the full-rpc
+    # compile wall and walks rounds_per_chunk down BEFORE paying it —
+    # now on EVERY rung (including the SHADOW_TPU_FORCE_CPU fallback),
+    # so no rpc choice can time a child out. The probe uses the plain
+    # engine; auto-select mode scales the projection by the three engine
+    # compiles about to happen x 2.0 engine-variance headroom
+    # (pump/megakernel Mosaic lowering can cost a multiple of the plain
+    # compile — the guard must err toward smaller chunks: a too-small
+    # rpc costs dispatch overhead, a too-large one costs the metric).
+    # SHADOW_TPU_BENCH_AUTOTUNE=0 disables; SHADOW_TPU_AUTOTUNE_CACHE
+    # persists probe walls across children of the same world.
     deadline_s = float(os.environ.get("SHADOW_TPU_BENCH_DEADLINE", 0) or 0)
-    if deadline_s > 0 and rounds_per_chunk > 16:
-        probe_rpc = 4
-        t0p = time.perf_counter()
-        run_until(
-            st0, 10_000_000, model, tables,
-            dataclasses.replace(cfg, engine="plain", pump_k=0),
-            rounds_per_chunk=probe_rpc, tracker=tracker,
+    autotune_plan = None
+    if deadline_s > 0 and os.environ.get("SHADOW_TPU_BENCH_AUTOTUNE", "1") != "0":
+        from shadow_tpu.runtime.autotune import (
+            plan_pump_k,
+            plan_rounds_per_chunk,
         )
-        probe_wall = time.perf_counter() - t0p
-        # auto: three engine compiles, each of UNKNOWN cost relative to
-        # the plain probe — budget 3 compiles x 2.0 engine-variance
-        # headroom; pinned: one compile of (possibly) a slower engine,
-        # keep the 2.0 headroom
+
         n_compiles = (3 if (eng_env == "auto" and pump_env == "auto") else 1) * 2.0
-        budget = deadline_s * 0.45  # leave the rest for the measured run
-        chosen = rounds_per_chunk
-        for cand in (rounds_per_chunk, 32, 16):
-            if cand > rounds_per_chunk:
-                continue
-            chosen = cand
-            if probe_wall * (cand / probe_rpc) * n_compiles <= budget:
-                break
+        autotune_plan = plan_rounds_per_chunk(
+            st0, model, tables, cfg,
+            requested=rounds_per_chunk,
+            budget_s=deadline_s * 0.45,  # leave the rest for the run
+            n_compiles=n_compiles,
+            cache_path=os.environ.get("SHADOW_TPU_AUTOTUNE_CACHE"),
+            tracker=tracker,
+        )
+        # same budget, second knob: cap the pump/megakernel microscan
+        # depth the auto-select trials will trace (an explicit
+        # SHADOW_TPU_BENCH_PUMP_K still wins below)
+        autotune_plan = plan_pump_k(autotune_plan, cfg)
         print(
             json.dumps(
                 {
                     "compile_probe": {
-                        "probe_rpc": probe_rpc,
-                        "probe_wall_s": round(probe_wall, 2),
+                        **autotune_plan.as_dict(),
                         "deadline_s": deadline_s,
-                        "n_compiles": n_compiles,
                         "requested_rpc": rounds_per_chunk,
-                        "chosen_rpc": chosen,
+                        "chosen_rpc": autotune_plan.rounds_per_chunk,
                     }
                 }
             ),
             flush=True,
         )
-        rounds_per_chunk = chosen
+        rounds_per_chunk = autotune_plan.rounds_per_chunk
 
     def _engine_cfg(name, k):
         # pin the engine by NAME, never implicitly via pump_k: the cfg a
@@ -259,6 +263,11 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
         )
 
     _ENGINES = {"plain": 0, "pump": 8, "megakernel": 8}
+    if autotune_plan is not None and autotune_plan.pump_k:
+        # compile-budget cap on the default microscan depth
+        # (runtime/autotune.py plan_pump_k): the trials never trace a
+        # longer pump chain than the budget's projection affords
+        _ENGINES["pump"] = _ENGINES["megakernel"] = autotune_plan.pump_k
     if eng_env != "auto":
         k = int(pump_env) if pump_env.lstrip("-").isdigit() else _ENGINES[eng_env]
         cfg = _engine_cfg(eng_env, k)
@@ -298,6 +307,11 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
         cfg = trials[engine_choice][1]
     t0 = time.perf_counter()
     last_probe = [None]
+    # per-chunk adaptivity capture: deltas of the probe's window/round
+    # lanes give a per-chunk mean window width series -> the histogram
+    # published with the trial (regressions in adaptivity must be visible
+    # in the BENCH_r* trajectory, not just in aggregate means)
+    adapt = WidthCapture()
 
     def on_chunk(probe):
         # probe is the driver's ChunkProbe (already-fetched ints): the
@@ -306,6 +320,7 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
         # wall totals (tracker spans) so a later timeout still leaves
         # the breakdown in the parent's attempt log.
         last_probe[0] = probe
+        adapt.update(probe)
         print(
             json.dumps(
                 {
@@ -388,13 +403,70 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
                     "drop_loss": probe.drop_loss,
                     "drop_codel": probe.drop_codel,
                     "drop_unroutable": probe.drop_unroutable,
-                }
+                },
+                # adaptivity lanes: mean/histogrammed live-window width,
+                # live-lane occupancy, round split — the levers of the
+                # adaptive-window + compaction round, per trial
+                "adaptivity": {
+                    "window_ns_mean": round(probe.window_ns_mean, 1),
+                    "window_ns_hist": adapt.hist(),
+                    "occupancy": round(probe.occupancy(num_hosts), 4),
+                    "lanes_live": probe.lanes_live,
+                    "iters": probe.iters,
+                    "rounds": {
+                        "live": probe.rounds_live,
+                        "idle": probe.rounds_idle,
+                    },
+                },
             }
             if probe is not None
             else {}
         ),
+        **(
+            {"autotune": autotune_plan.as_dict()}
+            if autotune_plan is not None
+            else {}
+        ),
         **({"engine": engine_choice} if engine_choice is not None else {}),
     }
+
+
+class WidthCapture:
+    """Per-chunk mean live-window widths from the probe's CUMULATIVE
+    win_ns_sum / rounds_live counters — the one place the delta math
+    lives, shared with tools/profile_kernels.py part 7 so a probe-lane
+    change cannot skew one published histogram and not the other."""
+
+    def __init__(self):
+        self._prev = (0, 0)
+        self.widths = []
+
+    def update(self, probe) -> None:
+        dw = probe.win_ns_sum - self._prev[0]
+        dr = probe.rounds_live - self._prev[1]
+        if dr > 0:
+            self.widths.append(dw / dr)
+        self._prev = (probe.win_ns_sum, probe.rounds_live)
+
+    def hist(self) -> dict:
+        return _width_hist(self.widths)
+
+
+def _width_hist(widths) -> dict:
+    """Coarse log10 histogram of per-chunk mean window widths (ns):
+    {"1e6-1e7": count, ...} — enough buckets to spot a collapse back to
+    the fixed conservative width without shipping the raw series."""
+    import math
+
+    hist: dict = {}
+    for w in widths:
+        if w <= 0:
+            key = "0"
+        else:
+            k = int(math.floor(math.log10(w)))
+            key = f"1e{k}-1e{k + 1}"
+        hist[key] = hist.get(key, 0) + 1
+    return hist
 
 
 def _measure_ensemble(num_hosts: int, sim_sec: float, replica_counts=(1, 8, 32)):
@@ -890,28 +962,34 @@ def main():
         return
 
     # ---- native C baseline (identical semantics at native speed; see
-    # tools/native_baseline/) — same world size, same horizon -------------
+    # tools/native_baseline/) — same world size, same horizon.
+    # SHADOW_TPU_BENCH_NATIVE=0 skips it (the tier-1 CPU-rung smoke only
+    # asserts the accelerator metric is non-null). ------------------------
     bh = used[0]
-    try:
-        r = subprocess.run(
-            [
-                sys.executable,
-                os.path.join(
-                    os.path.dirname(os.path.abspath(__file__)),
-                    "tools", "native_baseline", "run_native_baseline.py",
-                ),
-                str(bh),
-                str(used[1]),
-            ],
-            env=_cpu_env(),
-            capture_output=True,
-            text=True,
-            timeout=900 if tpu_up else min(240.0, max(_time_left(), 60.0)),
-        )
-        base = json.loads(r.stdout.strip().splitlines()[-1])
-        base_rate = base["rate"]
-    except Exception as e:  # noqa: BLE001 — report, never die
-        base, base_rate = {"error": f"native baseline failed: {e}"}, None
+    skip_native = os.environ.get("SHADOW_TPU_BENCH_NATIVE", "1") == "0"
+    if skip_native:
+        base, base_rate = {"skipped": True}, None
+    else:
+        try:
+            r = subprocess.run(
+                [
+                    sys.executable,
+                    os.path.join(
+                        os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "native_baseline", "run_native_baseline.py",
+                    ),
+                    str(bh),
+                    str(used[1]),
+                ],
+                env=_cpu_env(),
+                capture_output=True,
+                text=True,
+                timeout=900 if tpu_up else min(240.0, max(_time_left(), 60.0)),
+            )
+            base = json.loads(r.stdout.strip().splitlines()[-1])
+            base_rate = base["rate"]
+        except Exception as e:  # noqa: BLE001 — report, never die
+            base, base_rate = {"error": f"native baseline failed: {e}"}, None
 
     # ---- host-scaling crossover (round-4 verdict Next #2): the TPU's
     # per-iteration cost is ~flat in H while the single-core C baseline is

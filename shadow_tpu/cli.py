@@ -75,6 +75,24 @@ def main(argv: "list[str] | None" = None) -> int:
         "(default 1; general.replica_seed_stride)",
     )
     run_p.add_argument(
+        "--autotune",
+        type=float,
+        nargs="?",
+        const=-1.0,
+        metavar="SECONDS",
+        help="enable the compile-budget autotuner: a tiny-chunk compile "
+        "probe walks experimental.rounds_per_chunk down so one config "
+        "knob cannot blow the run's wall budget; optional SECONDS "
+        "overrides experimental.autotune_budget_s (runtime/autotune.py; "
+        "docs/usage.md)",
+    )
+    run_p.add_argument(
+        "--no-autotune",
+        action="store_true",
+        help="force the autotuner off even when the config enables "
+        "experimental.autotune",
+    )
+    run_p.add_argument(
         "--no-recover",
         action="store_true",
         help="disable rollback-and-regrow capacity recovery: fail fast "
@@ -149,6 +167,8 @@ def main(argv: "list[str] | None" = None) -> int:
                 checkpoint_interval=args.checkpoint_interval,
                 resume=args.resume,
                 no_recover=args.no_recover,
+                autotune=args.autotune,
+                no_autotune=args.no_autotune,
                 replicas=args.replicas,
                 replica_seed_stride=args.replica_seed_stride,
                 chunk_watchdog=args.chunk_watchdog,

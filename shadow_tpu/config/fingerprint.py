@@ -44,14 +44,18 @@ _DISPLAY_GENERAL_KEYS = (
     "checkpoint_interval_ns",
     "resume",
 )
-# experimental-section keys that steer the recovery loop, not the
-# trajectory (rollback-and-regrow replays are leaf-exact by contract;
-# the chunk-dispatch watchdog re-dispatches the same chunks)
+# experimental-section keys that steer the recovery loop or the dispatch
+# shape, not the trajectory (rollback-and-regrow replays are leaf-exact
+# by contract; the chunk-dispatch watchdog re-dispatches the same chunks;
+# the autotuner only re-chunks the same rounds — runtime/autotune.py —
+# so a resumed run may re-tune freely)
 _RECOVERY_EXPERIMENTAL_KEYS = (
     "recover",
     "recovery_max_retries",
     "recovery_snapshot_chunks",
     "chunk_watchdog_s",
+    "autotune",
+    "autotune_budget_s",
 )
 
 

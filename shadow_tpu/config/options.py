@@ -186,6 +186,18 @@ class ExperimentalOptions:
     scheduler: str = "tpu"
     runahead_ns: Optional[int] = None  # None = min graph latency
     use_dynamic_runahead: bool = False
+    # Adaptive conservative windows (engine/state.py adaptive_window,
+    # docs/architecture.md "Lookahead & compaction"): extend each round to
+    # the LBTS bound min(next_event + per-node lookahead) instead of the
+    # fixed start + runahead width. Leaf-identical to fixed-width runs;
+    # off only for A/B debugging of the window policy itself. Ignored
+    # under use_dynamic_runahead, where window width moves delivery
+    # times (engine/round.py _next_window_end).
+    adaptive_window: bool = True
+    # Live-host compaction (engine/state.py active_lanes): cap each drain
+    # iteration to this many gathered live host lanes (0 = full width).
+    # Bit-identical results at any value.
+    active_lanes: int = 0
     # Round-engine selection (engine/state.py EngineConfig.engine): all
     # four values are bit-identical on every model; determinism-relevant
     # only in that the config fingerprint pins a resumed run to the exact
@@ -218,6 +230,14 @@ class ExperimentalOptions:
     recover: bool = True
     recovery_max_retries: int = 4
     recovery_snapshot_chunks: int = 32
+    # Compile-budget autotuner (runtime/autotune.py, docs/usage.md): when
+    # true, a tiny-chunk compile probe walks rounds_per_chunk down before
+    # the main compile so one config knob can never blow the whole run's
+    # wall budget. Trajectory-neutral (chunking only groups rounds), so
+    # the keys are excluded from the config fingerprint. CLI:
+    # --autotune SECONDS / --no-autotune.
+    autotune: bool = False
+    autotune_budget_s: float = 120.0
     # Chunk-dispatch watchdog (docs/robustness.md): wall-clock seconds a
     # single chunk dispatch (launch + probe fetch) may take before the
     # driver abandons the in-flight chunk and re-dispatches from the
@@ -241,6 +261,10 @@ class ExperimentalOptions:
         for k in (
             "scheduler",
             "use_dynamic_runahead",
+            "adaptive_window",
+            "active_lanes",
+            "autotune",
+            "autotune_budget_s",
             "engine",
             "pump_k",
             "queue_capacity",

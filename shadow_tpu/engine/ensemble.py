@@ -68,6 +68,7 @@ from shadow_tpu.engine.round import (
     PROBE_QUEUE_OV,
     PROBE_ROUNDS_IDLE,
     PROBE_ROUNDS_LIVE,
+    PROBE_WIN_NS,
     ChunkProbe,
     RunInterrupted,
     _capacity_error,
@@ -92,7 +93,7 @@ from shadow_tpu.engine.state import (
 
 # probe lanes that aggregate across replicas as sums; the rest are
 # extrema (PROBE_NEXT_TIME/PROBE_NOW min, high-water marks / round
-# counters max — see _aggregate_probe)
+# counters / window-width sums max — see _aggregate_probe)
 _SUM_LANES = frozenset(range(PROBE_LANES)) - {
     PROBE_NEXT_TIME,
     PROBE_NOW,
@@ -100,6 +101,7 @@ _SUM_LANES = frozenset(range(PROBE_LANES)) - {
     PROBE_OUTBOX_HWM,
     PROBE_ROUNDS_LIVE,
     PROBE_ROUNDS_IDLE,
+    PROBE_WIN_NS,
 }
 
 
@@ -107,11 +109,12 @@ def ensemble_engine_cfg(cfg: EngineConfig) -> EngineConfig:
     """The engine config an ensemble actually traces: cfg.ensemble arms
     the per-replica done-mask in run_round (semantics-neutral; unbatched
     runs skip its cost — engine/state.py), and the megakernel's
-    pallas_call is not exercised under vmap here, so engine="megakernel"
-    falls back to the XLA pump microscan — the SAME pump microsteps,
-    bit-identical results (tests/test_megakernel.py), one vmappable
-    program."""
-    if cfg.engine == "megakernel":
+    pallas_call is not exercised under vmap here, so a megakernel engine —
+    explicit, or "auto" resolving to it on a real backend
+    (effective_engine) — falls back to the XLA pump microscan: the SAME
+    pump microsteps, bit-identical results (tests/test_megakernel.py),
+    one vmappable program."""
+    if effective_engine(dataclasses.replace(cfg, ensemble=False)) == "megakernel":
         return dataclasses.replace(
             cfg, ensemble=True, engine="pump",
             pump_k=cfg.pump_k if cfg.pump_k > 0 else 8,

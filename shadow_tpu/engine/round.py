@@ -360,33 +360,12 @@ def handle_one_iteration(
     )
 
 
-def handle_one_iteration_compact(
-    st: SimState,
-    window_end: jax.Array,
-    model,
-    tables: RoutingTables,
-    cfg: EngineConfig,
-    lanes: int,
-) -> SimState:
-    """Active-set compaction around handle_one_iteration.
-
-    At scale most hosts are idle in any given pop-iteration (long app
-    pauses, shaping backlogs concentrated on few hosts), yet the
-    full-width iteration pays O(H) work regardless. Here we compact: find
-    the <= `lanes` hosts whose next event is inside the window (O(H)
-    cumsum + scatter), gather their rows of the *entire* SimState into a
-    [lanes]-row sub-state, run the unchanged full iteration there, and
-    scatter the rows back.
-
-    Correctness: hosts are independent within a conservative window (the
-    PDES invariant — packets land next round, local emits stay on-row), so
-    handling any subset per iteration yields bit-identical per-host
-    sequences; eligible hosts beyond `lanes` are simply handled on a later
-    iteration of the same round. Sentinel lanes (when fewer than `lanes`
-    hosts are active) gather row H-1 but are neutralized by forcing their
-    head_time to TIME_MAX (the handler is identity on rows with no popped
-    event) and their write-back is dropped.
-    """
+def _compact_rows(st: SimState, window_end: jax.Array, lanes: int):
+    """The device-side live-lane permutation: lane i -> the i-th host
+    whose next event is inside the window (O(H) cumsum + scatter).
+    Returns (rows_c, rows, live): `rows_c` indexes the gather (sentinel
+    lanes point at row H-1), `live` marks real lanes, `rows` carries the
+    un-clamped targets for the scatter-back."""
     h = st.seq.shape[0]
     elig = equeue.next_time(st.queue) < window_end  # [H]
     pos = jnp.where(elig, jnp.cumsum(elig.astype(jnp.int32)) - 1, lanes)
@@ -396,7 +375,37 @@ def handle_one_iteration_compact(
         .set(jnp.arange(h, dtype=jnp.int32), mode="drop")
     )
     live = rows < h
-    rows_c = jnp.minimum(rows, h - 1)
+    return jnp.minimum(rows, h - 1), rows, live
+
+
+def compact_step(
+    st: SimState, window_end: jax.Array, lanes: int, body
+) -> SimState:
+    """Active-set compaction around one drain-iteration body.
+
+    At scale most hosts are idle in any given pop-iteration (long app
+    pauses, shaping backlogs concentrated on few hosts), yet a
+    full-width iteration pays O(H) work regardless. Here we compact: find
+    the <= `lanes` hosts whose next event is inside the window
+    (_compact_rows), gather their rows of the *entire* SimState into a
+    [lanes]-row sub-state, run the unchanged `body` (the plain handler,
+    or the pump/megakernel stage followed by the handler) there, and
+    scatter the rows back — so the pump microscan and the megakernel's
+    Pallas tiles cover only occupied lanes instead of paying full-[H]
+    microsteps when a handful of hosts are active.
+
+    Correctness: hosts are independent within a conservative window (the
+    PDES invariant — packets land next round, local emits stay on-row),
+    and every op in the bodies is row-local, so handling any subset per
+    iteration yields bit-identical per-host sequences; eligible hosts
+    beyond `lanes` are simply handled on a later iteration of the same
+    round. Sentinel lanes (when fewer than `lanes` hosts are active)
+    gather row H-1 but are neutralized by forcing their head_time to
+    TIME_MAX (both bodies are identity on rows with no popped event) and
+    their write-back is dropped.
+    """
+    h = st.seq.shape[0]
+    rows_c, rows, live = _compact_rows(st, window_end, lanes)
 
     def take(a):
         return a if jnp.ndim(a) == 0 else a[rows_c]
@@ -407,7 +416,7 @@ def handle_one_iteration_compact(
             head_time=jnp.where(live, sub.queue.head_time, TIME_MAX)
         )
     )
-    sub = handle_one_iteration(sub, window_end, model, tables, cfg)
+    sub = body(sub)
 
     back = jnp.where(live, rows, h)  # sentinel writes dropped
 
@@ -417,6 +426,40 @@ def handle_one_iteration_compact(
         return full.at[back].set(g, mode="drop")
 
     return jax.tree.map(put, st, sub)
+
+
+def handle_one_iteration_compact(
+    st: SimState,
+    window_end: jax.Array,
+    model,
+    tables: RoutingTables,
+    cfg: EngineConfig,
+    lanes: int,
+) -> SimState:
+    """compact_step around the plain handler (kept as the named seam the
+    docs/config reference; run_round compacts the whole stage+handler
+    body through compact_step directly)."""
+    return compact_step(
+        st,
+        window_end,
+        lanes,
+        lambda s: handle_one_iteration(s, window_end, model, tables, cfg),
+    )
+
+
+def model_pump_capable(model) -> bool:
+    """Whether the pump/megakernel fast paths can honor this model: it
+    must publish a pump_spec and use none of the hooks the microscan
+    cannot replay (loss counters, packet-outcome / codel-drop callbacks).
+    Models failing this always take the plain handler — bit-identical on
+    every engine value — so run_round's engine selection AND the drivers'
+    reported engine (runtime/scheduler.py) share this predicate."""
+    return (
+        getattr(model, "pump_spec", None) is not None
+        and getattr(model, "LOSS_COUNTER_LANE", None) is None
+        and not hasattr(model, "on_packet_outcomes")
+        and not hasattr(model, "on_codel_drop")
+    )
 
 
 def _has_traffic(st: SimState, axis_name: Optional[str]) -> jax.Array:
@@ -590,20 +633,18 @@ def run_round(
     if compact:
         max_iters *= -(-h_local // lanes)
 
-    # Engine selection. The pump microscan / megakernel stage runs on the
-    # FULL state before each iteration's handler — above the compact path,
-    # whose sentinel-row head_time neutralization must not be disturbed by
-    # the stage's queue mutations. Models without a pump_spec (or with
-    # hooks the fast paths can't honor) always take the plain handler, so
-    # every engine value is bit-identical on every model.
-    pump_capable = (
-        getattr(model, "pump_spec", None) is not None
-        and getattr(model, "LOSS_COUNTER_LANE", None) is None
-        and not hasattr(model, "on_packet_outcomes")
-        and not hasattr(model, "on_codel_drop")
-    )
+    # Engine selection ("auto" resolved by effective_engine: megakernel on
+    # real backends, pump/plain on CPU and under vmap). Models without a
+    # pump_spec (or with hooks the fast paths can't honor) always take the
+    # plain handler, so every engine value is bit-identical on every model.
+    # With compaction, the WHOLE iteration body — pump/megakernel stage
+    # plus the rejection-handler pass — runs on the gathered
+    # [active_lanes]-row sub-state, so the stage's microsteps and the
+    # megakernel's tiles cover only occupied lanes.
+    pump_capable = model_pump_capable(model)
+    eng = effective_engine(cfg)
     stage, stage_cfg = None, cfg
-    if cfg.engine == "megakernel" and pump_capable:
+    if eng == "megakernel" and pump_capable:
         from shadow_tpu.engine.megakernel import (
             megakernel_stage,
             resolve_stage_cfg,
@@ -618,9 +659,7 @@ def run_round(
             from shadow_tpu.engine.pump import pump_stage
 
             stage = pump_stage
-    elif (
-        cfg.engine == "pump" or (cfg.engine == "auto" and cfg.pump_k > 0)
-    ) and pump_capable:
+    elif eng == "pump" and cfg.pump_k > 0 and pump_capable:
         from shadow_tpu.engine.pump import pump_stage
 
         stage = pump_stage
@@ -632,24 +671,33 @@ def run_round(
             iters < max_iters
         )
 
-    def _handler(s):
-        if compact:
-            return handle_one_iteration_compact(
-                s, window_end, model, tables, cfg, lanes
-            )
-        return handle_one_iteration(s, window_end, model, tables, cfg)
-
-    def _step(carry):
-        s, iters = carry
+    def _body(s):
+        """One iteration over whatever rows `s` holds (full or compacted)."""
         if use_pump:
             s, rej = stage(s, window_end, model, tables, stage_cfg)
             # the full handler only runs when some host's head event
             # failed pump classification — pump-only iterations cover the
             # steady packet streams (chains longer than pump_k keep
             # pumping next iteration without a handler pass)
-            s = jax.lax.cond(rej, _handler, lambda x: x, s)
+            return jax.lax.cond(
+                rej,
+                lambda x: handle_one_iteration(
+                    x, window_end, model, tables, cfg
+                ),
+                lambda x: x,
+                s,
+            )
+        return handle_one_iteration(s, window_end, model, tables, cfg)
+
+    def _step(carry):
+        s, iters = carry
+        # live-lane occupancy diagnostic: hosts eligible this iteration
+        elig = equeue.next_time(s.queue) < window_end
+        s = s.replace(lanes_live=s.lanes_live + elig)
+        if compact:
+            s = compact_step(s, window_end, lanes, _body)
         else:
-            s = _handler(s)
+            s = _body(s)
         return s, iters + 1
 
     if cfg.ensemble:
@@ -694,7 +742,10 @@ def run_round(
     )
 
 
-def _next_window_end(st: SimState, end_time, cfg: EngineConfig, axis_name, start=None):
+def _next_window_end(
+    st: SimState, end_time, cfg: EngineConfig, axis_name, start=None,
+    tables: "RoutingTables | None" = None,
+):
     if start is None:
         start = jnp.min(equeue.next_time(st.queue))
         if axis_name is not None:
@@ -710,7 +761,41 @@ def _next_window_end(st: SimState, end_time, cfg: EngineConfig, axis_name, start
         runahead = jnp.maximum(
             runahead, jnp.where(used == TIME_MAX, runahead, used)
         )
-    return jnp.minimum(start + runahead, end_time)
+    floor = jnp.minimum(start + runahead, end_time)
+    # Adaptive windows are gated OFF under dynamic runahead: there the
+    # delivery clamp max(t + lat, window_end) is load-bearing (deliveries
+    # of faster-than-observed paths snap to the round end — that IS the
+    # approximation), so widening the window would move those snapped
+    # delivery times and silently change trajectories vs prior releases.
+    # The leaf-identity proof below covers only the static floor, where
+    # the clamp provably never binds.
+    adaptive = (
+        cfg.adaptive_window
+        and not cfg.use_dynamic_runahead
+        and tables is not None
+        and tables.lookahead_ns is not None
+        and tables.host_node is not None
+    )
+    if not adaptive:
+        return floor
+    # Adaptive window: the LBTS bound min over hosts of (next event time +
+    # the host's node lookahead). Host h cannot make ANY cross- or
+    # self-host effect land before next_time[h] + lookahead[h] (every path
+    # latency out of its node is >= lookahead), so draining [start, bound)
+    # in one round is exactness-preserving: the delivery clamp
+    # max(t + lat, window_end) provably never binds, which is what makes
+    # adaptive runs leaf-identical to fixed-width runs — empty hosts
+    # (next_time = TIME_MAX) do not constrain the window at all, so sparse
+    # worlds drain whole event clusters per round. The fixed width is kept
+    # as a floor: runahead_ns <= every per-node lookahead
+    # (validate_runahead), so the bound can only widen the window.
+    nt = equeue.next_time(st.queue)  # [H] local rows
+    la = tables.lookahead_ns[tables.host_node[st.host_id]]  # [H] i64
+    bound = nt + jnp.minimum(la, TIME_MAX - nt)  # saturating add
+    w = jnp.min(bound)
+    if axis_name is not None:
+        w = jax.lax.pmin(w, axis_name)
+    return jnp.maximum(floor, jnp.minimum(w, end_time))
 
 
 def run_rounds_scan(
@@ -742,9 +827,13 @@ def run_rounds_scan(
         if axis_name is not None:
             start = jax.lax.pmin(start, axis_name)
         has_traffic = _has_traffic(s, axis_name)
-        window_end = _next_window_end(s, end_time, cfg, axis_name, start=start)
+        window_end = _next_window_end(
+            s, end_time, cfg, axis_name, start=start, tables=tables
+        )
 
         def live(s):
+            width = window_end - jnp.minimum(start, window_end)
+            s = s.replace(win_ns_sum=s.win_ns_sum + width)
             s = run_round(s, window_end, model, tables, cfg, axis_name)
             if cfg.tracker:
                 # replicated scalars: every shard runs the same round
@@ -841,7 +930,16 @@ PROBE_QUEUE_HWM = 15
 PROBE_OUTBOX_HWM = 16
 PROBE_ROUNDS_LIVE = 17
 PROBE_ROUNDS_IDLE = 18
-PROBE_LANES = 19
+# adaptivity lanes (always live, like the drop reasons): total drain
+# iterations, total eligible-host lanes across iterations (occupancy
+# numerator), and the summed simulated width of all live windows. NB the
+# derived window_ns_mean needs the tracker's rounds_live as denominator,
+# so it reads 0.0 on tracker-off runs even though win_ns_sum accrues —
+# consumers of the mean (bench, profiler, --tracker stats) run tracker-on
+PROBE_ITERS = 19
+PROBE_LANES_LIVE = 20
+PROBE_WIN_NS = 21
+PROBE_LANES = 22
 
 
 def state_probe(st: SimState, axis_name: Optional[str] = None) -> jax.Array:
@@ -867,24 +965,27 @@ def state_probe(st: SimState, axis_name: Optional[str] = None) -> jax.Array:
         jnp.sum(tr.bytes_ctrl),
         jnp.sum(tr.bytes_data),
         jnp.sum(tr.retrans_segs),
+        jnp.sum(st.iters_done).astype(jnp.int64),
+        jnp.sum(st.lanes_live),
     ]
     maxes = [
         st.now,
         jnp.max(tr.queue_hwm).astype(jnp.int64),
         jnp.max(tr.outbox_hwm).astype(jnp.int64),
     ]
-    rounds = [tr.rounds_live, tr.rounds_idle]  # replicated scalars
+    # replicated scalars (win_ns_sum is mesh-uniform: pmin'd window math)
+    rounds = [tr.rounds_live, tr.rounds_idle, st.win_ns_sum]
     if axis_name is not None:
         nt = jax.lax.pmin(nt, axis_name)
         sums = [jax.lax.psum(x, axis_name) for x in sums]
         maxes = [jax.lax.pmax(x, axis_name) for x in maxes]
         rounds = [jax.lax.pmax(x, axis_name) for x in rounds]
     now, qh, oh = maxes
-    (ov, ev, pk, qov, oov, evl, evt, dl, dc, du, bc, bd, rx) = sums
-    rl, ri = rounds
+    (ov, ev, pk, qov, oov, evl, evt, dl, dc, du, bc, bd, rx, it, ll) = sums
+    rl, ri, wn = rounds
     return jnp.stack(
         [nt, ov, now, ev, pk, qov, oov, evl, evt, dl, dc, du, bc, bd, rx,
-         qh, oh, rl, ri]
+         qh, oh, rl, ri, it, ll, wn]
     ).astype(jnp.int64)
 
 
@@ -914,11 +1015,31 @@ class ChunkProbe:
     outbox_hwm: int
     rounds_live: int
     rounds_idle: int
+    iters: int
+    lanes_live: int
+    win_ns_sum: int
 
     @property
     def ev_packet(self) -> int:
         """Packet events handled (total minus the local/tcp classes)."""
         return self.events_handled - self.ev_local - self.ev_tcp
+
+    @property
+    def window_ns_mean(self) -> float:
+        """Mean simulated width of the live windows drained so far.
+        Requires cfg.tracker (rounds_live is a tracker counter): a
+        tracker-off run accrues win_ns_sum but reads 0.0 here."""
+        return self.win_ns_sum / self.rounds_live if self.rounds_live else 0.0
+
+    def occupancy(self, num_hosts: int, num_shards: int = 1) -> float:
+        """Mean fraction of host lanes holding an eligible event per drain
+        iteration — the quantity live-host compaction exploits. `iters`
+        aggregates per-shard (or per-replica) loop counts while each of
+        those iterations scans only num_hosts/num_shards lanes, so a
+        sharded probe must pass its shard count or occupancy under-reports
+        by exactly that factor."""
+        denom = self.iters * (num_hosts // max(num_shards, 1))
+        return self.lanes_live / denom if denom else 0.0
 
     @classmethod
     def from_array(cls, arr) -> "ChunkProbe":
@@ -984,11 +1105,25 @@ class EngineCompileError(RuntimeError):
 
 
 def effective_engine(cfg) -> str:
-    """The engine an "auto" config actually runs (pump when pump_k > 0,
-    else plain) — the name chaos `compile` faults target and engine
-    fallback records report (runtime/chaos.py)."""
+    """The engine an "auto" config actually runs — the single resolution
+    seam run_round's engine selection, the chaos `compile` fault targets,
+    and the fallback-ladder records all share (runtime/chaos.py,
+    runtime/scheduler.py). Resolution order (docs/megakernel.md):
+
+      1. an explicit engine name always wins;
+      2. "auto" on a real (non-CPU) backend resolves to the megakernel —
+         safe as a default since the PR-8 fallback ladder degrades a
+         failed megakernel compile to pump/plain with bit-identical
+         results — except under the ensemble plane (cfg.ensemble), where
+         pallas_call is not exercised under vmap and "auto" resolves to
+         the pump;
+      3. "auto" on CPU (and under vmap) keeps the prior behavior: pump
+         when pump_k > 0, else plain.
+    """
     if cfg.engine != "auto":
         return cfg.engine
+    if not cfg.ensemble and jax.default_backend() != "cpu":
+        return "megakernel"
     return "pump" if cfg.pump_k > 0 else "plain"
 
 
@@ -1029,6 +1164,9 @@ def host_stats(st: SimState) -> dict:
             "outbox_hwm": st.tracker.outbox_hwm,
             "rounds_live": st.tracker.rounds_live,
             "rounds_idle": st.tracker.rounds_idle,
+            "iters_done": st.iters_done,
+            "lanes_live": st.lanes_live,
+            "win_ns_sum": st.win_ns_sum,
         }
     )
 

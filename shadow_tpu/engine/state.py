@@ -45,6 +45,22 @@ class EngineConfig:
     # graph minimum; correctness is preserved by the deliver-time clamp to
     # round end (worker.rs:399-402), identical to the reference's semantics.
     use_dynamic_runahead: bool = False
+    # Adaptive conservative windows (engine/round.py _next_window_end):
+    # extend each round's window to min over hosts of
+    # (next_event_time + per-node lookahead), the Chandy–Misra/Fujimoto
+    # LBTS bound, instead of the fixed start + runahead_ns width. Every
+    # packet a host emits delivers at >= its next event time + its node's
+    # min outgoing path latency, so the round-end delivery clamp provably
+    # never binds: adaptive runs are leaf-identical to fixed-width runs
+    # (tests/test_adaptive_window.py) while draining a cluster of events
+    # in fewer, wider rounds. Requires RoutingTables.lookahead_ns (set by
+    # compute_routing); hand-built tables without it fall back to the
+    # fixed width. Unlike use_dynamic_runahead this cannot change any
+    # delivery time, which is why it can default ON — and why the engine
+    # ignores it when use_dynamic_runahead is set: under dynamic runahead
+    # the round-end clamp DOES move delivery times, so window width is
+    # semantics-bearing there and stays fixed.
+    adaptive_window: bool = True
     # Sharded round-boundary exchange (the cross-chip seam, the analogue of
     # worker.rs:619-629): "all_to_all" buckets outbox entries by destination
     # shard and exchanges only each peer's bucket over ICI; "all_gather"
@@ -143,6 +159,16 @@ class EngineConfig:
             self.megakernel_tile > 0 and self.num_hosts % self.megakernel_tile
         ):
             raise ValueError("megakernel_tile must be 0 or divide num_hosts")
+        if (
+            0 < self.active_lanes
+            and self.megakernel_tile > 0
+            and self.active_lanes % self.megakernel_tile
+        ):
+            # compacted iterations hand the megakernel an active_lanes-row
+            # sub-state; an explicit tile must divide that too
+            raise ValueError(
+                "megakernel_tile must divide active_lanes when both are set"
+            )
 
 
 def trace_static_cfg(cfg: EngineConfig) -> EngineConfig:
@@ -261,6 +287,18 @@ class SimState:
     # diagnostic: pop-iterations executed, accumulated on each shard's row 0
     # (sum over the axis = total device iterations; feeds the perf probes)
     iters_done: jax.Array  # [H] i32
+    # diagnostic: per-host count of drain iterations in which this host had
+    # an eligible event (next_time < window_end) — the live-lane occupancy
+    # numerator (occupancy = sum(lanes_live) / (iters * H)). Like
+    # iters_done it depends on the engine's iteration structure (the pump
+    # drains chains in fewer iterations), so engine-equivalence tests
+    # exclude it alongside iters_done.
+    lanes_live: jax.Array  # [H] i64
+    # diagnostic: total simulated width of all live windows drained so far
+    # (sum of window_end - start per live round). Mesh-uniform by
+    # construction (the window agreement is pmin'd), so the scalar stays
+    # replicated sharded; mean window width = win_ns_sum / rounds_live.
+    win_ns_sum: jax.Array  # scalar i64
     # the tracker plane (zeros unless EngineConfig.tracker is set)
     tracker: TrackerState
 
@@ -440,5 +478,7 @@ def init_state(
         packets_dropped=jnp.zeros((h,), jnp.int64),
         packets_unroutable=jnp.zeros((h,), jnp.int64),
         iters_done=jnp.zeros((h,), jnp.int32),
+        lanes_live=jnp.zeros((h,), jnp.int64),
+        win_ns_sum=jnp.asarray(0, jnp.int64),
         tracker=_empty_tracker(h),
     )

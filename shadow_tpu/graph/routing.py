@@ -39,6 +39,16 @@ class RoutingTables:
     lat_ns: jax.Array  # [N, N] i64
     rel: jax.Array  # [N, N] f32
     host_node: "jax.Array | None" = None  # [H_global] i32
+    # Per-node conservative lookahead: the minimum finite path latency out
+    # of each node (self-loops included), i.e. a lower bound on how far in
+    # the future ANY packet emitted by a host on that node can land. The
+    # round engine's adaptive window (engine/round.py _next_window_end)
+    # extends the conservative window to min over hosts of
+    # (next_event_time + lookahead) — the classic Chandy–Misra/Fujimoto
+    # LBTS bound — which is exactness-preserving because the round-end
+    # delivery clamp provably never binds under it. TIME_MAX for nodes
+    # with no finite outgoing path (their packets are all unroutable).
+    lookahead_ns: "jax.Array | None" = None  # [N] i64
 
     @property
     def num_nodes(self) -> int:
@@ -53,6 +63,13 @@ class RoutingTables:
         if hn.ndim != 1:
             raise ValueError("host_node must be 1-D [num_hosts]")
         return self.replace(host_node=hn)
+
+    def with_lookahead(self) -> "RoutingTables":
+        """Attach the per-node lookahead (row-min of the latency table).
+        The min over any row equals the node's min outgoing edge latency:
+        every path's latency is bounded below by its first hop."""
+        row_min = jnp.min(self.lat_ns, axis=1)
+        return self.replace(lookahead_ns=jnp.minimum(row_min, TIME_MAX))
 
     def min_path_latency_ns(self) -> int:
         """Minimum finite path latency — upper bound for a valid runahead."""
@@ -124,7 +141,9 @@ def compute_routing(
     if not use_shortest_path:
         # direct-edges-only mode (reference graph/mod.rs:232-254): the table
         # is just the adjacency, self-loops included.
-        return RoutingTables(lat_ns=jnp.asarray(lat0[:n, :n]), rel=jnp.asarray(rel0[:n, :n]))
+        return RoutingTables(
+            lat_ns=jnp.asarray(lat0[:n, :n]), rel=jnp.asarray(rel0[:n, :n])
+        ).with_lookahead()
 
     np_n = lat0.shape[0]
     # transit computation runs with a free (0-cost) diagonal…
@@ -156,4 +175,4 @@ def compute_routing(
     lat_sp = lat_sp.at[di, di].set(self_lat)
     rel_sp = rel_sp.at[di, di].set(self_rel)
 
-    return RoutingTables(lat_ns=lat_sp[:n, :n], rel=rel_sp[:n, :n])
+    return RoutingTables(lat_ns=lat_sp[:n, :n], rel=rel_sp[:n, :n]).with_lookahead()

@@ -37,6 +37,8 @@ def run_from_config(
     checkpoint_interval: "str | None" = None,
     resume: bool = False,
     no_recover: bool = False,
+    autotune: "float | None" = None,
+    no_autotune: bool = False,
     replicas: "int | None" = None,
     replica_seed_stride: "int | None" = None,
     chunk_watchdog: "float | None" = None,
@@ -68,6 +70,13 @@ def run_from_config(
         config.general.resume = True
     if no_recover:
         config.experimental.recover = False
+    if autotune is not None:
+        # bare --autotune keeps the config's budget (const = -1.0)
+        config.experimental.autotune = True
+        if autotune >= 0:
+            config.experimental.autotune_budget_s = autotune
+    if no_autotune:
+        config.experimental.autotune = False
     if replicas is not None:
         if replicas < 1:
             raise CliUserError("--replicas must be >= 1")

@@ -281,12 +281,20 @@ def flatten_host_stats(hs: dict) -> dict:
     fetch into the flat shape the host-side tracker fold expects
     (utils/tracker.py sums/maxes over one axis): per-host arrays flatten
     to [R*H]; the per-replica round scalars reduce to their max (exact
-    per-replica rounds live in the `ensemble` stats block instead)."""
+    per-replica rounds live in the `ensemble` stats block instead). The
+    window-width pair is the exception: mean_ns = win_ns_sum /
+    rounds_live must take BOTH from the same population, so the fold
+    gets the across-replica totals (win_rounds_live carries the summed
+    denominator; maxing each independently would divide numbers from
+    different replicas and report a mean no replica actually had)."""
     out = {}
     for k, v in hs.items():
         a = np.asarray(v)
-        if k in ("rounds_live", "rounds_idle"):
+        if k == "win_ns_sum":
+            out[k] = int(a.sum())
+        elif k in ("rounds_live", "rounds_idle"):
             out[k] = int(a.max())
         else:
             out[k] = a.reshape(-1)
+    out["win_rounds_live"] = int(np.asarray(hs["rounds_live"]).sum())
     return out

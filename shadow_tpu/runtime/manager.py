@@ -274,6 +274,8 @@ class Manager:
             use_netstack=use_netstack,
             bootstrap_end_ns=cfgo.general.bootstrap_end_time_ns,
             use_dynamic_runahead=cfgo.experimental.use_dynamic_runahead,
+            adaptive_window=cfgo.experimental.adaptive_window,
+            active_lanes=cfgo.experimental.active_lanes,
             engine=cfgo.experimental.engine,
             pump_k=cfgo.experimental.pump_k,
             tracker=cfgo.general.tracker,
@@ -324,6 +326,83 @@ class Manager:
         tx_refill, rx_refill = world.tx_refill, world.rx_refill
         ecfg, ckpt, guard, resume_path = self._setup_checkpointing(world.ecfg)
 
+        rounds_per_chunk = cfgo.experimental.rounds_per_chunk
+        autotune_plan = None
+        if (
+            cfgo.experimental.autotune
+            and cfgo.experimental.scheduler != "tpu"
+        ):
+            # never silently drop the flag: the user asked for compile-
+            # budget protection the other schedulers don't dispatch through
+            slog(
+                "warning", 0, "autotune",
+                f"experimental.autotune only applies to the tpu scheduler "
+                f"(scheduler={cfgo.experimental.scheduler}); ignoring",
+            )
+        elif cfgo.experimental.autotune:
+            # Compile-budget autotuner (runtime/autotune.py): a tiny-chunk
+            # probe projects the full compile wall and walks
+            # rounds_per_chunk down to fit the budget BEFORE the main
+            # compile. Trajectory-neutral (chunking only groups rounds),
+            # so resume/checkpoints are unaffected; probe walls persist
+            # in the data directory keyed by the canonicalized config.
+            import os as _os
+
+            from shadow_tpu.engine.state import init_state as _init_state
+            from shadow_tpu.runtime.autotune import plan_rounds_per_chunk
+
+            from shadow_tpu.engine.round import bootstrap as _bootstrap
+
+            def _probe_state():
+                # built lazily: a warm probe cache (or the rpc floor /
+                # zero budget) answers without ever paying this
+                # full-width init + bootstrap
+                return _bootstrap(
+                    _init_state(
+                        ecfg, model.init(),
+                        tx_bytes_per_interval=tx_refill,
+                        rx_bytes_per_interval=rx_refill,
+                    ),
+                    model, ecfg,
+                )
+
+            cache_path = None
+            if cfgo.general.data_directory:
+                cache_path = _os.path.join(
+                    cfgo.general.data_directory, "autotune.json"
+                )
+            try:
+                autotune_plan = plan_rounds_per_chunk(
+                    _probe_state, model, tables, ecfg,
+                    requested=rounds_per_chunk,
+                    budget_s=cfgo.experimental.autotune_budget_s,
+                    cache_path=cache_path,
+                )
+            except Exception as e:  # noqa: BLE001 — the autotuner is an
+                # optimization, never a failure: a probe crash (including
+                # a chaos fault landing on the probe's chunk-0 dispatch,
+                # which runs inside the installed plan but outside the
+                # fallback/recovery ladders) degrades to the requested
+                # chunking; the main run still hits any REAL error through
+                # the proper recovery seams
+                slog(
+                    "warning", 0, "autotune",
+                    f"compile probe failed ({type(e).__name__}: {e}); "
+                    f"keeping rounds_per_chunk={rounds_per_chunk}",
+                )
+                autotune_plan = None
+            if autotune_plan is not None:
+                rounds_per_chunk = autotune_plan.rounds_per_chunk
+                if rounds_per_chunk != autotune_plan.requested:
+                    slog(
+                        "info", 0, "autotune",
+                        f"rounds_per_chunk {autotune_plan.requested} -> "
+                        f"{rounds_per_chunk} "
+                        f"(probe {autotune_plan.probe_wall_s}s"
+                        f" at rpc={autotune_plan.probe_rpc}, budget "
+                        f"{autotune_plan.budget_s}s, {autotune_plan.source})",
+                    )
+
         replicas = cfgo.general.replicas
         if replicas > 1:
             # Ensemble plane (docs/ensemble.md): R vmapped replicas in one
@@ -338,7 +417,7 @@ class Manager:
                 ecfg,
                 num_replicas=replicas,
                 seed_stride=cfgo.general.replica_seed_stride,
-                rounds_per_chunk=cfgo.experimental.rounds_per_chunk,
+                rounds_per_chunk=rounds_per_chunk,
                 tx_bytes_per_interval=tx_refill,
                 rx_bytes_per_interval=rx_refill,
                 watchdog_s=cfgo.experimental.chunk_watchdog_s,
@@ -351,7 +430,7 @@ class Manager:
                 ecfg,
                 host_node,
                 parallelism=cfgo.general.parallelism,
-                rounds_per_chunk=cfgo.experimental.rounds_per_chunk,
+                rounds_per_chunk=rounds_per_chunk,
                 tx_bytes_per_interval=tx_refill,
                 rx_bytes_per_interval=rx_refill,
                 watchdog_s=cfgo.experimental.chunk_watchdog_s,
@@ -397,8 +476,10 @@ class Manager:
                 )
 
         rep_note = f"{replicas} replicas, " if replicas > 1 else ""
+        eng = getattr(sched, "engine", None)
+        eng_note = f"engine={eng}, " if eng else ""
         slog("info", 0, "manager", f"starting: {num_hosts} hosts, {rep_note}"
-             f"scheduler={sched.name}, "
+             f"scheduler={sched.name}, {eng_note}"
              f"runahead={runahead}ns, stop={fmt_time_ns(end)}")
         t0 = time.perf_counter()
         if isinstance(sched, CpuRefScheduler):
@@ -483,6 +564,10 @@ class Manager:
                 "engine_fallbacks": list(fallbacks),
                 "watchdog_redispatches": watchdogs,
             }
+        if autotune_plan is not None:
+            # what the autotuner decided and on what evidence — an
+            # autotuned run is visibly autotuned in sim-stats.json
+            results.extra_stats["autotune"] = autotune_plan.as_dict()
         self._fold_chaos(results)
         host_tensors = None
         if replicas > 1:
@@ -500,6 +585,14 @@ class Manager:
                 end / NS_PER_SEC,
                 seed_stride=cfgo.general.replica_seed_stride,
                 host_tensors=host_tensors,
+            )
+        if tracker is not None:
+            # occupancy denominator: iters_done sums per-shard (or, after
+            # the ensemble flatten, per-replica) drain-loop counts, each
+            # covering only H/planes lanes (utils/tracker.py num_shards)
+            tracker.num_shards = (
+                replicas if replicas > 1
+                else getattr(sched, "num_devices", 1)
             )
         self._fold_tracker(
             tracker, results, end,

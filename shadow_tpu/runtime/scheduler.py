@@ -18,7 +18,12 @@ import numpy as np
 
 from shadow_tpu.cpu_ref import CpuRefPhold
 from shadow_tpu.engine import EngineConfig
-from shadow_tpu.engine.round import bootstrap, run_until
+from shadow_tpu.engine.round import (
+    bootstrap,
+    effective_engine,
+    model_pump_capable,
+    run_until,
+)
 from shadow_tpu.engine.sharded import AXIS, ShardedRunner
 from shadow_tpu.engine.state import init_state
 from shadow_tpu.graph.routing import RoutingTables
@@ -45,6 +50,18 @@ class TpuScheduler:
         while n > 1 and cfg.num_hosts % n != 0:
             n -= 1
         self.num_devices = n
+        # the engine run_round actually executes on THIS backend for THIS
+        # model ("auto" resolves megakernel-first on real accelerators —
+        # engine/round.py effective_engine, docs/megakernel.md "Engine
+        # selection"), mirroring run_round's own substitutions so the
+        # start log never advertises a faster engine than runs: models
+        # the fast paths can't honor take the plain handler, and sharded
+        # runs keep the XLA pump (pallas_call under shard_map untested)
+        self.engine = effective_engine(cfg)
+        if not model_pump_capable(model):
+            self.engine = "plain"
+        elif n > 1 and self.engine == "megakernel":
+            self.engine = "pump"
         if n > 1:
             from jax.sharding import Mesh
 

@@ -41,6 +41,8 @@ import json
 import threading
 import time
 
+import numpy as np
+
 
 # Span-list bound: beyond this many recorded events new spans fold into
 # the running per-phase totals only (the Chrome trace and percentiles
@@ -93,6 +95,14 @@ class Tracker:
         self._next_hb = heartbeat_ns if heartbeat_ns > 0 else None
         self.last_probe = None  # latest ChunkProbe seen (aggregates)
         self._final_hosts: "dict | None" = None  # last bulk host_stats
+        # independent iteration planes behind the folded host tensors:
+        # iters_done sums PER-PLANE drain-loop counts (one count per
+        # shard's row 0, or per replica after the ensemble flatten) while
+        # each such iteration scans only H/planes lanes — the occupancy
+        # denominator must shrink by the same factor or a sharded run
+        # under-reports occupancy by exactly the shard count. The manager
+        # sets this to num_devices (sharded) or replicas (ensemble).
+        self.num_shards = 1
         # rollback-and-regrow recovery records (runtime/recovery.py):
         # folded into stats_dict and marked in the trace as instants
         self.recoveries: "list[dict]" = []
@@ -308,6 +318,28 @@ class Tracker:
             out["rounds"] = {
                 "live": int(hs["rounds_live"]),
                 "idle": int(hs["rounds_idle"]),
+            }
+            # adaptivity: window widths + live-lane occupancy (the levers
+            # of the adaptive-window/compaction round, docs/architecture.md
+            # "Lookahead & compaction")
+            # mean width must pair win_ns_sum with the SAME population's
+            # live-round count: the ensemble flatten sums win_ns_sum
+            # across replicas and supplies the summed denominator as
+            # win_rounds_live (runtime/ensemble.py flatten_host_stats);
+            # single runs fall back to the run's own rounds_live
+            live = int(hs.get("win_rounds_live", hs["rounds_live"]))
+            iters = int(np.asarray(hs["iters_done"]).sum())
+            lanes = int(np.asarray(hs["lanes_live"]).sum())
+            # lanes scanned per iteration: the full row count divided by
+            # the iteration planes (shards / flattened replicas) whose
+            # loop counts iters sums — see num_shards in __init__
+            h = int(np.asarray(hs["lanes_live"]).size) // max(self.num_shards, 1)
+            out["window"] = {
+                "win_ns_sum": int(hs["win_ns_sum"]),
+                "mean_ns": round(int(hs["win_ns_sum"]) / live, 1) if live else 0,
+                "iters": iters,
+                "lanes_live": lanes,
+                "occupancy": round(lanes / (iters * h), 4) if iters and h else 0,
             }
         elif self.last_probe is not None:
             p = self.last_probe

@@ -89,6 +89,21 @@ SLOW_TESTS = {
     "test_tgen_compact_bit_identical",
     "test_transfer_completes_under_loss",
     "test_unmatched_segment_draws_rst",
+    # ~38 s solo (two end-to-end 64 MB managed-guest runs); under
+    # full-suite contention the guests' syscall waits flake on wall time
+    # (CHANGES.md PR 8) — the structural work-ratio assertions inside it
+    # are contention-proof, the wall is not, so it runs in the full tier
+    "test_bulk_pipe_stream_integrity_and_speed",
+    # the adaptive-window equivalence MATRIX (engines x tgen, sharded,
+    # ensemble) pays an XLA compile per cell (~40-90 s each on this box);
+    # the quick tier keeps the tentpole pins (phold leaf-exactness +
+    # iteration reduction, checkpoint roundtrip, the bench smoke)
+    "test_adaptive_matches_fixed_tgen_engines",
+    "test_adaptive_matches_fixed_sharded",
+    "test_adaptive_matches_fixed_ensemble_slices",
+    # ~25 s; the quick tier already runs the real checkpoint machinery
+    # with adaptive windows on by default (tests/test_robustness.py)
+    "test_adaptive_checkpoint_roundtrip_leaf_exact",
 }
 
 

@@ -1,0 +1,88 @@
+"""Tier-1 bench-harness smoke (the r05 null-regression guard): the
+forced-CPU tiny rung must publish a NON-NULL metric, with every rung
+running under the compile-budget autotuner (runtime/autotune.py) so no
+rounds_per_chunk choice can time the child out — the published
+`compile_probe` line must show the requested rpc corrected down when
+its projected compile wall does not fit the budget.
+
+This is the one deliberately-heavy test in the quick tier (~1 min, one
+XLA compile of the tgen world on CPU): BENCH_r04/r05 both shipped with
+the metric one config knob away from null, and the only thing that
+actually pins "the bench cannot publish null" is running the real
+harness end to end. Every optional section (native baseline, scaling
+table, ensemble/sweep trials) is disabled via its env switch, and the
+autotuner's probe cache is pre-seeded with an inflated probe wall — the
+planner then corrects the rpc from the cache without paying the probe's
+own scan compile (tier-1 budget; the live-probe path runs in the CLI
+and the full-scale bench, and if the cache key ever drifts this test
+still passes, just paying the probe again)."""
+
+import json
+import os
+import subprocess
+import sys
+
+BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py")
+
+
+def _seed_probe_cache(path) -> None:
+    """Write a probe-wall entry for the exact world the CPU rung builds
+    (bench._build_world(64)), inflated so any rpc > the floor projects
+    past the budget — the r05 misconfiguration, injected via the cache."""
+    from bench import _build_world
+    from shadow_tpu.runtime.autotune import PROBE_RPC, _cache_key
+
+    cfg, _, _ = _build_world(64)
+    key = _cache_key(cfg, PROBE_RPC, "cpu")
+    path.write_text(json.dumps({key: {"probe_wall_s": 600.0}}))
+
+
+def test_bench_cpu_rung_publishes_non_null(tmp_path):
+    cache = tmp_path / "autotune.json"
+    _seed_probe_cache(cache)
+    env = dict(
+        os.environ,
+        SHADOW_TPU_FORCE_CPU="1",
+        SHADOW_TPU_BENCH_HOSTS="64",
+        SHADOW_TPU_BENCH_CPU_HOSTS="64",
+        SHADOW_TPU_BENCH_CPU_SIMSEC="0.02",
+        SHADOW_TPU_BENCH_NATIVE="0",
+        SHADOW_TPU_BENCH_SCALING="",
+        SHADOW_TPU_BENCH_ENSEMBLE="0",
+        SHADOW_TPU_BENCH_SWEEP="0",
+        SHADOW_TPU_AUTOTUNE_CACHE=str(cache),
+    )
+    r = subprocess.run(
+        [sys.executable, BENCH],
+        env=env, capture_output=True, text=True, timeout=700,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    last = json.loads(r.stdout.strip().splitlines()[-1])
+
+    # the whole point: the harness publishes a number, never null
+    assert last["value"] is not None and last["value"] > 0, last
+    assert last["unit"] == "sim_s/wall_s"
+
+    detail = last["detail"]
+    main = detail["main"]
+    assert main["events"] > 0
+
+    # every rung ran under the autotuner, and the decision is published
+    at = main["autotune"]
+    assert at["source"] in ("probe", "cache", "floor")
+    assert at["rounds_per_chunk"] <= at["requested"]
+
+    # the attempt log carries the compile_probe line: a requested rpc
+    # whose projected compile blows the budget is corrected DOWN before
+    # the main compile (the r05 failure mode, inverted)
+    probe = detail["attempts"][0]["compile_probe"]
+    assert probe["chosen_rpc"] <= probe["requested_rpc"]
+    assert main["rounds_per_chunk"] == at["rounds_per_chunk"]
+
+    # adaptivity lanes are published per trial (window widths, live-lane
+    # occupancy) so a regression in adaptivity is visible in BENCH_r*
+    ad = main["adaptivity"]
+    assert ad["iters"] > 0 and ad["lanes_live"] > 0
+    assert 0 < ad["occupancy"] <= 1
+    assert ad["window_ns_mean"] > 0
+    assert ad["rounds"]["live"] > 0

@@ -72,8 +72,8 @@ def _assert_states_equal(a, b):
     assert len(fa) == len(fb)
     for (path, x), y in zip(fa, fb):
         name = jax.tree_util.keystr(path)
-        if "iters_done" in name:
-            continue  # diagnostic: compaction legitimately splits waves
+        if "iters_done" in name or "lanes_live" in name:
+            continue  # diagnostics: compaction legitimately splits waves
         if jnp.issubdtype(getattr(x, "dtype", None), jax.dtypes.prng_key):
             x, y = jax.random.key_data(x), jax.random.key_data(y)
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y), err_msg=name)

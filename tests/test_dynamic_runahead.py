@@ -46,6 +46,12 @@ def _run(dynamic: bool, rounds: int):
         queue_capacity=32,
         runahead_ns=graph.min_latency_ns(),
         use_dynamic_runahead=dynamic,
+        # this suite pins the dynamic-runahead mechanism in isolation:
+        # the engine gates adaptive windows off under dynamic runahead
+        # (window width is semantics-bearing there), but the STATIC
+        # baseline leg would still be widened by the adaptive LBTS bound
+        # on exactly this topology (tests/test_adaptive_window.py)
+        adaptive_window=False,
     )
     model = PholdModel(num_hosts=8, min_delay_ns=NS_PER_MS, max_delay_ns=5 * NS_PER_MS)
     st = init_state(cfg, model.init())
@@ -76,6 +82,7 @@ def test_dynamic_matches_static_results():
             queue_capacity=32,
             runahead_ns=graph.min_latency_ns(),
             use_dynamic_runahead=dynamic,
+            adaptive_window=False,
         )
         model = PholdModel(num_hosts=8, min_delay_ns=NS_PER_MS, max_delay_ns=5 * NS_PER_MS)
         st = init_state(cfg, model.init())

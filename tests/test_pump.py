@@ -94,7 +94,10 @@ def _normalize(st):
         aux=jnp.asarray(aux[oi, order]),
         data=jnp.asarray(data[oi, order]),
     )
-    return st.replace(queue=q, iters_done=st.iters_done * 0)
+    # iters_done/lanes_live count engine iterations, not simulation state
+    return st.replace(
+        queue=q, iters_done=st.iters_done * 0, lanes_live=st.lanes_live * 0
+    )
 
 
 def _assert_states_equal(a, b):

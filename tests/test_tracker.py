@@ -200,6 +200,53 @@ def test_probe_tracker_lanes_consistent():
     assert p.queue_overflow == 0 and p.outbox_overflow == 0
 
 
+def test_ensemble_flatten_pairs_window_numerator_and_denominator():
+    """mean_ns = win_ns_sum / live must take BOTH terms from the same
+    population: the ensemble flatten sums win_ns_sum across replicas and
+    ships the summed live-round denominator as win_rounds_live — maxing
+    each independently would divide replica A's width sum by replica B's
+    round count and publish a mean no replica actually had."""
+    import numpy as np
+
+    from shadow_tpu.runtime.ensemble import flatten_host_stats
+
+    hs = {
+        "rounds_live": np.array([10, 20]),
+        "rounds_idle": np.array([1, 2]),
+        "win_ns_sum": np.array([100_000_000, 60_000_000]),
+        "lanes_live": np.ones((2, 3), np.int64),
+    }
+    out = flatten_host_stats(hs)
+    assert out["win_ns_sum"] == 160_000_000
+    assert out["win_rounds_live"] == 30  # -> weighted mean ~5.33e6, exact
+    assert out["rounds_live"] == 20  # the rounds block keeps its max
+    assert out["lanes_live"].shape == (6,)
+
+
+def test_window_occupancy_scales_by_iteration_planes():
+    """The occupancy denominator must shrink by the iteration-plane
+    count: iters_done sums PER-PLANE drain-loop counts (one per shard's
+    row 0, or per replica after the ensemble flatten) while each such
+    iteration scans only H/planes lanes — without the correction a
+    sharded fold under-reports occupancy by exactly the shard factor."""
+    cfg0, model, tables, st0 = _phold_world()
+    cfg = dataclasses.replace(cfg0, tracker=True)
+    names = [f"h{i}" for i in range(cfg.num_hosts)]
+    st = run_until(st0, 40 * NS_PER_MS, model, tables, cfg, rounds_per_chunk=4)
+    tr1 = Tracker(host_names=names)
+    tr1.finalize(host_stats(st))
+    occ1 = tr1.stats_dict()["window"]["occupancy"]
+    # a plane count that divides H, like the scheduler enforces for shards
+    planes = 2
+    assert cfg.num_hosts % planes == 0
+    tr2 = Tracker(host_names=names)
+    tr2.num_shards = planes
+    tr2.finalize(host_stats(st))
+    occ2 = tr2.stats_dict()["window"]["occupancy"]
+    assert occ1 > 0
+    assert occ2 == pytest.approx(occ1 * planes, rel=0.05)
+
+
 def test_heartbeat_lines_and_stats_fold_phold():
     """Driving with a Tracker attached renders per-host heartbeat lines
     in the format tools/parse_shadow.py parses, and the end-of-run fold

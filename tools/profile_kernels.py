@@ -39,6 +39,16 @@ every later same-shape batch pays, and one cached-chunk dispatch — plus
 amortized per-job wall vs sweep size (1/2/4/8 jobs through the
 production SweepService).
 
+Part 7 (adaptive-window round, docs/architecture.md "Lookahead &
+compaction"): on a sparse-in-time scenario (hosts whose true lookahead
+is 20x the graph's minimum latency), the drain-iteration reduction and
+window-widening the adaptive LBTS bound buys vs fixed-width rounds —
+per-run window-width distribution (log10 histogram of per-chunk mean
+live widths), live-lane occupancy per drain iteration (the quantity
+live-host compaction exploits), and the same run again under
+active-lane compaction. The iteration-reduction factor printed here is
+the published acceptance number for the adaptive-window round.
+
   python tools/profile_kernels.py [reps] [engine_hosts]
 
 Env knobs: SHADOW_TPU_PROFILE_WIDTHS (comma list, part 1),
@@ -577,6 +587,108 @@ def profile_sweep(hosts: int = 0, capacity: int = 4):
     return out
 
 
+def profile_adaptivity(hosts: int = 0):
+    """Part 7 (adaptive-window round): what the LBTS window + compaction
+    buy on a sparse-in-time world.
+
+    Topology: hosts sit on nodes with 20 ms links, while a pair of
+    host-less nodes carries the graph's 1 ms minimum-latency edge — so
+    the FIXED conservative width is 1 ms although every host's true
+    lookahead is 20 ms. phold with delays up to 50 ms makes event times
+    sparse. The three runs are leaf-identical
+    (tests/test_adaptive_window.py); only the round structure differs:
+
+      fixed            adaptive_window=False — 1 ms windows, most empty
+      adaptive         window_end = min(next_event + lookahead)
+      adaptive_compact adaptive + active-lane compaction (gathered
+                       [H/8]-row iterations)
+
+    Reported per run: drain iterations, live/idle round split, mean live
+    window width + its log10 per-chunk histogram, live-lane occupancy
+    per iteration, wall. `iter_reduction` (fixed/adaptive iterations) is
+    the published acceptance number."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from bench import WidthCapture
+    from shadow_tpu.engine import EngineConfig, init_state
+    from shadow_tpu.engine.round import (
+        ChunkProbe,
+        bootstrap,
+        run_until,
+        state_probe,
+    )
+    from shadow_tpu.graph import NetworkGraph, compute_routing
+    from shadow_tpu.models import PholdModel
+    from shadow_tpu.simtime import NS_PER_MS, NS_PER_SEC
+
+    h = hosts or (2560 if jax.default_backend() == "tpu" else 256)
+    graph = NetworkGraph.from_gml(
+        "\n".join(
+            [
+                "graph [",
+                "  directed 0",
+                *[f"  node [ id {i} ]" for i in range(4)],
+                '  edge [ source 0 target 0 latency "20 ms" ]',
+                '  edge [ source 1 target 1 latency "20 ms" ]',
+                '  edge [ source 0 target 1 latency "20 ms" ]',
+                '  edge [ source 2 target 3 latency "1 ms" ]',
+                '  edge [ source 2 target 2 latency "1 ms" ]',
+                '  edge [ source 3 target 3 latency "1 ms" ]',
+                "]",
+            ]
+        )
+    )
+    tables = compute_routing(graph).with_hosts([i % 2 for i in range(h)])
+    cfg0 = EngineConfig(
+        num_hosts=h,
+        queue_capacity=32,
+        runahead_ns=graph.min_latency_ns(),
+        seed=9,
+        tracker=True,
+    )
+    model = PholdModel(
+        num_hosts=h, min_delay_ns=1 * NS_PER_MS, max_delay_ns=50 * NS_PER_MS
+    )
+    st0 = bootstrap(init_state(cfg0, model.init()), model, cfg0)
+    end = int(0.4 * NS_PER_SEC)
+
+    def run_one(cfg):
+        widths = WidthCapture()
+
+        t0 = time.perf_counter()
+        st = run_until(
+            st0, end, model, tables, cfg, rounds_per_chunk=8,
+            on_chunk=widths.update,
+        )
+        wall = time.perf_counter() - t0
+        p = ChunkProbe.from_array(np.asarray(jax.jit(state_probe)(st)))
+        return p, {
+            "iters": p.iters,
+            "rounds": {"live": p.rounds_live, "idle": p.rounds_idle},
+            "window_ns_mean": round(p.window_ns_mean, 1),
+            "window_ns_hist": widths.hist(),
+            "occupancy": round(p.occupancy(h), 4),
+            "events": p.events_handled,
+            "wall_s": round(wall, 3),
+        }
+
+    out = {"hosts": h, "sim_s": end / NS_PER_SEC}
+    pf, out["fixed"] = run_one(
+        dataclasses.replace(cfg0, adaptive_window=False)
+    )
+    pa, out["adaptive"] = run_one(cfg0)
+    _, out["adaptive_compact"] = run_one(
+        dataclasses.replace(cfg0, active_lanes=max(h // 8, 8))
+    )
+    assert pa.events_handled == pf.events_handled  # leaf-identical runs
+    out["iter_reduction"] = round(pf.iters / max(pa.iters, 1), 2)
+    print(json.dumps({"adaptivity": out}), flush=True)
+    return out
+
+
 def main():
     import jax
 
@@ -593,6 +705,7 @@ def main():
     out["checkpoint"] = profile_checkpoint(eng_hosts)
     out["ensemble"] = profile_ensemble(min(reps, 3))
     out["sweep"] = profile_sweep()
+    out["adaptivity"] = profile_adaptivity()
     print(json.dumps(out), flush=True)
 
 
