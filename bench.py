@@ -350,6 +350,15 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
     # a salvage line and lands in watchdog_redispatches below
     watchdog_s = float(os.environ.get("SHADOW_TPU_BENCH_WATCHDOG_S", 0) or 0)
 
+    # flight recorder (runtime/flightrec.py): the main measurement's
+    # per-chunk time series rides the probes the driver fetches anyway —
+    # the trial publishes the tail so BENCH_r* trajectories show WHEN
+    # throughput moved inside a trial, not just the aggregate rate
+    from shadow_tpu.runtime import flightrec
+    from shadow_tpu.runtime.flightrec import FlightRecorder
+
+    recorder = FlightRecorder(num_hosts=num_hosts, ring=256)
+
     def attempt(eng_cfg):
         return run_until_recovering(
             st0,
@@ -368,12 +377,13 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
             ),
         )
 
-    (st, recoveries), fallbacks = run_with_engine_ladder(
-        cfg, attempt,
-        on_fallback=lambda rec: print(
-            json.dumps({"engine_fallback": rec}), flush=True
-        ),
-    )
+    with flightrec.installed(recorder):
+        (st, recoveries), fallbacks = run_with_engine_ladder(
+            cfg, attempt,
+            on_fallback=lambda rec: print(
+                json.dumps({"engine_fallback": rec}), flush=True
+            ),
+        )
     jax.block_until_ready(st.events_handled)
     wall = time.perf_counter() - t0
     probe = last_probe[0]
@@ -396,6 +406,9 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
         # per-phase dispatch percentiles (tracker plane) + the final
         # probe's always-live aggregate lanes (drop reasons etc.)
         "phases": tracker.phase_stats(),
+        # the per-chunk time series tail (flight recorder): sim-time
+        # advance / events / window width / occupancy per chunk
+        "series": recorder.series_tail(32),
         **(
             {
                 "tracker_totals": {
@@ -1172,6 +1185,31 @@ def main():
         cpu_xla = att.get("result") or att.get("partial") or att
 
     rate = main_res["rate"]
+
+    # ---- bench trajectory (tools/bench_history.py): parse the prior
+    # BENCH_r*.json record and publish this run's delta vs the best prior
+    # round in the bench log — a regression (or a null) must announce
+    # itself, not wait for a human to diff JSONs. Advisory: never fatal.
+    history = None
+    try:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "bench_history",
+            os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "tools", "bench_history.py",
+            ),
+        )
+        bh = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bh)
+        rounds = bh.load_rounds(os.path.dirname(os.path.abspath(__file__)))
+        history = bh.regression_check(rounds, current=round(rate, 4))
+        print(json.dumps({"bench_history": history}), flush=True)
+    except Exception as e:  # noqa: BLE001 — trajectory is advisory
+        print(json.dumps({"bench_history": {"error": str(e)[:200]}}),
+              flush=True)
+
     print(
         json.dumps(
             {
@@ -1188,6 +1226,7 @@ def main():
                     **({"ensemble": ensemble} if ensemble else {}),
                     **({"sweep": sweep} if sweep else {}),
                     **({"cpu_xla": cpu_xla} if cpu_xla else {}),
+                    **({"history": history} if history else {}),
                     "attempts": [
                         {k: v for k, v in a.items() if k != "result"} for a in attempts_log
                     ],

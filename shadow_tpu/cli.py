@@ -38,6 +38,34 @@ def main(argv: "list[str] | None" = None) -> int:
         "(chrome://tracing / Perfetto loadable; general.trace_file)",
     )
     run_p.add_argument(
+        "--metrics-file",
+        metavar="PATH",
+        help="stream per-chunk metrics samples as JSONL while the run "
+        "is live (tailable; flushed at heartbeat cadence; zero extra "
+        "device syncs — general.metrics_file; docs/observability.md). "
+        "Render later with `shadow-tpu metrics PATH`",
+    )
+    run_p.add_argument(
+        "--metrics-prom",
+        metavar="PATH",
+        help="rewrite a Prometheus textfile snapshot of the run's "
+        "gauges at heartbeat cadence (node-exporter textfile collector "
+        "format; general.metrics_prom)",
+    )
+    run_p.add_argument(
+        "--xprof-dir",
+        metavar="DIR",
+        help="capture a jax.profiler (xprof) trace of the chunk "
+        "dispatches in the --xprof-chunks window into DIR "
+        "(experimental.xprof_dir; best-effort)",
+    )
+    run_p.add_argument(
+        "--xprof-chunks",
+        metavar="A:B",
+        help="chunk index window [A, B) the --xprof-dir capture "
+        "brackets (default 1:3; experimental.xprof_chunks)",
+    )
+    run_p.add_argument(
         "--checkpoint-dir",
         metavar="DIR",
         help="write versioned run checkpoints into DIR at --checkpoint-"
@@ -147,6 +175,30 @@ def main(argv: "list[str] | None" = None) -> int:
         help="print the packing decision (jobs -> ensemble batches) as "
         "JSON and exit without running",
     )
+    sweep_p.add_argument(
+        "--metrics-file",
+        metavar="PATH",
+        help="stream the service's per-chunk samples and job/batch "
+        "events as JSONL (docs/service.md)",
+    )
+    sweep_p.add_argument(
+        "--metrics-prom",
+        metavar="PATH",
+        help="rewrite a Prometheus textfile snapshot of the service "
+        "gauges (queue depth, preemptions, cache hits) after every "
+        "scheduling decision — the sweep service's scrape endpoint "
+        "(docs/service.md)",
+    )
+    metrics_p = sub.add_parser(
+        "metrics",
+        help="summarize a recorded metrics series: a --metrics-file "
+        "JSONL stream or a flight-recorder.json black box — per-metric "
+        "percentiles, sparklines, and the event/failure log "
+        "(docs/observability.md)",
+    )
+    metrics_p.add_argument(
+        "file", help="path to a metrics JSONL stream or flight-recorder.json"
+    )
     sub.add_parser(
         "shm-cleanup",
         help="remove stale shared-memory blocks left by crashed runs "
@@ -174,6 +226,10 @@ def main(argv: "list[str] | None" = None) -> int:
                 chunk_watchdog=args.chunk_watchdog,
                 chaos_seed=args.chaos_seed,
                 chaos_faults=args.chaos_fault,
+                metrics_file=args.metrics_file,
+                metrics_prom=args.metrics_prom,
+                xprof_dir=args.xprof_dir,
+                xprof_chunks=args.xprof_chunks,
             )
         except CliUserError as e:
             print(f"shadow-tpu: error: {e}", file=sys.stderr)
@@ -186,10 +242,21 @@ def main(argv: "list[str] | None" = None) -> int:
                 args.spec,
                 output_dir=args.output_dir,
                 show_plan=args.show_plan,
+                metrics_file=args.metrics_file,
+                metrics_prom=args.metrics_prom,
             )
         except CliUserError as e:
             print(f"shadow-tpu: error: {e}", file=sys.stderr)
             return 1
+    if args.command == "metrics":
+        from shadow_tpu.runtime.flightrec import render_summary_file
+
+        try:
+            print(render_summary_file(args.file))
+        except (OSError, ValueError) as e:
+            print(f"shadow-tpu: error: {e}", file=sys.stderr)
+            return 1
+        return 0
     if args.command == "shm-cleanup":
         return shm_cleanup()
     parser.print_help()

@@ -39,6 +39,8 @@ _DISPLAY_GENERAL_KEYS = (
     "progress",
     "log_level",
     "trace_file",
+    "metrics_file",
+    "metrics_prom",
     "heartbeat_interval_ns",
     "checkpoint_dir",
     "checkpoint_interval_ns",
@@ -56,6 +58,10 @@ _RECOVERY_EXPERIMENTAL_KEYS = (
     "chunk_watchdog_s",
     "autotune",
     "autotune_budget_s",
+    # observability-only (runtime/flightrec.py): the recorder reads the
+    # probe the driver already fetched, never the trajectory
+    "xprof_dir",
+    "xprof_chunks",
 )
 
 

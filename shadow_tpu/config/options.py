@@ -83,6 +83,15 @@ class GeneralOptions:
     # span recording even without `tracker`). CLI: --tracker/--trace-file.
     tracker: bool = False
     trace_file: Optional[str] = None
+    # Flight recorder / metrics plane (docs/observability.md):
+    # `metrics_file` streams per-chunk JSONL samples live (tailable;
+    # flushed at heartbeat cadence), `metrics_prom` rewrites a
+    # Prometheus textfile snapshot for scraping. Both read the probe the
+    # driver already fetched — zero extra device syncs. The post-mortem
+    # black box (flight-recorder.json) is always on. CLI:
+    # --metrics-file / --metrics-prom.
+    metrics_file: Optional[str] = None
+    metrics_prom: Optional[str] = None
     # Fault tolerance (docs/robustness.md): `checkpoint_dir` turns on
     # versioned chunk-boundary checkpoints at `checkpoint_interval`
     # sim-time cadence (SIGINT/SIGTERM also write a final one); `resume`
@@ -125,6 +134,8 @@ class GeneralOptions:
             "progress",
             "tracker",
             "trace_file",
+            "metrics_file",
+            "metrics_prom",
             "checkpoint_dir",
             "resume",
             "replicas",
@@ -244,6 +255,13 @@ class ExperimentalOptions:
     # retained clean snapshot (counted like a recovery in sim-stats).
     # 0 = off. CLI: --chunk-watchdog.
     chunk_watchdog_s: float = 0.0
+    # jax.profiler capture window (docs/observability.md): write an
+    # xprof trace of the chunk dispatches in [start, end) of
+    # xprof_chunks into xprof_dir. Best-effort — a backend without
+    # profiler support records an event and continues. CLI:
+    # --xprof-dir / --xprof-chunks.
+    xprof_dir: Optional[str] = None
+    xprof_chunks: str = "1:3"
 
     @classmethod
     def from_dict(cls, d: dict) -> "ExperimentalOptions":
@@ -282,11 +300,24 @@ class ExperimentalOptions:
             "recovery_max_retries",
             "recovery_snapshot_chunks",
             "chunk_watchdog_s",
+            "xprof_dir",
+            "xprof_chunks",
         ):
             if k in d:
                 setattr(out, k, d.pop(k))
         if out.chunk_watchdog_s < 0:
             raise ValueError("experimental.chunk_watchdog_s must be >= 0")
+        parts = str(out.xprof_chunks).split(":")
+        if (
+            len(parts) != 2
+            or not all(p.lstrip("-").isdigit() for p in parts)
+            or int(parts[0]) < 0
+            or int(parts[1]) <= int(parts[0])
+        ):
+            raise ValueError(
+                f"experimental.xprof_chunks must be 'START:END' chunk "
+                f"indices with 0 <= START < END, got {out.xprof_chunks!r}"
+            )
         if out.strace_logging_mode is False:  # YAML 1.1 parses bare `off` as False
             out.strace_logging_mode = "off"
         if out.strace_logging_mode not in ("off", "standard", "deterministic"):

@@ -328,7 +328,7 @@ def _drive_ensemble(
     extra device syncs; runtime/sweep.py). `watchdog_s`/`engine` and
     the chaos capacity/stall/compile hooks mirror engine/round.py
     `_drive` — the degradation ladder covers both drivers."""
-    from shadow_tpu.runtime import chaos
+    from shadow_tpu.runtime import chaos, flightrec
 
     R = num_replicas(st)
     # Replicas quiescent at ENTRY (a resumed checkpoint whose batch was
@@ -337,6 +337,7 @@ def _drive_ensemble(
     # (_patch_snapshot), so the entry state — not any later chunk's
     # probe, which would re-accumulate idle rounds — carries the exact
     # leaves _finish must restore.
+    flightrec.begin_segment()  # mirrors engine/round.py _drive
     entry_rows = np.asarray(jax.device_get(_peek_probe_ensemble(st)))
     final_rows: "dict[int, np.ndarray]" = {
         r: entry_rows[r]
@@ -356,6 +357,11 @@ def _drive_ensemble(
         with _tspan(tracker, "probe_fetch", chunk=fetched):
             rows = np.asarray(_fetch_probe(pend_probe, watchdog_s, fetched))
         fetched += 1
+        # the flight-recorder seam mirrors engine/round.py `_drive`:
+        # aggregate and record BEFORE the capacity checks so a
+        # post-mortem's last sample is the failing chunk's probe
+        probe = _aggregate_probe(rows)
+        flightrec.observe_probe(probe, chunk=fetched - 1)
         injected = chaos.fire("capacity", at=fetched - 1)
         if injected is not None:
             raise chaos.injected_capacity_error(fetched - 1, injected)
@@ -363,7 +369,6 @@ def _drive_ensemble(
             raise _replica_capacity_error(rows)
         if on_rows is not None:
             on_rows(rows)
-        probe = _aggregate_probe(rows)
         if on_chunk is not None:
             on_chunk(probe)
         for r in range(R):

@@ -1337,8 +1337,11 @@ def _drive(launch, st, end_time, max_chunks, on_chunk, pipeline, desc,
     runtime/chaos.py hooks that cost one global read when no fault plan
     is installed.
     """
-    from shadow_tpu.runtime import chaos
+    from shadow_tpu.runtime import chaos, flightrec
 
+    # every _drive entry (first attempt, fallback rung, recovery replay)
+    # restarts the cumulative probe lanes: new delta segment
+    flightrec.begin_segment()
     pend_st, pend_probe = _launch_chunk0(launch, st, tracker, engine)
     launched = 1
     fetched = 0  # index of the chunk whose probe is fetched next
@@ -1354,6 +1357,11 @@ def _drive(launch, st, end_time, max_chunks, on_chunk, pipeline, desc,
                 _fetch_probe(pend_probe, watchdog_s, fetched)
             )
         fetched += 1
+        # flight recorder (runtime/flightrec.py): fold this probe into
+        # the installed recorder's ring BEFORE the capacity checks, so a
+        # post-mortem's last sample is the chunk that failed — reading
+        # the already-fetched probe costs zero extra device syncs
+        flightrec.observe_probe(probe, chunk=fetched - 1)
         injected = chaos.fire("capacity", at=fetched - 1)
         if injected is not None:
             raise chaos.injected_capacity_error(fetched - 1, injected)

@@ -156,16 +156,36 @@ def plan_rounds_per_chunk(
     probe_wall = cache.get(key, {}).get("probe_wall_s")
     source = "cache" if probe_wall is not None else "probe"
     if probe_wall is None:
+        import contextlib
+
         from shadow_tpu.engine.round import run_until
+        from shadow_tpu.runtime import flightrec
 
         probe_cfg = dataclasses.replace(cfg, engine="plain", pump_k=0)
         probe_st = st0() if callable(st0) else st0  # build outside the wall
-        t0 = time.perf_counter()
-        run_until(
-            probe_st, probe_end_ns, model, tables, probe_cfg,
-            rounds_per_chunk=probe_rpc, tracker=tracker,
+        # the probe's cost is real wall the run pays: record it as a
+        # first-class tracker span (`autotune_probe`) so traces and the
+        # phase percentiles show it, not just sim-stats' autotune block
+        span = (
+            tracker.span("autotune_probe", rpc=probe_rpc)
+            if tracker is not None
+            else contextlib.nullcontext()
         )
+        t0 = time.perf_counter()
+        with span, flightrec.suspended():
+            # suspended: the probe drives a THROWAWAY state through the
+            # real driver — its per-chunk probes must not pollute the
+            # run's metrics stream/ring (the decision event below is the
+            # probe's footprint there)
+            run_until(
+                probe_st, probe_end_ns, model, tables, probe_cfg,
+                rounds_per_chunk=probe_rpc, tracker=tracker,
+            )
         probe_wall = time.perf_counter() - t0
+        flightrec.record_event(
+            "autotune_probe", wall_s=round(probe_wall, 4), rpc=probe_rpc,
+            backend=backend,
+        )
         cache[key] = {
             "probe_wall_s": round(probe_wall, 4),
             "probe_rpc": probe_rpc,

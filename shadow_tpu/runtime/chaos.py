@@ -405,6 +405,15 @@ def run_with_engine_ladder(cfg, attempt, on_fallback=None):
                 f"({rec['reason']}); falling back to {rec['to']} "
                 "(bit-identical results, possibly slower)",
             )
+            # flight recorder (runtime/flightrec.py): a fallback is a
+            # survivable degradation — event in the metrics stream plus
+            # a black-box snapshot of the moment the ladder acted
+            from shadow_tpu.runtime import flightrec
+
+            flightrec.record_event("engine_fallback", **rec)
+            flightrec.post_mortem(
+                failure={"kind": "engine_fallback", "recovered": True, **rec}
+            )
             if on_fallback is not None:
                 on_fallback(rec)
             cfg = nxt

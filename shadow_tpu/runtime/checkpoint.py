@@ -35,6 +35,7 @@ import json
 import os
 import signal
 import threading
+import time
 
 import jax
 import numpy as np
@@ -232,7 +233,16 @@ class CheckpointManager:
         if self.engine_cfg is not None:
             meta["deliver_lanes"] = self.engine_cfg.deliver_lanes
             meta["a2a_capacity"] = self.engine_cfg.a2a_capacity
+        t0 = time.perf_counter()
         save_checkpoint(path, host_state, meta)
+        # flight recorder: checkpoint walls are part of the metrics
+        # stream (a run stalling on serialization must be visible there)
+        from shadow_tpu.runtime import flightrec
+
+        flightrec.record_event(
+            "checkpoint", wall_s=round(time.perf_counter() - t0, 4),
+            now_ns=now, final=final, path=path,
+        )
         # chaos seam (runtime/chaos.py): `at` counts this manager's
         # writes; the damage lands after the atomic commit, simulating
         # post-write corruption the integrity check must catch

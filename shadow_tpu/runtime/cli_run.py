@@ -44,6 +44,10 @@ def run_from_config(
     chunk_watchdog: "float | None" = None,
     chaos_seed: "int | None" = None,
     chaos_faults: "list[str] | None" = None,
+    metrics_file: "str | None" = None,
+    metrics_prom: "str | None" = None,
+    xprof_dir: "str | None" = None,
+    xprof_chunks: "str | None" = None,
 ) -> int:
     try:
         config = load_config_file(path)
@@ -89,6 +93,24 @@ def run_from_config(
         if chunk_watchdog < 0:
             raise CliUserError("--chunk-watchdog must be >= 0")
         config.experimental.chunk_watchdog_s = chunk_watchdog
+    if metrics_file:
+        config.general.metrics_file = metrics_file
+    if metrics_prom:
+        config.general.metrics_prom = metrics_prom
+    if xprof_dir:
+        config.experimental.xprof_dir = xprof_dir
+    if xprof_chunks:
+        parts = xprof_chunks.split(":")
+        if (
+            len(parts) != 2
+            or not all(p.isdigit() for p in parts)
+            or int(parts[1]) <= int(parts[0])
+        ):
+            raise CliUserError(
+                f"invalid --xprof-chunks {xprof_chunks!r}: expected "
+                "'START:END' with 0 <= START < END"
+            )
+        config.experimental.xprof_chunks = xprof_chunks
     if chaos_seed is not None:
         config.chaos.seed = chaos_seed
     for arg in chaos_faults or []:
@@ -133,6 +155,8 @@ def run_sweep(
     spec_path: str,
     output_dir: "str | None" = None,
     show_plan: bool = False,
+    metrics_file: "str | None" = None,
+    metrics_prom: "str | None" = None,
 ) -> int:
     """`shadow-tpu sweep` implementation: expand + pack + (optionally)
     execute a sweep spec (docs/service.md). Exit 0 when every job
@@ -148,7 +172,9 @@ def run_sweep(
     except (ValueError, OSError, yaml.YAMLError) as e:
         raise CliUserError(f"invalid sweep spec: {e}") from e
     try:
-        service = SweepService(spec)
+        service = SweepService(
+            spec, metrics_file=metrics_file, metrics_prom=metrics_prom
+        )
     except ValueError as e:
         raise CliUserError(str(e)) from e
     if show_plan:

@@ -71,10 +71,13 @@ class CompileCache:
         cfg), compiling at most once per distinct full key. `build()`
         must return the callable executable (e.g.
         lower_ensemble_chunk(...).compile())."""
+        from shadow_tpu.runtime import flightrec
+
         fk = self._full_key(key, st, static_cfg)
         exe = self._entries.get(fk)
         if exe is not None:
             self.hits += 1
+            flightrec.record_event("compile_cache", hit=True)
             return exe
         t0 = time.perf_counter()
         exe = build()
@@ -83,6 +86,9 @@ class CompileCache:
         self.compile_seconds += wall
         self.compile_walls.append(round(wall, 4))
         self._entries[fk] = exe
+        # compile telemetry: a miss's XLA wall is a first-class event in
+        # the metrics stream (runtime/flightrec.py)
+        flightrec.record_event("compile_cache", hit=False, wall_s=round(wall, 4))
         return exe
 
     @property

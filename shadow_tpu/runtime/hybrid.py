@@ -612,6 +612,14 @@ class ParallelHybridScheduler:
              f"worker {w} {reason}; respawning and replaying "
              f"{len(self._cmd_log[w])} commands to the last round boundary "
              f"(respawn {self._respawns[w]}/{self.max_worker_respawns})")
+        # flight recorder: supervision events ride the metrics stream so
+        # a post-mortem shows the respawn history before a final crash
+        from shadow_tpu.runtime import flightrec
+
+        flightrec.record_event(
+            "worker_respawn", worker=w, reason=reason[:200],
+            respawn=self._respawns[w], replayed=len(self._cmd_log[w]),
+        )
         proc, conn = self._workers[w]
         try:
             conn.close()

@@ -296,13 +296,20 @@ class Manager:
         "Chaos testing"). The plan is process-global for the duration of
         the run — every seam (drivers, checkpoint writer, hybrid
         supervision) consults it through runtime/chaos.py fire()."""
-        from shadow_tpu.runtime import chaos
+        from shadow_tpu.runtime import chaos, flightrec
 
-        plan = chaos.plan_from_config(self.config.chaos)
-        if plan is None:
-            return self._run()
-        with chaos.installed(plan):
-            return self._run()
+        try:
+            plan = chaos.plan_from_config(self.config.chaos)
+            if plan is None:
+                return self._run()
+            with chaos.installed(plan):
+                return self._run()
+        finally:
+            # belt-and-braces: the drivers' finally uninstalls the flight
+            # recorder, but an exception between its install and the run
+            # (a world-construction error) must never leak a recorder
+            # into the next run of this process
+            flightrec.uninstall()
 
     def _fold_chaos(self, results: SimResults) -> None:
         """Publish what the installed fault plan actually injected: a
@@ -325,6 +332,21 @@ class Manager:
         host_node, runahead = world.host_node, world.runahead_ns
         tx_refill, rx_refill = world.tx_refill, world.rx_refill
         ecfg, ckpt, guard, resume_path = self._setup_checkpointing(world.ecfg)
+
+        from shadow_tpu.runtime import flightrec
+        from shadow_tpu.utils.progress import ProgressLine
+
+        # progress/tracker are built BEFORE the autotuner so its compile
+        # probe records an `autotune_probe` span like any other phase
+        progress = ProgressLine(cfgo.general.progress)
+        tracker = self._build_tracker(progress)
+        # the flight recorder (runtime/flightrec.py) is always on for
+        # scripted runs: the bounded ring costs nothing per chunk (it
+        # reads the already-fetched probe through the _drive seam), and
+        # the black-box dump must exist on EVERY failure path, not only
+        # when --metrics-file was passed
+        recorder = self._build_recorder(tracker)
+        flightrec.install(recorder)
 
         rounds_per_chunk = cfgo.experimental.rounds_per_chunk
         autotune_plan = None
@@ -377,6 +399,7 @@ class Manager:
                     requested=rounds_per_chunk,
                     budget_s=cfgo.experimental.autotune_budget_s,
                     cache_path=cache_path,
+                    tracker=tracker,
                 )
             except Exception as e:  # noqa: BLE001 — the autotuner is an
                 # optimization, never a failure: a probe crash (including
@@ -393,6 +416,12 @@ class Manager:
                 autotune_plan = None
             if autotune_plan is not None:
                 rounds_per_chunk = autotune_plan.rounds_per_chunk
+                if tracker is not None:
+                    # the probe's measured wall + the chosen chunking in
+                    # the tracker fold, not just sim-stats (the trace and
+                    # stats must tell one story)
+                    tracker.autotune = autotune_plan.as_dict()
+                flightrec.record_event("autotune", **autotune_plan.as_dict())
                 if rounds_per_chunk != autotune_plan.requested:
                     slog(
                         "info", 0, "autotune",
@@ -440,10 +469,16 @@ class Manager:
         hb_ns = cfgo.general.heartbeat_interval_ns
         last_hb = [0]
 
-        from shadow_tpu.utils.progress import ProgressLine
-
-        progress = ProgressLine(cfgo.general.progress)
-        tracker = self._build_tracker(progress)
+        # occupancy denominator, set BEFORE the run so heartbeat lines
+        # and mid-run metrics divide correctly: iters_done sums per-shard
+        # (or, after the ensemble flatten, per-replica) drain-loop
+        # counts, each covering only H/planes lanes (utils/tracker.py)
+        num_shards = replicas if replicas > 1 else (
+            getattr(sched, "num_devices", 1) or 1
+        )
+        if tracker is not None:
+            tracker.num_shards = num_shards
+        recorder.num_shards = max(1, num_shards)
 
         def on_chunk(probe):
             # probe is an engine ChunkProbe of already-fetched ints (the
@@ -482,46 +517,60 @@ class Manager:
              f"scheduler={sched.name}, {eng_note}"
              f"runahead={runahead}ns, stop={fmt_time_ns(end)}")
         t0 = time.perf_counter()
-        if isinstance(sched, CpuRefScheduler):
-            final = sched.run(end, on_chunk=on_chunk, tracker=tracker)
-        else:
-            resume_state = None
-            if resume_path is not None:
-                from shadow_tpu.runtime.checkpoint import load_checkpoint
+        try:
+            if isinstance(sched, CpuRefScheduler):
+                final = sched.run(end, on_chunk=on_chunk, tracker=tracker)
+            else:
+                resume_state = None
+                if resume_path is not None:
+                    from shadow_tpu.runtime.checkpoint import load_checkpoint
 
-                # resume_path came from latest_path, which verified the
-                # sha-256 digest moments ago — skip the second full hash
-                resume_state, meta = load_checkpoint(
-                    resume_path, sched.initial_state(), ckpt.fingerprint,
-                    check_digest=False,
-                )
-                slog("info", meta["now_ns"], "manager",
-                     f"resuming from checkpoint {resume_path} "
-                     f"(sim time {fmt_time_ns(meta['now_ns'])})")
-            recovery = None
-            if cfgo.experimental.recover:
-                from shadow_tpu.runtime.recovery import RecoveryPolicy
-
-                recovery = RecoveryPolicy(
-                    max_recoveries=cfgo.experimental.recovery_max_retries,
-                    snapshot_interval_chunks=(
-                        cfgo.experimental.recovery_snapshot_chunks
-                    ),
-                )
-            try:
-                with guard if guard is not None else contextlib.nullcontext():
-                    final = sched.run(
-                        end, on_chunk=on_chunk, tracker=tracker,
-                        start_state=resume_state, checkpoints=ckpt,
-                        guard=guard, recovery=recovery,
+                    # resume_path came from latest_path, which verified
+                    # the sha-256 digest moments ago — skip the second
+                    # full hash
+                    resume_state, meta = load_checkpoint(
+                        resume_path, sched.initial_state(), ckpt.fingerprint,
+                        check_digest=False,
                     )
-            except RunInterrupted:
-                progress.clear()
-                slog("info", 0, "manager",
-                     f"interrupted; checkpoints are in "
-                     f"{cfgo.general.checkpoint_dir} — rerun with --resume "
-                     "to continue to a bit-identical final state")
-                raise
+                    slog("info", meta["now_ns"], "manager",
+                         f"resuming from checkpoint {resume_path} "
+                         f"(sim time {fmt_time_ns(meta['now_ns'])})")
+                recovery = None
+                if cfgo.experimental.recover:
+                    from shadow_tpu.runtime.recovery import RecoveryPolicy
+
+                    recovery = RecoveryPolicy(
+                        max_recoveries=cfgo.experimental.recovery_max_retries,
+                        snapshot_interval_chunks=(
+                            cfgo.experimental.recovery_snapshot_chunks
+                        ),
+                    )
+                try:
+                    with guard if guard is not None else contextlib.nullcontext():
+                        final = sched.run(
+                            end, on_chunk=on_chunk, tracker=tracker,
+                            start_state=resume_state, checkpoints=ckpt,
+                            guard=guard, recovery=recovery,
+                        )
+                except RunInterrupted:
+                    progress.clear()
+                    slog("info", 0, "manager",
+                         f"interrupted; checkpoints are in "
+                         f"{cfgo.general.checkpoint_dir} — rerun with "
+                         "--resume to continue to a bit-identical final "
+                         "state")
+                    raise
+        except RunInterrupted:
+            raise  # not a failure: a final checkpoint was committed
+        except Exception as err:
+            # post-mortem black box on EVERY failure path, plain
+            # exceptions included — the ring already holds the failing
+            # chunk's sample (_drive records the probe before raising)
+            recorder.dump(failure=flightrec.failure_record(err))
+            raise
+        finally:
+            recorder.close()
+            flightrec.uninstall()
         wall = time.perf_counter() - t0
         progress.finish(end)
 
@@ -586,14 +635,17 @@ class Manager:
                 seed_stride=cfgo.general.replica_seed_stride,
                 host_tensors=host_tensors,
             )
-        if tracker is not None:
-            # occupancy denominator: iters_done sums per-shard (or, after
-            # the ensemble flatten, per-replica) drain-loop counts, each
-            # covering only H/planes lanes (utils/tracker.py num_shards)
-            tracker.num_shards = (
-                replicas if replicas > 1
-                else getattr(sched, "num_devices", 1)
-            )
+        if recorder.metrics_path or recorder.prom_path:
+            # a metrics-streamed run names its outputs in sim-stats so
+            # the artifacts are discoverable from the run record
+            results.extra_stats["metrics"] = {
+                "samples": len(recorder.samples),
+                "events": len(recorder.events),
+                **({"file": recorder.metrics_path}
+                   if recorder.metrics_path else {}),
+                **({"prom": recorder.prom_path}
+                   if recorder.prom_path else {}),
+            }
         self._fold_tracker(
             tracker, results, end,
             final_state=None if isinstance(sched, CpuRefScheduler) else final,
@@ -716,6 +768,38 @@ class Manager:
             counters=g.tracker,
         )
 
+    def _build_recorder(self, tracker=None, num_shards: int = 1):
+        """The flight recorder (runtime/flightrec.py): always built — the
+        bounded ring is free and the black-box dump must exist on every
+        failure path — with the streaming/scrape/profiler outputs wired
+        only when the config asks for them (--metrics-file /
+        --metrics-prom / --xprof-dir)."""
+        from shadow_tpu.runtime.flightrec import FlightRecorder
+
+        g = self.config.general
+        e = self.config.experimental
+        blackbox = (
+            os.path.join(g.data_directory, "flight-recorder.json")
+            if g.data_directory
+            else None
+        )
+        xprof_chunks = None
+        if e.xprof_chunks:
+            a, _, b = e.xprof_chunks.partition(":")
+            xprof_chunks = (int(a), int(b))
+        return FlightRecorder(
+            num_hosts=len(self.hosts),
+            num_shards=num_shards,
+            metrics_path=g.metrics_file,
+            prom_path=g.metrics_prom,
+            blackbox_path=blackbox,
+            heartbeat_ns=g.heartbeat_interval_ns,
+            config_dict=self.config.to_dict(),
+            tracker=tracker,
+            xprof_dir=e.xprof_dir,
+            xprof_chunks=xprof_chunks,
+        )
+
     def _run_managed(self) -> SimResults:
         """Run real executables as managed processes under the LD_PRELOAD
         shim (spawn/resume managed_thread.rs:156-267). scheduler=tpu (the
@@ -831,11 +915,22 @@ class Manager:
         slog("info", 0, "manager",
              f"starting: {len(self.hosts)} hosts, scheduler={sched_label}, "
              f"{len(k.procs)} managed processes, stop={fmt_time_ns(end)}")
+        from shadow_tpu.runtime import flightrec
+
+        recorder = self._build_recorder(tracker)
+        flightrec.install(recorder)
         t0 = time.perf_counter()
         try:
             run_fn(end)
+        except Exception as err:
+            # worker crashes and plain exceptions get the same black box
+            # as the scripted drivers (events: worker respawns, spans)
+            recorder.dump(failure=flightrec.failure_record(err))
+            raise
         finally:
             k.shutdown()
+            recorder.close()
+            flightrec.uninstall()
         wall = time.perf_counter() - t0
 
         stats = k.stats()
@@ -922,10 +1017,19 @@ class Manager:
              f"starting: {len(self.hosts)} hosts, scheduler={sched.name} "
              f"({sched.num_workers} workers), {len(specs)} managed processes, "
              f"stop={fmt_time_ns(end)}")
+        from shadow_tpu.runtime import flightrec
+
+        recorder = self._build_recorder(tracker)
+        flightrec.install(recorder)
         t0 = time.perf_counter()
         try:
             try:
                 sched.run(end)
+            except Exception as err:
+                # the worker-crash post-mortem: respawn events already
+                # ride the recorder (runtime/hybrid.py _revive)
+                recorder.dump(failure=flightrec.failure_record(err))
+                raise
             finally:
                 sched.shutdown()
             wall = time.perf_counter() - t0
@@ -933,6 +1037,8 @@ class Manager:
             unexpected = sched.unexpected_final_states()
         finally:
             sched.close()
+            recorder.close()
+            flightrec.uninstall()
         for u in unexpected:
             slog("warning", end, "manager", f"unexpected final state: {u}")
         results = SimResults(

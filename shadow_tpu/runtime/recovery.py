@@ -171,11 +171,17 @@ def run_until_recovering(
             final = runner_factory(cur_cfg)(cur_st, on_state=tap)
             return final, recoveries
         except (CapacityError, WatchdogExpired) as err:
+            from shadow_tpu.runtime import flightrec
+
             if len(recoveries) >= policy.max_recoveries:
                 # terminal: surface what the run survived before it died,
                 # so a degraded-then-failed run stays visibly degraded
-                # (sweep manifests read this off the exception)
+                # (sweep manifests read this off the exception), and
+                # write the black-box post-mortem — the recorder's last
+                # sample is the failing chunk's probe (_drive records it
+                # before raising)
                 err.recoveries = list(recoveries)
+                flightrec.post_mortem(err, recoveries=len(recoveries))
                 raise
             is_watchdog = isinstance(err, WatchdogExpired)
             if retainer is not None and retainer.host_state is not None:
@@ -240,6 +246,14 @@ def run_until_recovering(
                 )
             if tracker is not None and hasattr(tracker, "record_recovery"):
                 tracker.record_recovery(record)
+            # flight recorder: the recovery is an event in the metrics
+            # stream AND a survivable-failure black box (overwritten by a
+            # later, more terminal dump if the run eventually dies)
+            flightrec.record_event("recovery", **record)
+            flightrec.post_mortem(
+                failure={"kind": f"recovery:{record['kind']}",
+                         "recovered": True, **record},
+            )
             if on_recovery is not None:
                 on_recovery(record)
             cur_st, cur_cfg = grown, new_cfg

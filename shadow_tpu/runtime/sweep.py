@@ -196,7 +196,8 @@ class SweepService:
     """Executes a SweepSpec: packs, queues, runs, preempts, reports.
     One instance per sweep; the compile cache lives for its lifetime."""
 
-    def __init__(self, spec: SweepSpec):
+    def __init__(self, spec: SweepSpec, metrics_file: "str | None" = None,
+                 metrics_prom: "str | None" = None):
         self.spec = spec
         self.cache = CompileCache()
         self.batches = pack_jobs(spec.jobs, spec.capacity)
@@ -205,6 +206,20 @@ class SweepService:
             j.name: {"now_ns": 0, "events": 0} for j in spec.jobs
         }
         self.job_records: "dict[str, dict]" = {}
+        # Service-level telemetry (runtime/flightrec.py; docs/service.md):
+        # the recorder streams the drivers' per-chunk samples plus
+        # batch/queue events, `job_series` keeps a bounded per-job time
+        # series keyed off the per-replica probe rows (zero extra device
+        # syncs — the rows already arrive via on_rows), and
+        # `queue_depth_series` gauges the queue at every scheduling
+        # decision. `metrics_prom` makes the service scrapeable.
+        self.metrics_file = metrics_file
+        self.metrics_prom = metrics_prom
+        self.recorder = None  # built in run()
+        self.job_series: "dict[str, list[dict]]" = {
+            j.name: [] for j in spec.jobs
+        }
+        self.queue_depth_series: "list[dict]" = []
         # per-job failed attempts (the retry/quarantine ladder's budget
         # counter; docs/service.md "Retries and quarantine")
         self.job_attempts: "dict[str, int]" = {}
@@ -273,8 +288,21 @@ class SweepService:
         )
         t0 = time.perf_counter()
         os.makedirs(self.spec.output_dir, exist_ok=True)
-        with ctx:
-            self._drain(list(self.batches))
+        from shadow_tpu.runtime.flightrec import FlightRecorder
+
+        self.recorder = FlightRecorder(
+            blackbox_path=os.path.join(
+                self.spec.output_dir, "flight-recorder.json"
+            ),
+            metrics_path=self.metrics_file,
+            prom_path=self.metrics_prom,
+        )
+        try:
+            with ctx:
+                self._drain(list(self.batches))
+        finally:
+            self._write_prom([])
+            self.recorder.close()
         manifest = self._manifest(time.perf_counter() - t0)
         if plan is not None:
             manifest["chaos"] = plan.report()
@@ -293,6 +321,20 @@ class SweepService:
                 continue
             batch = min(ready, key=lambda b: (-b.priority, b.arrival_ns, b.index))
             pending.remove(batch)
+            # queue-depth gauge at every scheduling decision (the running
+            # batch counts toward the depth); getattr because the
+            # retry-ladder unit tests drive a bare service shell
+            depth = len(pending) + 1
+            qseries = getattr(self, "queue_depth_series", None)
+            if qseries is not None:
+                qseries.append({"clock_ns": self.clock_ns, "depth": depth})
+            rec = getattr(self, "recorder", None)
+            if rec is not None:
+                rec.event(
+                    "batch_start", batch=batch.index, queue_depth=depth,
+                    jobs=[j.name for j in batch.jobs],
+                    priority=batch.priority,
+                )
             try:
                 self._run_batch(batch, pending)
             except _Preempted:
@@ -303,6 +345,11 @@ class SweepService:
                     f"batch {batch.index} preempted "
                     f"(checkpoint: {batch.resume_ckpt or 'none — restarts'})",
                 )
+                if rec is not None:
+                    rec.event(
+                        "preempt", batch=batch.index,
+                        checkpoint=batch.resume_ckpt,
+                    )
                 pending.append(batch)
             except Exception as e:
                 # EVERY batch error — typed ladder failures (capacity /
@@ -314,6 +361,7 @@ class SweepService:
                 # KeyboardInterrupt/SystemExit are BaseException and
                 # still abort the sweep.
                 self._handle_failure(batch, e, pending)
+            self._write_prom(pending)
 
     def _requeue_job(self, job: SweepJob, like: Batch) -> Batch:
         """A fresh single-job batch for a retry/split: same scheduling
@@ -343,9 +391,17 @@ class SweepService:
         `quarantined` for a repeat offender (it failed again after a
         retry), plain `failed` when retry_max is 0 and the first failure
         was terminal."""
+        from shadow_tpu.runtime import flightrec
+
         kind = _failure_kind(err)
         batch.error = str(err)
         batch.failure = kind
+        rec = getattr(self, "recorder", None)
+        if rec is not None:
+            rec.event(
+                "batch_failure", batch=batch.index, failure=kind,
+                jobs=[j.name for j in batch.jobs], error=str(err)[:200],
+            )
         if batch.replicas > 1:
             batch.status = "split"
             slog(
@@ -376,6 +432,23 @@ class SweepService:
         self.job_records[job.name] = self._job_record(
             job, batch, status=status, error=str(err), failure=kind,
         )
+        if rec is not None:
+            # the quarantined/failed job's post-mortem black box: one
+            # dump in ITS data directory (the forensics travel with the
+            # job's outputs) and one service-level dump — both carry the
+            # failing chunk's sample, recorded by the driver before the
+            # raise (docs/observability.md)
+            failure = flightrec.failure_record(
+                err, job=job.name, status=status, attempts=attempts,
+                batch=batch.index,
+            )
+            job_dir = job.config.general.data_directory
+            if job_dir:
+                rec.dump(
+                    failure=failure,
+                    path=os.path.join(job_dir, "flight-recorder.json"),
+                )
+            rec.dump(failure=failure)
         slog(
             "warning", self.clock_ns, "sweep",
             f"job {job.name} {status} after {attempts} failed "
@@ -437,10 +510,16 @@ class SweepService:
             # raw [R, PROBE_LANES] probe: one row per job, already
             # fetched by the driver — per-job progress costs zero syncs
             for name, r in rows_map.items():
-                self.job_progress[name] = {
+                point = {
                     "now_ns": int(rows[r, PROBE_NOW]),
                     "events": int(rows[r, PROBE_EVENTS]),
                 }
+                self.job_progress[name] = point
+                # bounded per-job time series for the manifest telemetry
+                # (keyed off the same already-fetched probe rows)
+                series = self.job_series.setdefault(name, [])
+                series.append({"clock_ns": self.clock_ns, **point})
+                del series[:-64]
 
         runner = EnsembleRunner(
             world.model,
@@ -525,12 +604,18 @@ class SweepService:
         )
         from shadow_tpu.runtime import chaos
 
+        from shadow_tpu.runtime import flightrec
+
         t0 = time.perf_counter()
         try:
             # ambient tags = this batch's job names, so a chaos fault
             # with `target: <job>` fires only in batches carrying it —
-            # the poison-job selector (docs/robustness.md)
-            with chaos.scoped_tags(*[j.name for j in batch.jobs]):
+            # the poison-job selector (docs/robustness.md). The service
+            # recorder is installed for the batch's duration so the
+            # driver's per-chunk samples and the compile cache's
+            # hit/miss events stream into the service telemetry.
+            with chaos.scoped_tags(*[j.name for j in batch.jobs]), \
+                    flightrec.installed(self.recorder):
                 final = runner.run(
                     end,
                     on_chunk=on_chunk,
@@ -658,6 +743,49 @@ class SweepService:
 
     # --- reporting -------------------------------------------------------
 
+    def _write_prom(self, pending: "list[Batch]") -> None:
+        """Rewrite the service's Prometheus textfile snapshot (the scrape
+        endpoint of a long-lived sweep — docs/service.md): job/queue
+        gauges on top of the recorder's run-level ones."""
+        rec = getattr(self, "recorder", None)
+        if rec is None or not rec.prom_path:
+            return
+        statuses = [r.get("status") for r in self.job_records.values()]
+        rec.write_prom(
+            extra_gauges={
+                "shadow_tpu_sweep_queue_depth": len(pending),
+                "shadow_tpu_sweep_clock_ns": self.clock_ns,
+                "shadow_tpu_sweep_jobs_total": len(self.spec.jobs),
+                "shadow_tpu_sweep_jobs_done": statuses.count("done"),
+                "shadow_tpu_sweep_jobs_failed": statuses.count("failed"),
+                "shadow_tpu_sweep_jobs_quarantined": statuses.count(
+                    "quarantined"
+                ),
+                "shadow_tpu_sweep_preemptions_total": sum(
+                    b.preemptions for b in self.batches
+                ),
+            }
+        )
+
+    def _telemetry(self) -> dict:
+        """The service-level telemetry block of sweep-manifest.json:
+        queue-depth gauges per scheduling decision plus the tail of each
+        job's probe-row series (full series stream via --metrics-file)."""
+        return {
+            "queue_depth": self.queue_depth_series[-100:],
+            "max_queue_depth": max(
+                (p["depth"] for p in self.queue_depth_series), default=0
+            ),
+            "per_job": {
+                name: {
+                    "samples": len(series),
+                    "series_tail": series[-8:],
+                }
+                for name, series in self.job_series.items()
+                if series
+            },
+        }
+
     def _manifest(self, wall: float) -> dict:
         from shadow_tpu.runtime.ensemble import _agg
 
@@ -698,6 +826,7 @@ class SweepService:
             ),
             "preemptions": sum(b.preemptions for b in self.batches),
             "compile_cache": self.cache.stats(),
+            "telemetry": self._telemetry(),
             "batches": [
                 {**b.describe(), "status": b.status,
                  "wall_seconds": round(b.wall_seconds, 4),

@@ -106,6 +106,11 @@ class Tracker:
         # rollback-and-regrow recovery records (runtime/recovery.py):
         # folded into stats_dict and marked in the trace as instants
         self.recoveries: "list[dict]" = []
+        # the autotune decision (runtime/autotune.py AutotunePlan
+        # as_dict, set by the manager): the probe's measured wall and the
+        # chosen rounds_per_chunk surface in stats_dict alongside the
+        # `autotune_probe` span — not only in sim-stats' own block
+        self.autotune: "dict | None" = None
 
     # --- spans -----------------------------------------------------------
 
@@ -209,6 +214,13 @@ class Tracker:
             self.clear_line()
         names = self.host_names
         n = len(stats["events_handled"])
+        # run-wide adaptivity figures from the probe (the PR-9 lanes):
+        # appended to every line so parse_shadow-compatible consumers see
+        # the window-width/occupancy data next to the per-host counters —
+        # the leading fields keep the exact parsed format (the parser
+        # ignores trailing keys it does not know)
+        win_mean = probe.window_ns_mean
+        occ = probe.occupancy(n, self.num_shards)
         for i in range(n):
             ev = int(stats["events_handled"][i])
             evl = int(stats["ev_local"][i])
@@ -230,7 +242,9 @@ class Tracker:
                 f"bytes_data={int(stats['bytes_data'][i])} "
                 f"retrans={int(stats['retrans_segs'][i])} "
                 f"queue_hwm={int(stats['queue_hwm'][i])} "
-                f"outbox_hwm={int(stats['outbox_hwm'][i])}",
+                f"outbox_hwm={int(stats['outbox_hwm'][i])} "
+                f"lanes_live={int(stats['lanes_live'][i])} "
+                f"win_mean_ns={win_mean:.0f} occupancy={occ:.4f}",
             )
 
     def record_probe(self, probe) -> None:
@@ -289,6 +303,8 @@ class Tracker:
         out: dict = {"phases": self.phase_stats()}
         if self.recoveries:
             out["recoveries"] = list(self.recoveries)
+        if self.autotune:
+            out["autotune"] = dict(self.autotune)
         if not self.counters:
             return out
         hs = self._final_hosts

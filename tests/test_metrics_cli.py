@@ -1,0 +1,118 @@
+"""Tier-1 CLI smoke for the metrics plane (docs/observability.md):
+`shadow-tpu run --metrics-file` streams a parseable JSONL series with
+zero extra syncs, `shadow-tpu metrics` renders it with percentile rows,
+`--metrics-prom` writes a scrapeable textfile snapshot, and a chaos
+failure through the full CLI path leaves the post-mortem black box in
+the data directory."""
+
+import json
+import pathlib
+
+import pytest
+
+from shadow_tpu.cli import main as cli_main
+from shadow_tpu.runtime.cli_run import CliUserError, run_from_config
+
+pytestmark = pytest.mark.metrics
+
+CONFIG = """
+general:
+  stop_time: 60 ms
+  seed: 1
+  data_directory: {data_dir}
+  heartbeat_interval: null
+  tracker: true
+network:
+  graph:
+    type: 1_gbit_switch
+experimental:
+  rounds_per_chunk: 4
+hosts:
+  peer:
+    network_node_id: 0
+    # 12 hosts matches test_checkpoint_cli's world exactly (same static
+    # EngineConfig + model), so this smoke reuses its compiled chunk
+    # program from the process-wide jit cache instead of paying a
+    # second XLA compile in the tier-1 suite
+    quantity: 12
+    processes:
+      - path: phold
+        args:
+          min_delay: "2 ms"
+          max_delay: "12 ms"
+"""
+
+
+def _write(tmp_path, name) -> pathlib.Path:
+    d = tmp_path / name
+    d.mkdir()
+    cfg = d / "shadow.yaml"
+    cfg.write_text(CONFIG.format(data_dir=d / "data"))
+    return cfg
+
+
+def test_cli_metrics_stream_then_metrics_summary(tmp_path, capsys):
+    cfg = _write(tmp_path, "run")
+    mf = tmp_path / "run" / "metrics.jsonl"
+    pp = tmp_path / "run" / "metrics.prom"
+    assert run_from_config(
+        str(cfg), metrics_file=str(mf), metrics_prom=str(pp)
+    ) == 0
+
+    # the JSONL stream parses line-by-line and carries real samples
+    lines = [json.loads(ln) for ln in mf.read_text().splitlines()]
+    samples = [l for l in lines if l["type"] == "sample"]
+    assert samples, lines
+    assert samples[-1]["events_total"] > 0
+    assert all("now_ns" in s and "dt_ns" in s for s in samples)
+
+    # the prom snapshot is scrapeable textfile-collector output
+    prom = pp.read_text()
+    assert "shadow_tpu_events_total" in prom
+    assert "shadow_tpu_sim_time_ns" in prom
+
+    # sim-stats names the metrics artifacts
+    stats = json.loads(
+        (tmp_path / "run" / "data" / "sim-stats.json").read_text()
+    )
+    assert stats["metrics"]["samples"] == len(samples)
+    assert stats["metrics"]["file"] == str(mf)
+    # satellite: the tracker fold did NOT gain an autotune block (the
+    # autotuner was off), but the stats fold still parses
+    assert "tracker" in stats
+
+    # `shadow-tpu metrics` renders the summary with percentile rows
+    capsys.readouterr()
+    assert cli_main(["metrics", str(mf)]) == 0
+    out = capsys.readouterr().out
+    for token in ("samples", "p50", "p90", "p99", "dt_ns", "events"):
+        assert token in out, out
+
+    # a clean success leaves no black box behind
+    assert not (tmp_path / "run" / "data" / "flight-recorder.json").exists()
+
+
+def test_cli_chaos_capacity_leaves_blackbox(tmp_path):
+    """The full CLI path: an injected capacity fault with recovery off
+    exits as a one-line user error AND leaves flight-recorder.json in
+    the data directory with the failing chunk's sample."""
+    cfg = _write(tmp_path, "boom")
+    with pytest.raises(CliUserError, match="capacity"):
+        run_from_config(str(cfg), no_recover=True,
+                        chaos_faults=["capacity@1"])
+    box = tmp_path / "boom" / "data" / "flight-recorder.json"
+    doc = json.loads(box.read_text())
+    assert doc["failure"]["kind"] == "capacity"
+    assert doc["failure"]["injected"] is True
+    assert doc["samples"][-1]["chunk"] == 1
+    # the black box carries the resolved config and the dispatch spans
+    assert doc["config"]["general"]["seed"] == 1
+    assert any(s["name"] == "probe_fetch" for s in doc["tracker_spans"])
+
+
+def test_cli_metrics_subcommand_rejects_garbage(tmp_path, capsys):
+    bad = tmp_path / "not-metrics.json"
+    bad.write_text('{"no": "samples"}')
+    assert cli_main(["metrics", str(bad)]) == 1
+    assert "error" in capsys.readouterr().err
+    assert cli_main(["metrics", str(tmp_path / "missing.jsonl")]) == 1

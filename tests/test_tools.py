@@ -44,6 +44,67 @@ def test_parse_and_plot(tmp_path):
     assert "server" in svg.read_text()
 
 
+def test_bench_history_trajectory_and_regression(tmp_path):
+    """tools/bench_history.py parses BENCH_r*.json into a trajectory
+    table and flags a regression vs the best prior round — including the
+    null-round case (the r05 failure mode the tool exists to announce)."""
+
+    def _round(n, value, attempts):
+        (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps({
+            "n": n,
+            "parsed": {
+                "metric": "m",
+                "value": value,
+                "detail": {
+                    "config": {"hosts": 128, "rounds_per_chunk": 16},
+                    "main": {"wall_s": 1.0},
+                    "attempts": attempts,
+                },
+            },
+        }))
+
+    _round(1, 0.10, [{"ok": True, "config": {"hosts": 128}}])
+    _round(2, 0.20, [{"ok": True, "config": {"hosts": 128}}])
+    _round(3, None, [{"ok": False, "error": "timeout after 10s",
+                      "config": {"hosts": 128, "rounds_per_chunk": 128}}])
+
+    sys.path.insert(0, str(TOOLS))
+    try:
+        import bench_history as bh
+    finally:
+        sys.path.pop(0)
+
+    rounds = bh.load_rounds(str(tmp_path))
+    assert [r["round"] for r in rounds] == [1, 2, 3]
+    assert rounds[2]["failure_kinds"] == ["timeout"]
+    table = bh.trajectory_table(rounds)
+    assert "null" in table and "timeout" in table
+
+    # newest round is null -> regression vs best prior (r2)
+    v = bh.regression_check(rounds)
+    assert v["regression"] is True and v["best_prior"] == 0.20
+
+    # an in-flight value above the best prior round is clean...
+    v = bh.regression_check(rounds, current=0.25)
+    assert v["regression"] is False and v["delta_pct"] == 25.0
+    # ...and one far below it flags
+    v = bh.regression_check(rounds, current=0.10)
+    assert v["regression"] is True
+
+    # the CLI exits nonzero on a regression (the bench log's delta line)
+    r = subprocess.run(
+        [sys.executable, str(TOOLS / "bench_history.py"), str(tmp_path)],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 1 and "REGRESSION" not in r.stdout  # null case note
+    r = subprocess.run(
+        [sys.executable, str(TOOLS / "bench_history.py"), str(tmp_path),
+         "--current", "0.21"],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0 and "ok:" in r.stdout
+
+
 def test_shm_cleanup(tmp_path):
     import mmap
     import os

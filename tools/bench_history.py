@@ -1,0 +1,179 @@
+#!/usr/bin/env python3
+"""Bench trajectory: parse every BENCH_r*.json into a per-round table
+and flag regressions against the best prior round.
+
+The BENCH_r* files are the repo's published performance record (one per
+growth round: {"n", "parsed": {"metric", "value", "detail": ...}}), but
+nothing ever read them BACK — a regression (or a round publishing null,
+like r05) was only visible to a human diffing JSON. This tool is the
+read side:
+
+  * `load_rounds` — one record per round: the metric value, the rung
+    ladder each attempt walked (hosts / rounds_per_chunk / wall /
+    failure kind), and the measuring config;
+  * `trajectory_table` — the human-readable per-round table;
+  * `regression_check` — the latest value (or an in-flight value passed
+    by bench.py) vs the best prior round, with a structured verdict.
+
+bench.py runs this at the end of every bench and prints the delta line
+into the bench log, so every BENCH_r*.json from now on carries its own
+trajectory context.
+
+Usage: python tools/bench_history.py [ROOT] [--current VALUE] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+
+# latest < best_prior * (1 - TOLERANCE) flags a regression; the slack
+# absorbs run-to-run noise on contended hosts without hiding a real slide
+TOLERANCE = 0.05
+
+
+def _attempt_row(att: dict) -> dict:
+    cfg = att.get("config", {})
+    row = {
+        "ok": bool(att.get("ok")),
+        "hosts": cfg.get("hosts"),
+        "rounds_per_chunk": cfg.get("rounds_per_chunk"),
+    }
+    if att.get("wall_s") is not None:
+        row["wall_s"] = att["wall_s"]
+    failure = att.get("failure")
+    if isinstance(failure, dict):
+        row["failure"] = failure.get("kind", "?")
+    elif not row["ok"]:
+        err = str(att.get("error", ""))
+        row["failure"] = (
+            "timeout" if "timeout" in err.lower() else (err[:40] or "?")
+        )
+    return row
+
+
+def load_rounds(root: str = ".") -> "list[dict]":
+    """One record per BENCH_r*.json, sorted by round number. Tolerant of
+    missing/partial fields — a malformed round becomes a null-value row,
+    never an exception."""
+    rounds = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = doc.get("parsed") or {}
+        detail = parsed.get("detail") or {}
+        main = detail.get("main") or {}
+        rec = {
+            "round": doc.get("n"),
+            "file": os.path.basename(path),
+            "metric": parsed.get("metric"),
+            "value": parsed.get("value"),
+            "vs_baseline": parsed.get("vs_baseline"),
+            "hosts": (detail.get("config") or {}).get("hosts"),
+            "rounds_per_chunk": (detail.get("config") or {}).get(
+                "rounds_per_chunk"
+            ),
+            "wall_s": main.get("wall_s"),
+            "partial": bool(main.get("partial")),
+            "attempts": [
+                _attempt_row(a) for a in detail.get("attempts", [])
+            ],
+        }
+        rec["failure_kinds"] = sorted(
+            {a["failure"] for a in rec["attempts"] if a.get("failure")}
+        )
+        rounds.append(rec)
+    rounds.sort(key=lambda r: (r["round"] is None, r["round"]))
+    return rounds
+
+
+def trajectory_table(rounds: "list[dict]") -> str:
+    """The per-round trajectory: metric value, measuring rung, per-rung
+    walls, and the failure kinds each round survived (or died of)."""
+    lines = [
+        f"{'round':>5} {'value':>10} {'hosts':>8} {'rpc':>5} {'wall_s':>8} "
+        f"{'rungs':>5}  failures"
+    ]
+    for r in rounds:
+        val = "null" if r["value"] is None else f"{r['value']:.4f}"
+        lines.append(
+            f"{r['round'] if r['round'] is not None else '?':>5} "
+            f"{val:>10}{'*' if r['partial'] else ' '}"
+            f"{r['hosts'] if r['hosts'] is not None else '-':>7} "
+            f"{r['rounds_per_chunk'] or '-':>5} "
+            f"{r['wall_s'] if r['wall_s'] is not None else '-':>8} "
+            f"{len(r['attempts']):>5}  "
+            f"{','.join(r['failure_kinds']) or '-'}"
+        )
+    return "\n".join(lines)
+
+
+def regression_check(rounds: "list[dict]",
+                     current: "float | None" = None) -> dict:
+    """The delta verdict: `current` (an in-flight bench value) — or the
+    newest recorded round when None — against the best prior round.
+    `regression` is True when the latest is null or more than TOLERANCE
+    below the best prior value."""
+    history = list(rounds)
+    latest_round = None
+    if current is None and history:
+        last = history[-1]
+        current, latest_round = last["value"], last["round"]
+        history = history[:-1]
+    prior = [r for r in history if r["value"] is not None]
+    best = max(prior, key=lambda r: r["value"]) if prior else None
+    out = {
+        "latest": current,
+        "latest_round": latest_round,
+        "best_prior": best["value"] if best else None,
+        "best_prior_round": best["round"] if best else None,
+        "rounds": len(rounds),
+    }
+    if best is None:
+        out["regression"] = current is None
+        out["note"] = "no prior non-null round"
+        return out
+    if current is None:
+        out["regression"] = True
+        out["note"] = f"latest is null vs best {best['value']} (r{best['round']})"
+        return out
+    delta = (current - best["value"]) / best["value"]
+    out["delta_pct"] = round(delta * 100, 1)
+    out["regression"] = delta < -TOLERANCE
+    out["note"] = (
+        f"{'REGRESSION' if out['regression'] else 'ok'}: "
+        f"{current:.4f} vs best {best['value']:.4f} "
+        f"(r{best['round']}, {out['delta_pct']:+.1f}%)"
+    )
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="BENCH_r*.json trajectory table + regression flag"
+    )
+    ap.add_argument("root", nargs="?", default=".",
+                    help="repo root holding BENCH_r*.json (default .)")
+    ap.add_argument("--current", type=float, default=None,
+                    help="an in-flight bench value to compare against the "
+                    "best recorded round")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the parsed rounds + verdict as JSON")
+    args = ap.parse_args(argv)
+    rounds = load_rounds(args.root)
+    verdict = regression_check(rounds, current=args.current)
+    if args.json:
+        print(json.dumps({"rounds": rounds, "verdict": verdict}, indent=2))
+    else:
+        print(trajectory_table(rounds))
+        print(verdict.get("note", ""))
+    return 1 if verdict.get("regression") else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
