@@ -669,6 +669,98 @@ def _measure_sweep(num_hosts: int, jobs: int = 8, capacity: int = 4):
     }
 
 
+def _measure_service(num_hosts: int, jobs_per_tenant: int = 3):
+    """Service trial (runs in a disposable child, role=service): the
+    DAEMON path — 3 tenants' specs spooled and drained through the
+    production DaemonService (runtime/daemon.py, docs/service.md
+    "Daemon mode"), then a SECOND daemon instance on the same spool
+    with three more specs, measuring what the restart actually pays:
+    `restart.compiles` must be 0 when the persistent compile cache
+    holds (the crash-recovery economics), and jobs/hour + cache hit
+    rate are the published detail.service SLO numbers
+    (tools/bench_history.py tracks both across rounds)."""
+    import tempfile
+
+    import yaml
+
+    from shadow_tpu.runtime.daemon import DaemonService, submit_spec
+
+    base = {
+        "general": {"stop_time": "100 ms", "heartbeat_interval": None},
+        "network": {"graph": {"type": "1_gbit_switch"}},
+        "experimental": {"rounds_per_chunk": 16},
+        "hosts": {
+            "peer": {
+                "network_node_id": 0,
+                "quantity": num_hosts,
+                "processes": [
+                    {
+                        "path": "phold",
+                        "args": {"min_delay": "1 ms", "max_delay": "8 ms"},
+                    }
+                ],
+            }
+        },
+    }
+
+    def _spool_specs(d, spool, tag, tenants):
+        for t in tenants:
+            spec = os.path.join(d, f"{t}-{tag}.yaml")
+            with open(spec, "w") as f:
+                yaml.safe_dump(
+                    {
+                        "job": {
+                            "tenant": t,
+                            "name": f"{tag}",
+                            "seeds": list(range(jobs_per_tenant)),
+                            "config": base,
+                        }
+                    },
+                    f,
+                )
+            submit_spec(spool, spec, tenant=t)
+
+    tenants = ("t1", "t2", "t3")
+    with tempfile.TemporaryDirectory() as d:
+        spool = os.path.join(d, "spool")
+        _spool_specs(d, spool, "warm", tenants)
+        t0 = time.perf_counter()
+        m1 = DaemonService(spool, capacity=jobs_per_tenant, drain=True).run()
+        wall1 = time.perf_counter() - t0
+        # the restart: a fresh service on the same spool — same worlds
+        # modulo seed, so every executable must come from disk
+        _spool_specs(d, spool, "resub", tenants)
+        t0 = time.perf_counter()
+        m2 = DaemonService(spool, capacity=jobs_per_tenant, drain=True).run()
+        wall2 = time.perf_counter() - t0
+    total_jobs = m1["jobs_done"] + m2["jobs_done"]
+    total_wall = wall1 + wall2
+    cache2 = m2["compile_cache"]
+    return {
+        "hosts": num_hosts,
+        "tenants": len(tenants),
+        "jobs": total_jobs,
+        "wall_s": round(total_wall, 2),
+        "jobs_per_hour": (
+            round(total_jobs / total_wall * 3600, 1) if total_wall > 0 else None
+        ),
+        "cache_hit_rate": cache2["hit_rate"],
+        "first_run": {
+            "jobs_done": m1["jobs_done"],
+            "wall_s": round(wall1, 2),
+            "compile_cache": m1["compile_cache"],
+        },
+        "restart": {
+            "jobs_done": m2["jobs_done"],
+            "wall_s": round(wall2, 2),
+            "compiles": cache2["compiles"],
+            "disk_hits": cache2.get("persistent", {}).get("disk_hits"),
+            "zero_recompile_restart": cache2["compiles"] == 0,
+        },
+        "tenant_table": m2["daemon"]["tenants"],
+    }
+
+
 def _child_env(**extra) -> dict:
     env = dict(os.environ)
     env.update({k: str(v) for k, v in extra.items()})
@@ -819,6 +911,10 @@ def main():
     if role == "sweep":
         sh = int(os.environ.get("SHADOW_TPU_BENCH_SWEEP_HOSTS", 128))
         print(json.dumps({"sweep": _measure_sweep(sh)}))
+        return
+    if role == "service":
+        sh = int(os.environ.get("SHADOW_TPU_BENCH_SERVICE_HOSTS", 128))
+        print(json.dumps({"service": _measure_service(sh)}))
         return
 
     # ---- orchestrator -------------------------------------------------
@@ -1166,6 +1262,42 @@ def main():
         except subprocess.TimeoutExpired:
             sweep = {"error": "timeout"}
 
+    # ---- service trial (daemon round, docs/service.md "Daemon mode"):
+    # 3 tenants spooled through the production DaemonService, then a
+    # restarted daemon on the same spool — jobs/hour, cache hit rate,
+    # and whether the restart paid zero recompiles from the persistent
+    # cache. SHADOW_TPU_BENCH_SERVICE=0 disables. ------------------------
+    service = None
+    if os.environ.get("SHADOW_TPU_BENCH_SERVICE", "1") != "0" and _time_left() > 150:
+        svh = int(
+            os.environ.get(
+                "SHADOW_TPU_BENCH_SERVICE_HOSTS", 1024 if tpu_up else 128
+            )
+        )
+        env_extra = dict(
+            SHADOW_TPU_BENCH_ROLE="service",
+            SHADOW_TPU_BENCH_SERVICE_HOSTS=svh,
+        )
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=_child_env(**env_extra) if tpu_up else _cpu_env(**env_extra),
+                capture_output=True,
+                text=True,
+                timeout=600 if tpu_up else min(420.0, max(_time_left(), 90.0)),
+            )
+            for ln in r.stdout.strip().splitlines():
+                try:
+                    obj = json.loads(ln)
+                except ValueError:
+                    continue
+                if "service" in obj:
+                    service = obj["service"]
+            if service is None:
+                service = {"error": f"rc={r.returncode}: {r.stderr[-300:]}"}
+        except subprocess.TimeoutExpired:
+            service = {"error": "timeout"}
+
     # optional: the old JAX-on-CPU measurement, for the record only
     cpu_xla = None
     if os.environ.get("SHADOW_TPU_BENCH_CPU_XLA") == "1":
@@ -1205,6 +1337,16 @@ def main():
         spec.loader.exec_module(bh)
         rounds = bh.load_rounds(os.path.dirname(os.path.abspath(__file__)))
         history = bh.regression_check(rounds, current=round(rate, 4))
+        if service and service.get("jobs_per_hour") is not None:
+            # the daemon-plane SLO pair gets the same best-prior
+            # flagging as the headline metric (tools/bench_history.py)
+            history["service"] = bh.service_check(
+                rounds,
+                current={
+                    "jobs_per_hour": service.get("jobs_per_hour"),
+                    "cache_hit_rate": service.get("cache_hit_rate"),
+                },
+            )
         print(json.dumps({"bench_history": history}), flush=True)
     except Exception as e:  # noqa: BLE001 — trajectory is advisory
         print(json.dumps({"bench_history": {"error": str(e)[:200]}}),
@@ -1225,6 +1367,7 @@ def main():
                     **({"scaling": scaling} if scaling else {}),
                     **({"ensemble": ensemble} if ensemble else {}),
                     **({"sweep": sweep} if sweep else {}),
+                    **({"service": service} if service else {}),
                     **({"cpu_xla": cpu_xla} if cpu_xla else {}),
                     **({"history": history} if history else {}),
                     "attempts": [

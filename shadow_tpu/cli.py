@@ -189,6 +189,123 @@ def main(argv: "list[str] | None" = None) -> int:
         "scheduling decision — the sweep service's scrape endpoint "
         "(docs/service.md)",
     )
+    serve_p = sub.add_parser(
+        "serve",
+        help="run the durable simulation daemon on a spool directory: "
+        "live job arrivals (specs dropped into SPOOL/incoming/), a "
+        "crash-safe write-ahead journal (SIGKILL loses zero admitted "
+        "jobs), per-tenant quotas + weighted fair-share, and a "
+        "disk-persistent compile cache (docs/service.md 'Daemon mode')",
+    )
+    serve_p.add_argument(
+        "spool", help="spool directory (created if missing; all durable "
+        "daemon state — journal, jobs, checkpoints, cache — lives here)"
+    )
+    serve_p.add_argument(
+        "--drain",
+        action="store_true",
+        help="process every queued and spooled job, then exit instead "
+        "of waiting for new arrivals (batch mode; also the "
+        "crash-recovery idiom: restart with --drain to finish a dead "
+        "daemon's queue)",
+    )
+    serve_p.add_argument(
+        "--poll-interval", type=float, default=2.0, metavar="SECONDS",
+        help="spool scan cadence, also honored mid-batch so live "
+        "arrivals can preempt (default 2)",
+    )
+    serve_p.add_argument(
+        "--prom-interval", type=float, default=10.0, metavar="SECONDS",
+        help="wall-clock cadence for rewriting the --metrics-prom "
+        "textfile and daemon-manifest.json while batches run "
+        "(default 10)",
+    )
+    serve_p.add_argument(
+        "--capacity", type=int, default=8, metavar="N",
+        help="max jobs packed into one ensemble batch (default 8)",
+    )
+    serve_p.add_argument(
+        "--retry-max", type=int, default=1, metavar="N",
+        help="per-job retries before quarantine (default 1)",
+    )
+    serve_p.add_argument(
+        "--max-queue", type=int, default=256, metavar="N",
+        help="bounded-queue backpressure: admissions beyond N "
+        "outstanding jobs are rejected with a journaled record "
+        "(default 256)",
+    )
+    serve_p.add_argument(
+        "--default-quota", type=int, default=64, metavar="N",
+        help="per-tenant cap on outstanding jobs (default 64)",
+    )
+    serve_p.add_argument(
+        "--quota", action="append", metavar="TENANT=N",
+        help="override the outstanding-jobs quota for one tenant "
+        "(repeatable)",
+    )
+    serve_p.add_argument(
+        "--weight", action="append", metavar="TENANT=W",
+        help="fair-share weight for one tenant (higher = more service "
+        "within a priority level; default 1.0; repeatable)",
+    )
+    serve_p.add_argument(
+        "--keep-batch-dirs", type=int, default=8, metavar="K",
+        help="retention for per-batch checkpoint dirs: finished "
+        "batches' checkpoints are removed immediately, leftovers "
+        "beyond the newest K pruned (default 8)",
+    )
+    serve_p.add_argument(
+        "--cache-dir", metavar="DIR",
+        help="persistent compile-cache directory (default SPOOL/cache)",
+    )
+    serve_p.add_argument(
+        "--no-cache-persist", action="store_true",
+        help="keep the compile cache in-memory only (the pre-daemon "
+        "behavior: executables die with the process)",
+    )
+    serve_p.add_argument(
+        "--metrics-file", metavar="PATH",
+        help="stream service samples/events as JSONL; rotates at "
+        "--metrics-max-mb keeping --metrics-keep segments",
+    )
+    serve_p.add_argument(
+        "--metrics-max-mb", type=float, default=64.0, metavar="MB",
+        help="metrics JSONL rotation cap (default 64; 0 = unbounded)",
+    )
+    serve_p.add_argument(
+        "--metrics-keep", type=int, default=3, metavar="N",
+        help="rotated metrics segments kept (default 3)",
+    )
+    serve_p.add_argument(
+        "--metrics-prom", metavar="PATH",
+        help="Prometheus textfile snapshot: sweep gauges plus "
+        "shadow_tpu_daemon_uptime_seconds and the "
+        "shadow_tpu_tenant_queue_depth{tenant=...} family, rewritten "
+        "at --prom-interval cadence even mid-batch",
+    )
+    serve_p.add_argument(
+        "--chaos-seed", type=int, metavar="N",
+        help="chaos-plane PRNG seed (docs/robustness.md)",
+    )
+    serve_p.add_argument(
+        "--chaos-fault", action="append", metavar="SPEC",
+        help="inject a deterministic daemon fault, e.g. "
+        "'daemon-kill@2:target=chunk', 'spool-corrupt@1', "
+        "'cache-corrupt@0' (repeatable; plus every run-level kind)",
+    )
+    submit_p = sub.add_parser(
+        "submit",
+        help="atomically drop a job spec into a daemon spool's "
+        "incoming/ directory (write-then-rename, so the daemon never "
+        "reads a torn file)",
+    )
+    submit_p.add_argument("spool", help="the daemon's spool directory")
+    submit_p.add_argument("spec", help="path to a job spec YAML "
+                          "(a 'job:' mapping; docs/service.md)")
+    submit_p.add_argument(
+        "--tenant", metavar="NAME",
+        help="set/override job.tenant in the submitted spec",
+    )
     metrics_p = sub.add_parser(
         "metrics",
         help="summarize a recorded metrics series: a --metrics-file "
@@ -198,6 +315,20 @@ def main(argv: "list[str] | None" = None) -> int:
     )
     metrics_p.add_argument(
         "file", help="path to a metrics JSONL stream or flight-recorder.json"
+    )
+    metrics_p.add_argument(
+        "--follow",
+        action="store_true",
+        help="tail mode: re-render the summary whenever the stream "
+        "grows (watch a live daemon; Ctrl-C to stop)",
+    )
+    metrics_p.add_argument(
+        "--interval", type=float, default=2.0, metavar="SECONDS",
+        help="--follow poll cadence (default 2)",
+    )
+    metrics_p.add_argument(
+        "--max-updates", type=int, default=None, metavar="N",
+        help="stop --follow after N re-renders (default: until Ctrl-C)",
     )
     sub.add_parser(
         "shm-cleanup",
@@ -248,11 +379,58 @@ def main(argv: "list[str] | None" = None) -> int:
         except CliUserError as e:
             print(f"shadow-tpu: error: {e}", file=sys.stderr)
             return 1
-    if args.command == "metrics":
-        from shadow_tpu.runtime.flightrec import render_summary_file
+    if args.command == "serve":
+        from shadow_tpu.runtime.cli_run import CliUserError, run_serve
 
         try:
+            return run_serve(
+                args.spool,
+                drain=args.drain,
+                poll_interval=args.poll_interval,
+                prom_interval=args.prom_interval,
+                capacity=args.capacity,
+                retry_max=args.retry_max,
+                max_queue=args.max_queue,
+                default_quota=args.default_quota,
+                quotas=args.quota,
+                weights=args.weight,
+                keep_batch_dirs=args.keep_batch_dirs,
+                cache_dir=args.cache_dir,
+                no_cache_persist=args.no_cache_persist,
+                metrics_file=args.metrics_file,
+                metrics_max_mb=args.metrics_max_mb,
+                metrics_keep=args.metrics_keep,
+                metrics_prom=args.metrics_prom,
+                chaos_seed=args.chaos_seed,
+                chaos_faults=args.chaos_fault,
+            )
+        except CliUserError as e:
+            print(f"shadow-tpu: error: {e}", file=sys.stderr)
+            return 1
+    if args.command == "submit":
+        from shadow_tpu.runtime.cli_run import CliUserError, run_submit
+
+        try:
+            return run_submit(args.spool, args.spec, tenant=args.tenant)
+        except CliUserError as e:
+            print(f"shadow-tpu: error: {e}", file=sys.stderr)
+            return 1
+    if args.command == "metrics":
+        from shadow_tpu.runtime.flightrec import (
+            follow_file,
+            render_summary_file,
+        )
+
+        try:
+            if args.follow:
+                follow_file(
+                    args.file, interval_s=args.interval,
+                    max_updates=args.max_updates,
+                )
+                return 0
             print(render_summary_file(args.file))
+        except KeyboardInterrupt:
+            return 0  # the way a --follow session ends
         except (OSError, ValueError) as e:
             print(f"shadow-tpu: error: {e}", file=sys.stderr)
             return 1

@@ -41,6 +41,8 @@ _DISPLAY_GENERAL_KEYS = (
     "trace_file",
     "metrics_file",
     "metrics_prom",
+    "metrics_max_mb",
+    "metrics_keep",
     "heartbeat_interval_ns",
     "checkpoint_dir",
     "checkpoint_interval_ns",

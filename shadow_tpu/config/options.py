@@ -40,6 +40,13 @@ FAULT_KINDS = (
     "worker-kill",
     "worker-hang",
     "preempt",
+    # daemon-plane faults (runtime/daemon.py; docs/robustness.md):
+    # SIGKILL the serve process at an admission/batch/chunk/checkpoint
+    # ordinal, corrupt a just-written spool journal record, corrupt a
+    # just-written persistent compile-cache entry
+    "daemon-kill",
+    "spool-corrupt",
+    "cache-corrupt",
 )
 
 
@@ -92,6 +99,13 @@ class GeneralOptions:
     # --metrics-file / --metrics-prom.
     metrics_file: Optional[str] = None
     metrics_prom: Optional[str] = None
+    # Rolling retention for the metrics stream (runtime/flightrec.py):
+    # when metrics_max_mb > 0 the JSONL file rotates at that size cap
+    # (file -> file.1 -> ... -> file.N) keeping metrics_keep rotated
+    # segments, so a week-long daemon soak cannot fill the disk.
+    # 0 = unbounded (the pre-daemon behavior).
+    metrics_max_mb: float = 0.0
+    metrics_keep: int = 3
     # Fault tolerance (docs/robustness.md): `checkpoint_dir` turns on
     # versioned chunk-boundary checkpoints at `checkpoint_interval`
     # sim-time cadence (SIGINT/SIGTERM also write a final one); `resume`
@@ -136,6 +150,8 @@ class GeneralOptions:
             "trace_file",
             "metrics_file",
             "metrics_prom",
+            "metrics_max_mb",
+            "metrics_keep",
             "checkpoint_dir",
             "resume",
             "replicas",
@@ -144,6 +160,12 @@ class GeneralOptions:
             if k in d:
                 setattr(out, k, d.pop(k))
         _reject_unknown("general", d)
+        out.metrics_max_mb = float(out.metrics_max_mb)
+        if out.metrics_max_mb < 0:
+            raise ValueError("general.metrics_max_mb must be >= 0 (0 = unbounded)")
+        out.metrics_keep = int(out.metrics_keep)
+        if out.metrics_keep < 1:
+            raise ValueError("general.metrics_keep must be >= 1")
         if out.replicas < 1:
             raise ValueError("general.replicas must be >= 1")
         if out.replica_seed_stride < 1:

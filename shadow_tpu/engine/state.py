@@ -15,6 +15,7 @@ from typing import Any
 import flax.struct
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from shadow_tpu import equeue, netstack, rng
 from shadow_tpu.equeue import PAYLOAD_LANES, EventQueue
@@ -372,9 +373,27 @@ def state_to_host(st: SimState) -> SimState:
     checkpoints (runtime/checkpoint.py) and the rollback-and-regrow
     retention (runtime/recovery.py): a plain-numpy pytree that stays
     valid no matter how many times the device buffers are donated
-    afterwards. Invert with state_from_host."""
-    return jax.device_get(
-        jax.tree.map(lambda l: jax.random.key_data(l) if _is_key_leaf(l) else l, st)
+    afterwards. Invert with state_from_host.
+
+    The "stays valid" clause needs an explicit copy of any leaf that is
+    a zero-copy VIEW of a device buffer: on the CPU backend device_get
+    can alias the buffer directly, and an executable reloaded through
+    jax.experimental.serialize_executable reuses donated input buffers
+    for its outputs — so without the copy, the pipelined driver's next
+    chunk launch would rewrite a pending checkpoint snapshot under the
+    writer (caught by the daemon's sha-256 digests as a corrupt file)."""
+
+    def _owned(a):
+        a = np.asarray(a)
+        return a if a.flags.owndata else a.copy()
+
+    return jax.tree.map(
+        _owned,
+        jax.device_get(
+            jax.tree.map(
+                lambda l: jax.random.key_data(l) if _is_key_leaf(l) else l, st
+            )
+        ),
     )
 
 

@@ -48,6 +48,20 @@ Fault kinds (the injection catalog):
   ``preempt``       arm the sweep scheduler's preemption guard at batch
                     chunk `at` even with no higher-priority arrival —
                     a preemption storm is several of these.
+  ``daemon-kill``   SIGKILL the serve daemon (runtime/daemon.py) at
+                    site ordinal `at`; `target` picks the site class
+                    (``admit`` / ``batch-start`` / ``chunk`` /
+                    ``checkpoint``, no target = first match anywhere) —
+                    exercises the crash-safe journal + checkpoint
+                    replay: restart on the same spool loses zero jobs.
+  ``spool-corrupt`` flip bytes inside spool journal record number `at`
+                    after its atomic write — exercises the journal's
+                    per-record sha-256 check and the accepted-spec
+                    re-admission fallback.
+  ``cache-corrupt`` flip bytes inside persistent compile-cache entry
+                    number `at` after its atomic write — exercises the
+                    cache's integrity check: a damaged entry degrades
+                    to a recompile warning, never a failure.
 
 Opposite the injections sits the degradation ladder the chaos matrix
 validates (tests/test_chaos.py): the watchdog re-dispatch

@@ -192,3 +192,140 @@ def run_sweep(
         and manifest["jobs_unroutable"] == 0
     )
     return 0 if clean else 1
+
+
+def _parse_kv_list(args, cast, flag: str) -> dict:
+    out = {}
+    for item in args or []:
+        key, sep, val = str(item).partition("=")
+        if not sep or not key:
+            raise CliUserError(f"invalid {flag} {item!r}: expected KEY=VALUE")
+        try:
+            out[key] = cast(val)
+        except ValueError as e:
+            raise CliUserError(f"invalid {flag} {item!r}: {e}") from e
+    return out
+
+
+def run_serve(
+    spool: str,
+    drain: bool = False,
+    poll_interval: float = 2.0,
+    prom_interval: float = 10.0,
+    capacity: int = 8,
+    retry_max: int = 1,
+    max_queue: int = 256,
+    default_quota: int = 64,
+    quotas: "list[str] | None" = None,
+    weights: "list[str] | None" = None,
+    keep_batch_dirs: int = 8,
+    cache_dir: "str | None" = None,
+    no_cache_persist: bool = False,
+    metrics_file: "str | None" = None,
+    metrics_max_mb: float = 64.0,
+    metrics_keep: int = 3,
+    metrics_prom: "str | None" = None,
+    chaos_seed: "int | None" = None,
+    chaos_faults: "list[str] | None" = None,
+) -> int:
+    """`shadow-tpu serve` implementation (docs/service.md "Daemon
+    mode"). Exit 0 when the daemon shut down cleanly with no job left
+    `failed`/`quarantined` this run; rejections alone do not fail the
+    daemon (they are the submitter's structured signal)."""
+    import contextlib
+
+    from shadow_tpu.runtime import chaos
+    from shadow_tpu.runtime.daemon import DaemonService
+
+    if capacity < 1:
+        raise CliUserError("--capacity must be >= 1")
+    if retry_max < 0:
+        raise CliUserError("--retry-max must be >= 0")
+    if max_queue < 1 or default_quota < 1:
+        raise CliUserError("--max-queue and --default-quota must be >= 1")
+    faults = []
+    for arg in chaos_faults or []:
+        from shadow_tpu.runtime.chaos import parse_fault_arg
+
+        try:
+            faults.append(parse_fault_arg(arg))
+        except ValueError as e:
+            raise CliUserError(f"invalid --chaos-fault {arg!r}: {e}") from e
+    try:
+        service = DaemonService(
+            spool,
+            capacity=capacity,
+            retry_max=retry_max,
+            default_quota=default_quota,
+            quotas=_parse_kv_list(quotas, int, "--quota"),
+            weights=_parse_kv_list(weights, float, "--weight"),
+            max_queue=max_queue,
+            poll_interval_s=poll_interval,
+            prom_interval_s=prom_interval,
+            keep_batch_dirs=keep_batch_dirs,
+            drain=drain,
+            cache_dir=cache_dir,
+            persist_cache=not no_cache_persist,
+            metrics_file=metrics_file,
+            metrics_max_mb=metrics_max_mb,
+            metrics_keep=metrics_keep,
+            metrics_prom=metrics_prom,
+        )
+    except (ValueError, OSError) as e:
+        raise CliUserError(str(e)) from e
+    plan = (
+        chaos.FaultPlan(seed=chaos_seed or 0, faults=faults)
+        if faults else None
+    )
+    ctx = chaos.installed(plan) if plan else contextlib.nullcontext()
+    try:
+        with ctx:
+            manifest = service.run()
+    except OSError as e:
+        raise CliUserError(str(e)) from e
+    d = manifest["daemon"]
+    print(
+        f"daemon on {d['spool']}: {manifest['jobs_done']} job(s) done this "
+        f"run ({d['jobs_done_total']} total), "
+        f"{manifest['jobs_failed']} failed, "
+        f"{manifest['jobs_quarantined']} quarantined, "
+        f"{d['outstanding_jobs']} outstanding, "
+        f"{d['journal']['records']} journal record(s)"
+        + (f", {d['jobs_per_hour']} jobs/hour" if d["jobs_per_hour"] else "")
+        + (
+            f", {d['replay_failed_jobs']} failed at journal replay"
+            if d.get("replay_failed_jobs") else ""
+        )
+    )
+    cache = manifest["compile_cache"]
+    line = (
+        f"compile cache: {cache['compiles']} compile(s), "
+        f"{cache['hits']} hit(s) (rate {cache['hit_rate']:.2f})"
+    )
+    if "persistent" in cache:
+        p = cache["persistent"]
+        line += (
+            f"; persistent: {p['disk_hits']} disk hit(s), "
+            f"{p['disk_stores']} stored, {p['disk_skips']} skipped"
+        )
+    print(line)
+    clean = (
+        manifest["jobs_failed"] == 0
+        and manifest["jobs_quarantined"] == 0
+        # jobs marked failed during journal replay never enter the live
+        # queue's counters, but they are failures of this run
+        and d.get("replay_failed_jobs", 0) == 0
+    )
+    return 0 if clean else 1
+
+
+def run_submit(spool: str, spec: str, tenant: "str | None" = None) -> int:
+    """`shadow-tpu submit` implementation: atomic drop into the spool."""
+    from shadow_tpu.runtime.daemon import submit_spec
+
+    try:
+        dest = submit_spec(spool, spec, tenant=tenant)
+    except (ValueError, OSError, yaml.YAMLError) as e:
+        raise CliUserError(f"invalid spec: {e}") from e
+    print(f"spooled {dest}")
+    return 0

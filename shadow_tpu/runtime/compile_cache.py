@@ -22,15 +22,32 @@ across every batch that shares it:
     sweep manifest publishes both (the tier-1 test asserts an 8-job
     sweep pays exactly one).
 
-Scope: one cache per SweepService (in-process, this run). Persistent
-on-disk caching is jax's own compilation-cache territory, not ours.
+Scope: `CompileCache` is one cache per SweepService (in-process, this
+run). `PersistentCompileCache` extends it with a disk tier for the
+daemon (runtime/daemon.py, docs/service.md "Daemon mode"): AOT
+executables are serialized (jax.experimental.serialize_executable)
+into the spool's cache directory keyed by the full cache key PLUS the
+jax version and backend platform, so a restarted daemon pays zero XLA
+recompiles for worlds it has already compiled — and a corrupt,
+truncated, or version-mismatched entry degrades to a recompile with a
+warning, never a crash (the `cache-corrupt` chaos fault pins this).
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
+import os
+import pickle
 import time
 
 import jax
+
+from shadow_tpu.utils.shadow_log import slog
+
+# bumped when the on-disk entry layout changes; a mismatch is a skip
+# (recompile), never an error
+CACHE_FORMAT = 1
 
 
 def state_signature(st) -> tuple:
@@ -79,6 +96,14 @@ class CompileCache:
             self.hits += 1
             flightrec.record_event("compile_cache", hit=True)
             return exe
+        exe = self._load_persisted(fk)
+        if exe is not None:
+            # a disk hit is a hit — the whole point is zero recompiles
+            # across daemon restarts
+            self.hits += 1
+            self._entries[fk] = exe
+            flightrec.record_event("compile_cache", hit=True, tier="disk")
+            return exe
         t0 = time.perf_counter()
         exe = build()
         wall = time.perf_counter() - t0
@@ -89,7 +114,15 @@ class CompileCache:
         # compile telemetry: a miss's XLA wall is a first-class event in
         # the metrics stream (runtime/flightrec.py)
         flightrec.record_event("compile_cache", hit=False, wall_s=round(wall, 4))
+        self._persist(fk, exe)
         return exe
+
+    # the disk-tier seams PersistentCompileCache fills in
+    def _load_persisted(self, fk):
+        return None
+
+    def _persist(self, fk, exe) -> None:
+        pass
 
     @property
     def compiles(self) -> int:
@@ -107,3 +140,132 @@ class CompileCache:
             "compile_seconds": round(self.compile_seconds, 4),
             "compile_walls": self.compile_walls,
         }
+
+
+class PersistentCompileCache(CompileCache):
+    """CompileCache with a disk tier under `cache_dir` (the daemon's
+    cross-restart cache).
+
+    Entry layout: one file per full key, named by the sha-256 of the
+    key's repr. The file is a one-line JSON header — format version,
+    `jax.__version__` + backend platform (a serialized executable is
+    only loadable by the runtime that wrote it), and the sha-256 of the
+    payload — followed by the pickled
+    `jax.experimental.serialize_executable.serialize(exe)` triple.
+    Writes are atomic (tmp + rename, the journal/checkpoint idiom).
+
+    Every degradation is survivable BY CONSTRUCTION: an unreadable,
+    truncated, digest-mismatched, or version-mismatched entry — and a
+    backend whose executables refuse to (de)serialize at all — logs one
+    warning and falls back to a normal XLA compile. `stats()` gains a
+    `persistent` block (disk_hits / disk_stores / disk_skips)."""
+
+    def __init__(self, cache_dir: str):
+        super().__init__()
+        self.cache_dir = cache_dir
+        self.disk_hits = 0
+        self.disk_stores = 0
+        self.disk_skips = 0  # corrupt/mismatched/unserializable entries
+        self.runtime_version = f"jax-{jax.__version__}/{jax.default_backend()}"
+        os.makedirs(cache_dir, exist_ok=True)
+
+    def _entry_path(self, fk) -> str:
+        digest = hashlib.sha256(repr(fk).encode()).hexdigest()
+        return os.path.join(self.cache_dir, f"exe-{digest[:32]}.bin")
+
+    def _load_persisted(self, fk):
+        from jax.experimental import serialize_executable
+
+        path = self._entry_path(fk)
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path, "rb") as f:
+                header = json.loads(f.readline())
+                payload = f.read()
+        except (OSError, ValueError):
+            self.disk_skips += 1
+            slog("warning", 0, "cache",
+                 f"persistent compile-cache entry {path} is unreadable "
+                 "(corrupt or truncated); recompiling")
+            return None
+        if header.get("format") != CACHE_FORMAT or (
+            header.get("runtime") != self.runtime_version
+        ):
+            self.disk_skips += 1
+            slog("warning", 0, "cache",
+                 f"persistent compile-cache entry {path} was written by "
+                 f"{header.get('runtime')!r} format {header.get('format')!r} "
+                 f"(this runtime is {self.runtime_version!r} format "
+                 f"{CACHE_FORMAT}); recompiling")
+            return None
+        if hashlib.sha256(payload).hexdigest() != header.get("sha256"):
+            self.disk_skips += 1
+            slog("warning", 0, "cache",
+                 f"persistent compile-cache entry {path} failed its "
+                 "sha-256 integrity check; recompiling")
+            return None
+        try:
+            serialized, in_tree, out_tree = pickle.loads(payload)
+            exe = serialize_executable.deserialize_and_load(
+                serialized, in_tree, out_tree
+            )
+        except Exception as e:  # noqa: BLE001 — any load failure = recompile
+            self.disk_skips += 1
+            slog("warning", 0, "cache",
+                 f"persistent compile-cache entry {path} failed to "
+                 f"deserialize ({type(e).__name__}: {str(e)[:120]}); "
+                 "recompiling")
+            return None
+        self.disk_hits += 1
+        return exe
+
+    def _persist(self, fk, exe) -> None:
+        from jax.experimental import serialize_executable
+
+        from shadow_tpu.runtime import chaos
+
+        path = self._entry_path(fk)
+        try:
+            payload = pickle.dumps(serialize_executable.serialize(exe))
+        except Exception as e:  # noqa: BLE001 — persistence is best-effort
+            self.disk_skips += 1
+            slog("warning", 0, "cache",
+                 f"executable for key {repr(fk)[:60]}… does not serialize "
+                 f"on this backend ({type(e).__name__}: {str(e)[:120]}); "
+                 "it will be recompiled after a restart")
+            return
+        header = {
+            "format": CACHE_FORMAT,
+            "runtime": self.runtime_version,
+            "sha256": hashlib.sha256(payload).hexdigest(),
+            "bytes": len(payload),
+        }
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(json.dumps(header).encode() + b"\n")
+                f.write(payload)
+            os.replace(tmp, path)
+        except OSError as e:
+            self.disk_skips += 1
+            slog("warning", 0, "cache",
+                 f"could not persist compile-cache entry {path}: {e}")
+            return
+        self.disk_stores += 1
+        # chaos seam (runtime/chaos.py `cache-corrupt`): damage lands
+        # AFTER the atomic commit — bit-rot on a fully written entry,
+        # which is exactly what the sha-256 check must catch
+        if chaos.fire("cache-corrupt", at=self.disk_stores - 1) is not None:
+            chaos.damage_file(path, truncate=False)
+
+    def stats(self) -> dict:
+        out = super().stats()
+        out["persistent"] = {
+            "dir": self.cache_dir,
+            "runtime": self.runtime_version,
+            "disk_hits": self.disk_hits,
+            "disk_stores": self.disk_stores,
+            "disk_skips": self.disk_skips,
+        }
+        return out

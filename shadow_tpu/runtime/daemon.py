@@ -1,0 +1,1019 @@
+"""Durable simulation daemon: `shadow-tpu serve SPOOL_DIR`
+(docs/service.md "Daemon mode").
+
+The sweep scheduler (runtime/sweep.py) is a one-shot CLI: every job is
+pre-declared, queue state lives in memory, and the AOT compile cache
+dies with the process. This module promotes it to a **service** — and a
+service is trustworthy only if it survives its own death without losing
+work (the property ROADMAP item 5 named). Three mechanisms carry that
+guarantee:
+
+  * **Spool protocol** — jobs arrive live as YAML files dropped into
+    ``SPOOL_DIR/incoming/`` (atomically: write elsewhere, rename in —
+    ``shadow-tpu submit`` does this). Each file is one job entry
+    (tenant, name, seeds, priority, scenario config); admission moves
+    it to ``accepted/`` or ``rejected/`` with a structured reason.
+  * **Crash-safe journal** — every admission, rejection, batch start,
+    terminal job status, crash/resume, and clean shutdown is a
+    write-ahead record in ``SPOOL_DIR/journal/``: one JSON file per
+    record, atomic tmp+rename, sha-256 payload digest (the checkpoint
+    plane's integrity idiom). A SIGKILL at ANY point — mid-admission,
+    mid-batch, mid-checkpoint — loses zero accepted jobs: restart
+    replays the journal, re-queues every admitted-but-unfinished job,
+    resumes running batches from their latest valid checkpoint through
+    the existing CheckpointManager/latest_path recovery path (jobs
+    without one restart from scratch — and the journal's ``resume``
+    record says which). A corrupt journal record (bit-rot, the
+    ``spool-corrupt`` chaos fault) is skipped with a warning and its
+    admission recovered from the archived spec in ``accepted/``.
+  * **Multi-tenant admission control** — per-tenant quotas bound each
+    tenant's outstanding jobs, a bounded queue provides backpressure
+    (both reject with a journaled, structured record), and scheduling
+    is weighted fair-share within each priority level: the tenant with
+    the least weighted sim-time served runs next, so one tenant's
+    100-job flood cannot starve another tenant's single urgent job.
+
+The compile cache is a PersistentCompileCache
+(runtime/compile_cache.py) rooted in the spool, so a restarted daemon
+pays zero XLA recompiles for worlds it has already compiled. The chaos
+plane closes the loop: ``daemon-kill`` / ``spool-corrupt`` /
+``cache-corrupt`` faults (runtime/chaos.py) drive the soak test
+(tests/test_daemon_soak.py) — 100+ jobs, 3 tenants, faults firing, and
+the acceptance bar is zero lost jobs with the queue draining via
+quarantine rather than collapse.
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+import json
+import os
+import re
+import signal
+import threading
+import time
+
+import yaml
+
+from shadow_tpu.config.fingerprint import config_fingerprint
+from shadow_tpu.config.options import ConfigOptions, deep_merge
+from shadow_tpu.config.sweep import SweepJob, SweepSpec, _expand_seeds
+from shadow_tpu.runtime.compile_cache import PersistentCompileCache
+from shadow_tpu.runtime.sweep import Batch, SweepService
+from shadow_tpu.utils.shadow_log import slog
+
+JOURNAL_VERSION = 1
+
+# tenant and entry names become path components and prometheus label
+# values — keep them boring
+_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
+
+_TERMINAL_TYPES = {
+    "done": "job-done",
+    "failed": "job-failed",
+    "quarantined": "job-quarantined",
+}
+
+
+def _record_digest(rec: dict) -> str:
+    """sha-256 over the record's canonical JSON minus its own digest
+    field — re-derived and compared on replay, so a flipped byte in a
+    journal record surfaces as a named skip, never a silently different
+    queue state."""
+    payload = {k: v for k, v in rec.items() if k != "sha256"}
+    return hashlib.sha256(
+        json.dumps(payload, sort_keys=True, default=str).encode()
+    ).hexdigest()
+
+
+class Journal:
+    """Append-only write-ahead journal: one JSON file per record, named
+    by sequence number, committed with the checkpoint plane's
+    atomic-write + payload-digest idiom. Replay returns valid records
+    in sequence order; corrupt/unreadable records are skipped with a
+    warning and counted (`corrupt_skipped`) — the daemon's accepted/
+    rescan recovers any admission whose record was lost."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        self.corrupt_skipped = 0
+        os.makedirs(directory, exist_ok=True)
+        seqs = [
+            int(m.group(1))
+            for m in (re.match(r"^r(\d{8})\.json$", f)
+                      for f in os.listdir(directory))
+            if m
+        ]
+        self._seq = max(seqs) + 1 if seqs else 0
+
+    @property
+    def count(self) -> int:
+        return self._seq
+
+    def _path(self, seq: int) -> str:
+        return os.path.join(self.directory, f"r{seq:08d}.json")
+
+    def append(self, _type: str, **data) -> dict:
+        from shadow_tpu.runtime import chaos
+
+        rec = {
+            "seq": self._seq,
+            "version": JOURNAL_VERSION,
+            "type": _type,
+            "wall": round(time.time(), 3),
+            **data,
+        }
+        rec["sha256"] = _record_digest(rec)
+        path = self._path(self._seq)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, path)
+        # chaos seam: bit-rot on a fully committed record — exactly what
+        # the per-record digest and the accepted/ rescan defend against
+        if chaos.fire("spool-corrupt", at=rec["seq"]) is not None:
+            chaos.damage_file(path, truncate=False)
+        self._seq += 1
+        return rec
+
+    def replay(self) -> "list[dict]":
+        records = []
+        for fname in sorted(os.listdir(self.directory)):
+            if not re.match(r"^r\d{8}\.json$", fname):
+                continue
+            path = os.path.join(self.directory, fname)
+            try:
+                with open(path) as f:
+                    rec = json.load(f)
+                if rec.get("sha256") != _record_digest(rec):
+                    raise ValueError("payload failed its sha-256 check")
+            except (OSError, ValueError) as e:
+                self.corrupt_skipped += 1
+                slog("warning", 0, "daemon",
+                     f"skipping corrupt journal record {path}: {e} — "
+                     "admissions will be recovered from accepted/ specs")
+                continue
+            records.append(rec)
+        records.sort(key=lambda r: r.get("seq", 0))
+        return records
+
+
+def parse_spool_spec(text: str, spool_dir: str,
+                     default_tenant: str = "default"):
+    """Parse one spool spec file into (tenant, entry, jobs,
+    canonical_text).
+
+    `canonical_text` is the spec with a `base:` reference REPLACED by
+    the loaded config and seed ranges expanded — the hermetic form the
+    journal embeds and the archive stores, so a replay can never be
+    changed by edits to an external base file after admission
+    (re-parsing the canonical text always rebuilds the admitted
+    world).
+
+    Format — a single ``job`` mapping::
+
+        job:
+          tenant: alice            # default "default"
+          name: ph                 # entry name, unique per tenant
+          seeds: [0, 1]            # and/or seed_range: [lo, hi)
+          priority: 0              # higher preempts lower
+          config: {...}            # inline scenario mapping, or
+          # base: /abs/path.yaml   # an absolute config path
+          overrides: {...}         # deep-merged over config/base
+
+    Every (entry, seed) expands to one validated single-world SweepJob
+    named ``<tenant>.<entry>-s<seed>`` with its data directory under
+    ``<spool>/jobs/``. Deterministic: re-parsing the same text yields
+    the same jobs — the journal-replay contract."""
+    raw = yaml.safe_load(text)
+    if not isinstance(raw, dict) or "job" not in raw:
+        raise ValueError("spool spec must be a mapping with a 'job' section")
+    j = dict(raw["job"])
+    tenant = str(j.pop("tenant", default_tenant))
+    ename = str(j.pop("name", ""))
+    for label, val in (("tenant", tenant), ("name", ename)):
+        if not _NAME_RE.match(val or ""):
+            raise ValueError(
+                f"job.{label} {val!r} must match {_NAME_RE.pattern} "
+                "(it names directories and metric labels)"
+            )
+    seeds = _expand_seeds(ename, j)
+    priority = int(j.pop("priority", 0))
+    base_cfg = j.pop("config", None)
+    base_path = j.pop("base", None)
+    if (base_cfg is None) == (base_path is None):
+        raise ValueError(
+            "spool spec needs exactly one of 'config' (inline scenario) "
+            "or 'base' (an absolute config path)"
+        )
+    if base_path is not None:
+        if not os.path.isabs(base_path):
+            raise ValueError(
+                "job.base must be an absolute path — spool files are "
+                "archived after admission, so a relative path would "
+                "dangle (prefer an inline 'config')"
+            )
+        with open(base_path) as f:
+            base_cfg = yaml.safe_load(f.read())
+    if not isinstance(base_cfg, dict):
+        raise ValueError("spool spec config must be a mapping")
+    overrides = j.pop("overrides", {}) or {}
+    if not isinstance(overrides, dict):
+        raise ValueError("job.overrides must be a mapping")
+    if j:
+        raise ValueError(f"unknown key(s) in job: {sorted(j)}")
+    merged = deep_merge(base_cfg, overrides)
+    if "chaos" in merged:
+        raise ValueError(
+            "chaos is daemon-global (serve --chaos-seed/--chaos-fault); "
+            "a per-job chaos section would be silently ignored"
+        )
+    jobs: "list[SweepJob]" = []
+    for seed in seeds:
+        job_raw = copy.deepcopy(merged)
+        g = job_raw.setdefault("general", {})
+        g["seed"] = seed
+        jname = f"{tenant}.{ename}-s{seed}"
+        g["data_directory"] = os.path.join(spool_dir, "jobs", jname)
+        cfg = ConfigOptions.from_dict(copy.deepcopy(job_raw))
+        if cfg.general.replicas != 1:
+            raise ValueError(
+                f"job {ename!r}: spool jobs are single-world configs; "
+                "the daemon owns replica batching — drop general.replicas"
+            )
+        jobs.append(
+            SweepJob(
+                name=jname,
+                entry=ename,
+                seed=seed,
+                priority=priority,
+                arrival_ns=0,
+                config=cfg,
+                raw_config=job_raw,
+                group_key=config_fingerprint(cfg, exclude_seed=True),
+            )
+        )
+    canonical_text = yaml.safe_dump(
+        {
+            "job": {
+                "tenant": tenant,
+                "name": ename,
+                "seeds": seeds,
+                "priority": priority,
+                "config": base_cfg,
+                **({"overrides": overrides} if overrides else {}),
+            }
+        },
+        sort_keys=False,
+    )
+    return tenant, ename, jobs, canonical_text
+
+
+class DaemonService(SweepService):
+    """The persistent daemon: a SweepService whose queue is fed by the
+    spool, journaled through the WAL, scheduled with per-tenant
+    weighted fair-share, and backed by a disk-persistent compile cache.
+    One instance per `shadow-tpu serve` process; all durable state
+    lives in the spool directory, so a new instance on the same spool
+    IS the restarted daemon."""
+
+    def __init__(
+        self,
+        spool_dir: str,
+        *,
+        capacity: int = 8,
+        retry_max: int = 1,
+        retry_backoff_s: float = 0.0,
+        default_quota: int = 64,
+        quotas: "dict[str, int] | None" = None,
+        weights: "dict[str, float] | None" = None,
+        max_queue: int = 256,
+        poll_interval_s: float = 2.0,
+        prom_interval_s: float = 10.0,
+        keep_batch_dirs: int = 8,
+        drain: bool = False,
+        cache_dir: "str | None" = None,
+        persist_cache: bool = True,
+        metrics_file: "str | None" = None,
+        metrics_max_mb: float = 64.0,
+        metrics_keep: int = 3,
+        metrics_prom: "str | None" = None,
+        default_tenant: str = "default",
+    ):
+        self.spool_dir = os.path.abspath(spool_dir)
+        for sub in ("incoming", "accepted", "rejected", "journal",
+                    "jobs", "batches"):
+            os.makedirs(os.path.join(self.spool_dir, sub), exist_ok=True)
+        spec = SweepSpec(
+            name="daemon",
+            output_dir=self.spool_dir,
+            capacity=capacity,
+            jobs=[],
+            retry_max=retry_max,
+            retry_backoff_s=retry_backoff_s,
+        )
+        cache = None
+        if persist_cache:
+            cache = PersistentCompileCache(
+                cache_dir or os.path.join(self.spool_dir, "cache")
+            )
+        super().__init__(
+            spec, metrics_file=metrics_file, metrics_prom=metrics_prom,
+            cache=cache,
+        )
+        self.journal = Journal(os.path.join(self.spool_dir, "journal"))
+        self.default_quota = int(default_quota)
+        self.quotas = {str(k): int(v) for k, v in (quotas or {}).items()}
+        self.weights = {str(k): float(v) for k, v in (weights or {}).items()}
+        self.max_queue = int(max_queue)
+        self.poll_interval_s = float(poll_interval_s)
+        self.prom_interval_s = float(prom_interval_s)
+        self.keep_batch_dirs = int(keep_batch_dirs)
+        self.drain_mode = bool(drain)
+        self.metrics_max_mb = float(metrics_max_mb)
+        self.metrics_keep = int(metrics_keep)
+        self.default_tenant = default_tenant
+        # durable-state mirrors, rebuilt from the journal on start
+        self._admitted_digests: "dict[str, dict]" = {}
+        self._entries: "set[tuple[str, str]]" = set()
+        self._job_tenant: "dict[str, str]" = {}
+        self._terminal: "dict[str, str]" = {}  # job -> terminal status
+        # incrementally maintained outstanding counts (tenant -> jobs
+        # admitted and not yet terminal): quota checks and the prom
+        # gauge family read these at hot cadence, and a scan of every
+        # job ever admitted would grow with daemon lifetime
+        self._outstanding_t: "dict[str, int]" = {}
+        # jobs this run marked failed during journal replay (a spec
+        # that no longer validates): surfaced in the manifest and the
+        # serve exit code — they are failures of THIS run's replay
+        self.replay_failed = 0
+        self._rejected: "dict[str, int]" = {}  # tenant -> rejections
+        self.tenant_service: "dict[str, float]" = {}  # weighted sim-ns
+        self.resume_report: "dict | None" = None
+        self.pending: "list[Batch]" = []
+        self._stop = False
+        self._prev_signals: dict = {}
+        self._t0 = time.monotonic()
+        self._admit_ord = 0
+        self._batch_ord = 0
+        self._chunk_ticks = 0
+        self._last_poll_wall = float("-inf")
+        self._last_prom_wall = float("-inf")
+        self._manifest_doc: "dict | None" = None
+
+    # --- paths -----------------------------------------------------------
+
+    def _sub(self, name: str) -> str:
+        return os.path.join(self.spool_dir, name)
+
+    def _dir_key(self, tenant: str, entry: str) -> str:
+        return f"{tenant}.{entry}"
+
+    # --- lifecycle -------------------------------------------------------
+
+    def run(self) -> dict:
+        """Serve: replay the journal (crash recovery), then drain the
+        spool — forever in daemon mode (SIGTERM/SIGINT drain to a
+        checkpoint and exit cleanly), or until idle with --drain.
+        Returns (and writes) daemon-manifest.json."""
+        from shadow_tpu.runtime.flightrec import FlightRecorder
+
+        t0 = time.perf_counter()
+        self.recorder = FlightRecorder(
+            blackbox_path=os.path.join(self.spool_dir, "flight-recorder.json"),
+            metrics_path=self.metrics_file,
+            metrics_max_bytes=int(self.metrics_max_mb * 1_000_000),
+            metrics_keep=self.metrics_keep,
+            prom_path=self.metrics_prom,
+        )
+        self._install_signals()
+        clean = False
+        try:
+            self._replay()
+            self._drain(self.pending)
+            clean = True
+        finally:
+            self._restore_signals()
+            try:
+                if clean:
+                    # a SIGKILL skips this record, which is exactly how
+                    # the next start detects the crash
+                    self.journal.append(
+                        "shutdown", clean=True, stopped=self._stop,
+                        pending_jobs=self._outstanding(),
+                    )
+                # close() first — its plain write_prom must not clobber
+                # the daemon gauge snapshot written after it
+                self.recorder.close()
+                self._write_prom(self.pending)
+            finally:
+                self._manifest_doc = self._daemon_manifest(
+                    time.perf_counter() - t0
+                )
+                self._write_manifest()
+        return self._manifest_doc
+
+    def _install_signals(self) -> None:
+        if threading.current_thread() is not threading.main_thread():
+            return
+
+        def handle(signum, frame):
+            self._stop = True
+            slog("info", 0, "daemon",
+                 "shutdown requested: the running batch checkpoints at "
+                 "its next chunk boundary, then the daemon exits cleanly "
+                 "(restart resumes bit-exact)")
+            self._restore_signals()  # a second signal kills the old way
+
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            self._prev_signals[sig] = signal.signal(sig, handle)
+
+    def _restore_signals(self) -> None:
+        for sig, prev in list(self._prev_signals.items()):
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError):
+                pass
+        self._prev_signals.clear()
+
+    # --- journal replay (crash recovery) ---------------------------------
+
+    def _replay(self) -> None:
+        records = self.journal.replay()
+        crashed = bool(records) and records[-1].get("type") != "shutdown"
+        admits: "list[dict]" = []
+        for rec in records:
+            t = rec.get("type")
+            if t == "admit":
+                admits.append(rec)
+            elif t in ("job-done", "job-failed", "job-quarantined"):
+                self._terminal[rec.get("job")] = t[len("job-"):]
+            elif t == "reject":
+                tn = rec.get("tenant") or "?"
+                self._rejected[tn] = self._rejected.get(tn, 0) + 1
+        admits.extend(self._recover_lost_admits(admits))
+        resumed: "list[dict]" = []
+        for rec in admits:
+            resumed.extend(self._replay_admit(rec))
+        if records or resumed:
+            self.resume_report = {
+                "crashed": crashed,
+                "journal_records": len(records),
+                "corrupt_skipped": self.journal.corrupt_skipped,
+                "pending_jobs": self._outstanding(),
+                "batches": resumed,
+            }
+            self.journal.append("resume", **self.resume_report)
+            if crashed:
+                slog("warning", 0, "daemon",
+                     f"previous daemon did not shut down cleanly; "
+                     f"{self._outstanding()} admitted job(s) re-queued "
+                     f"({sum(1 for b in resumed if b['checkpoint'])} "
+                     "batch(es) resume from checkpoints)")
+
+    def _recover_lost_admits(self, admits: "list[dict]") -> "list[dict]":
+        """The spool-corrupt recovery path: any spec archived in
+        accepted/ whose digest has no valid admit record lost that
+        record to corruption — re-journal it from the archived file
+        (the journal and the archive are two independent copies of
+        every admission; losing one must lose nothing)."""
+        known = {r.get("spec_sha256") for r in admits}
+        recovered = []
+        for fname in sorted(os.listdir(self._sub("accepted"))):
+            path = os.path.join(self._sub("accepted"), fname)
+            try:
+                with open(path) as f:
+                    text = f.read()
+            except OSError:
+                continue
+            digest = hashlib.sha256(text.encode()).hexdigest()
+            if digest in known:
+                continue
+            try:
+                tenant, entry, jobs, _canon = parse_spool_spec(
+                    text, self.spool_dir, self.default_tenant
+                )
+            except (ValueError, yaml.YAMLError) as e:
+                slog("warning", 0, "daemon",
+                     f"accepted spec {fname} has no journal record and "
+                     f"does not parse ({e}); skipping")
+                continue
+            slog("warning", 0, "daemon",
+                 f"re-journaling admission of {fname} (its journal "
+                 "record was lost to corruption)")
+            # archived specs are already canonical (hermetic): embed
+            # the file text itself, whose digest is `digest`
+            rec = self.journal.append(
+                "admit", recovered=True, tenant=tenant, entry=entry,
+                jobs=[j.name for j in jobs], seeds=[j.seed for j in jobs],
+                priority=jobs[0].priority, spec_sha256=digest,
+                spec_file=fname, spec=text,
+            )
+            known.add(digest)
+            recovered.append(rec)
+        return recovered
+
+    def _replay_admit(self, rec: dict) -> "list[dict]":
+        """Re-expand one journaled admission; queue its non-terminal
+        jobs, resuming each re-packed batch from its newest valid
+        checkpoint when one exists for the exact batch config. Returns
+        the per-batch resume entries for the `resume` journal record."""
+        tenant = rec.get("tenant") or self.default_tenant
+        entry = rec.get("entry") or "?"
+        try:
+            tenant, entry, jobs, _canon = parse_spool_spec(
+                rec["spec"], self.spool_dir, self.default_tenant
+            )
+            self.validate_jobs(jobs)
+        except (KeyError, ValueError, yaml.YAMLError) as e:
+            # the spec was valid when admitted; it no longer is (config
+            # drift across versions). The jobs must not vanish silently:
+            # each gets a terminal, journaled `failed` record, counted
+            # into replay_failed so the manifest and the serve exit
+            # code report them as THIS run's failures.
+            for jn in rec.get("jobs", []):
+                self._job_tenant.setdefault(jn, tenant)
+                if jn not in self._terminal:
+                    self._mark_terminal(jn, "failed")
+                    self.replay_failed += 1
+                    self.journal.append(
+                        "job-failed", job=jn, failure="config",
+                        error=str(e)[:300],
+                    )
+            slog("warning", 0, "daemon",
+                 f"journaled admission {entry!r} no longer validates "
+                 f"({e}); its unfinished jobs are recorded failed")
+            return []
+        self._register_admit(tenant, entry, rec, jobs)
+        left = [j for j in jobs if j.name not in self._terminal]
+        if not left:
+            return []
+        for j in left:
+            j.arrival_ns = self.clock_ns
+        batches = self.enqueue(
+            left, tenant=tenant, dir_key=self._dir_key(tenant, entry)
+        )
+        self.pending.extend(batches)
+        out = []
+        for b in batches:
+            from shadow_tpu.runtime.checkpoint import (
+                CheckpointManager,
+                peek_checkpoint_meta,
+            )
+
+            ckpt_dir = os.path.join(self._batch_dir(b), "ckpts")
+            path = CheckpointManager.latest_path(ckpt_dir)
+            if path is not None:
+                # only resume the exact batch config the checkpoint was
+                # written for — anything else restarts from scratch
+                try:
+                    meta = peek_checkpoint_meta(path)
+                    want = config_fingerprint(self._batch_config(b))
+                    if meta.get("fingerprint") != want:
+                        path = None
+                except Exception:  # noqa: BLE001 — unusable = scratch
+                    path = None
+            b.resume_ckpt = path
+            out.append({
+                "key": b.dir_key,
+                "jobs": [j.name for j in b.jobs],
+                "checkpoint": path,
+            })
+        return out
+
+    def _register_admit(self, tenant, entry, rec, jobs) -> None:
+        # both digests dedupe: spec_sha256 is the canonical (hermetic)
+        # text the journal/archive hold; source_sha256 the original
+        # incoming file, so re-dropping either form is idempotent
+        self._admitted_digests[rec["spec_sha256"]] = rec
+        if rec.get("source_sha256"):
+            self._admitted_digests[rec["source_sha256"]] = rec
+        self._entries.add((tenant, entry))
+        self._outstanding_t.setdefault(tenant, 0)
+        for j in jobs:
+            if j.name not in self._job_tenant:
+                self._job_tenant[j.name] = tenant
+                if j.name not in self._terminal:
+                    self._outstanding_t[tenant] += 1
+
+    def _mark_terminal(self, name: str, status: str) -> bool:
+        """Record a terminal status, decrementing the owner tenant's
+        outstanding counter exactly once. Returns False when the job
+        was already terminal."""
+        if name in self._terminal:
+            self._terminal[name] = status
+            return False
+        self._terminal[name] = status
+        t = self._job_tenant.get(name)
+        if t is not None and self._outstanding_t.get(t, 0) > 0:
+            self._outstanding_t[t] -= 1
+        return True
+
+    # --- admission (the spool scan) --------------------------------------
+
+    def _outstanding(self, tenant: "str | None" = None) -> int:
+        if tenant is not None:
+            return self._outstanding_t.get(tenant, 0)
+        return sum(self._outstanding_t.values())
+
+    def _scan_spool(self, pending: "list[Batch]") -> None:
+        inc = self._sub("incoming")
+        try:
+            names = sorted(os.listdir(inc))
+        except OSError:
+            return
+        for name in names:
+            if not name.endswith((".yaml", ".yml")) or name.startswith("."):
+                continue  # tmp files mid-rename, editor droppings
+            self._admit_file(os.path.join(inc, name), pending)
+
+    def _admit_file(self, path: str, pending: "list[Batch]") -> None:
+        from shadow_tpu.runtime import chaos
+
+        name = os.path.basename(path)
+        try:
+            with open(path) as f:
+                text = f.read()
+        except OSError:
+            return  # racing the producer's rename; next scan gets it
+        digest = hashlib.sha256(text.encode()).hexdigest()
+        if digest in self._admitted_digests:
+            # already journaled: a crash between journal and archive, or
+            # the same spec dropped twice — admission is idempotent (the
+            # archive copy is restored from the record's canonical text)
+            rec = self._admitted_digests[digest]
+            self._archive(path, rec["spec_sha256"], rec.get("spec"))
+            return
+        try:
+            tenant, entry, jobs, canon = parse_spool_spec(
+                text, self.spool_dir, self.default_tenant
+            )
+        except (ValueError, yaml.YAMLError) as e:
+            self._reject(path, name, digest, None, "parse", str(e))
+            return
+        canon_digest = hashlib.sha256(canon.encode()).hexdigest()
+        if (tenant, entry) in self._entries:
+            self._reject(
+                path, name, digest, tenant, "duplicate",
+                f"entry {entry!r} is already admitted for tenant "
+                f"{tenant!r} (submit under a new name)",
+            )
+            return
+        quota = self.quotas.get(tenant, self.default_quota)
+        held = self._outstanding(tenant)
+        if held + len(jobs) > quota:
+            self._reject(
+                path, name, digest, tenant, "quota",
+                f"tenant {tenant!r} holds {held} outstanding job(s); "
+                f"admitting {len(jobs)} more would exceed its quota "
+                f"of {quota}",
+            )
+            return
+        total = self._outstanding()
+        if total + len(jobs) > self.max_queue:
+            self._reject(
+                path, name, digest, tenant, "backpressure",
+                f"queue holds {total} outstanding job(s); admitting "
+                f"{len(jobs)} more would exceed the bound of "
+                f"{self.max_queue} — resubmit when the queue drains",
+            )
+            return
+        try:
+            self.validate_jobs(jobs)
+        except ValueError as e:
+            self._reject(path, name, digest, tenant, "config", str(e))
+            return
+        # ---- admission commits: journal (the WAL) -> archive -> queue.
+        # A crash after the journal write loses nothing: replay re-queues
+        # from the record, and the idempotent-digest path re-archives a
+        # file left in incoming/.
+        # the journal embeds the CANONICAL spec (base: inlined, seeds
+        # expanded), so a replay can never be changed by later edits to
+        # an external base file — the admitted world is pinned here
+        rec = self.journal.append(
+            "admit", tenant=tenant, entry=entry,
+            jobs=[j.name for j in jobs], seeds=[j.seed for j in jobs],
+            priority=jobs[0].priority, spec_sha256=canon_digest,
+            source_sha256=digest, spec_file=name, spec=canon,
+        )
+        self._register_admit(tenant, entry, rec, jobs)
+        if chaos.fire("daemon-kill", at=self._admit_ord,
+                      tags=("admit",)) is not None:
+            self._kill_self(f"admission {self._admit_ord}")
+        self._admit_ord += 1
+        self._archive(path, canon_digest, canon)
+        for j in jobs:
+            j.arrival_ns = self.clock_ns
+        batches = self.enqueue(
+            jobs, tenant=tenant, dir_key=self._dir_key(tenant, entry)
+        )
+        pending.extend(batches)
+        slog("info", self.clock_ns, "daemon",
+             f"admitted {name}: tenant {tenant}, entry {entry}, "
+             f"{len(jobs)} job(s) in {len(batches)} batch(es) "
+             f"(priority {jobs[0].priority})")
+        rec2 = getattr(self, "recorder", None)
+        if rec2 is not None:
+            rec2.event("admit", tenant=tenant, entry=entry,
+                       jobs=len(jobs), file=name)
+
+    def _archive(self, path: str, digest: str,
+                 text: "str | None" = None) -> None:
+        """Archive an admitted spec under its canonical digest. `text`
+        (the canonical form) is written when it differs from the
+        incoming file; the original is removed either way."""
+        dest = os.path.join(
+            self._sub("accepted"), f"{digest[:12]}-{os.path.basename(path)}"
+        )
+        try:
+            if text is None:
+                os.replace(path, dest)
+                return
+            tmp = f"{dest}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(text)
+            os.replace(tmp, dest)
+            os.remove(path)
+        except OSError:
+            pass
+
+    def _reject(self, path, name, digest, tenant, reason, detail) -> None:
+        """Bounded-queue / quota / bad-spec refusal: a structured,
+        journaled record plus a reply file next to the moved spec — the
+        submitter can read WHY without grepping daemon logs."""
+        rec = self.journal.append(
+            "reject", file=name, tenant=tenant, reason=reason,
+            detail=str(detail)[:400], spec_sha256=digest,
+        )
+        tn = tenant or "?"
+        self._rejected[tn] = self._rejected.get(tn, 0) + 1
+        dest = os.path.join(self._sub("rejected"), f"{digest[:12]}-{name}")
+        try:
+            os.replace(path, dest)
+            with open(f"{dest}.reason.json", "w") as f:
+                json.dump(rec, f, indent=2)
+        except OSError:
+            pass
+        slog("warning", self.clock_ns, "daemon",
+             f"rejected {name} ({reason}): {detail}")
+        rec2 = getattr(self, "recorder", None)
+        if rec2 is not None:
+            rec2.event("reject", tenant=tenant, reason=reason, file=name)
+
+    def _kill_self(self, site: str) -> None:
+        slog("warning", 0, "chaos",
+             f"injecting fault: daemon-kill at {site} — SIGKILL now")
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    # --- scheduling seams (SweepService overrides) -----------------------
+
+    def _poll(self, pending: "list[Batch]") -> None:
+        self._scan_spool(pending)
+
+    def _idle(self, pending: "list[Batch]") -> bool:
+        if self.drain_mode or self._stop:
+            return False
+        now = time.monotonic()
+        if now - self._last_prom_wall >= self.prom_interval_s:
+            self._last_prom_wall = now
+            self._write_prom(pending)
+        time.sleep(self.poll_interval_s)
+        return not self._stop
+
+    def _stopping(self) -> bool:
+        return self._stop
+
+    def _select(self, ready: "list[Batch]") -> Batch:
+        """Strict priority first; weighted fair-share within the
+        priority level — the tenant with the least weighted sim-time
+        served runs next (deficit round-robin over the virtual clock),
+        so a flood from one tenant cannot starve another's jobs of
+        equal priority, and can never delay higher-priority work."""
+        top = max(b.priority for b in ready)
+        cands = [b for b in ready if b.priority == top]
+        return min(
+            cands,
+            key=lambda b: (
+                self.tenant_service.get(b.tenant or "", 0.0),
+                b.arrival_ns,
+                b.index,
+            ),
+        )
+
+    def _account(self, batch: Batch, delta_ns: int) -> None:
+        if batch.tenant and delta_ns > 0:
+            w = max(self.weights.get(batch.tenant, 1.0), 1e-9)
+            self.tenant_service[batch.tenant] = (
+                self.tenant_service.get(batch.tenant, 0.0) + delta_ns / w
+            )
+
+    def _ckpt_interval_ns(self, cfgo: ConfigOptions) -> int:
+        # periodic checkpoints bound the work a SIGKILL can cost a
+        # running batch (the config's cadence; preemption/shutdown still
+        # write verified finals through the same manager)
+        return cfgo.general.checkpoint_interval_ns
+
+    def _on_batch_start(self, batch: Batch, depth: int) -> None:
+        from shadow_tpu.runtime import chaos
+
+        self.journal.append(
+            "batch-start", key=batch.dir_key or f"b{batch.index:03d}",
+            jobs=[j.name for j in batch.jobs], tenant=batch.tenant,
+            resume=batch.resume_ckpt, queue_depth=depth,
+        )
+        if chaos.fire("daemon-kill", at=self._batch_ord,
+                      tags=("batch-start",)) is not None:
+            self._kill_self(f"batch-start {self._batch_ord}")
+        self._batch_ord += 1
+
+    def _on_chunk_tick(self, batch: Batch, pending: "list[Batch]") -> None:
+        from shadow_tpu.runtime import chaos
+
+        if chaos.fire("daemon-kill", at=self._chunk_ticks,
+                      tags=("chunk",)) is not None:
+            self._kill_self(f"chunk {self._chunk_ticks}")
+        self._chunk_ticks += 1
+        now = time.monotonic()
+        if now - self._last_poll_wall >= self.poll_interval_s:
+            self._last_poll_wall = now
+            # live arrivals mid-batch: a higher-priority admission here
+            # arms the preemption guard at the next chunk boundary
+            self._scan_spool(pending)
+        if now - self._last_prom_wall >= self.prom_interval_s:
+            # the satellite fix: gauges advance on a WALL cadence while
+            # a batch runs, not only between scheduling decisions
+            self._last_prom_wall = now
+            self._write_prom(pending)
+
+    def _on_job_terminal(self, name: str, record: dict) -> None:
+        status = record.get("status")
+        self._mark_terminal(name, status)
+        entry = {
+            "job": name,
+            "tenant": self._job_tenant.get(name),
+            "batch": record.get("batch"),
+        }
+        if record.get("failure"):
+            entry["failure"] = record["failure"]
+        if record.get("stats"):
+            entry["events"] = record["stats"].get("events_handled")
+        self.journal.append(_TERMINAL_TYPES.get(status, "job-done"), **entry)
+        self._maybe_prune(record)
+
+    def _maybe_prune(self, record: dict) -> None:
+        """Checkpoint-dir retention: a finished batch's checkpoints are
+        dead weight — drop them the moment its last job lands, and
+        prune leftover (crashed/preempted) batch dirs beyond the newest
+        `keep_batch_dirs`, never touching a pending batch's."""
+        import shutil
+
+        idx = record.get("batch")
+        if isinstance(idx, int) and 0 <= idx < len(self.batches):
+            batch = self.batches[idx]
+            if all(j.name in self._terminal for j in batch.jobs):
+                shutil.rmtree(
+                    os.path.join(self._batch_dir(batch), "ckpts"),
+                    ignore_errors=True,
+                )
+        from shadow_tpu.runtime.checkpoint import CheckpointManager
+
+        protect = {self._batch_dir(b) for b in self.pending}
+        CheckpointManager.prune_batch_dirs(
+            self._sub("batches"), self.keep_batch_dirs, protect=protect
+        )
+
+    # --- telemetry -------------------------------------------------------
+
+    def _prom_gauges(self, pending: "list[Batch]") -> dict:
+        g = super()._prom_gauges(pending)
+        g["shadow_tpu_daemon_uptime_seconds"] = round(
+            time.monotonic() - self._t0, 3
+        )
+        g["shadow_tpu_daemon_jobs_admitted_total"] = len(self._job_tenant)
+        g["shadow_tpu_daemon_jobs_rejected_total"] = sum(
+            self._rejected.values()
+        )
+        g["shadow_tpu_daemon_journal_records_total"] = self.journal.count
+        for t in sorted(self._outstanding_t):
+            g[f'shadow_tpu_tenant_queue_depth{{tenant="{t}"}}'] = (
+                self._outstanding(t)
+            )
+        stats = self.cache.stats()
+        if "persistent" in stats:
+            p = stats["persistent"]
+            g["shadow_tpu_compile_cache_disk_hits_total"] = p["disk_hits"]
+            g["shadow_tpu_compile_cache_disk_stores_total"] = p["disk_stores"]
+        return g
+
+    def _write_prom(self, pending: "list[Batch]") -> None:
+        super()._write_prom(pending)
+        # the manifest doubles as the daemon's rolling status document:
+        # refreshed at prom cadence so a SIGKILL leaves a recent one
+        self._manifest_doc = None
+        self._write_manifest(rolling=True)
+
+    def _tenant_table(self) -> dict:
+        out: "dict[str, dict]" = {}
+        for t in sorted(
+            set(self._job_tenant.values())
+            | set(self._rejected)
+            | set(self.quotas)
+        ):
+            jobs = [n for n, jt in self._job_tenant.items() if jt == t]
+            out[t] = {
+                "admitted": len(jobs),
+                "outstanding": self._outstanding(t),
+                "done": sum(
+                    1 for n in jobs if self._terminal.get(n) == "done"
+                ),
+                "failed": sum(
+                    1 for n in jobs if self._terminal.get(n) == "failed"
+                ),
+                "quarantined": sum(
+                    1 for n in jobs if self._terminal.get(n) == "quarantined"
+                ),
+                "rejected_specs": self._rejected.get(t, 0),
+                "quota": self.quotas.get(t, self.default_quota),
+                "weight": self.weights.get(t, 1.0),
+                "service_sim_s": round(
+                    self.tenant_service.get(t, 0.0) / 1e9, 4
+                ),
+            }
+        return out
+
+    def _daemon_manifest(self, wall: float) -> dict:
+        m = self._manifest(wall)
+        done_this_run = m["jobs_done"]
+        m["daemon"] = {
+            "spool": self.spool_dir,
+            "drain": self.drain_mode,
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+            "jobs_per_hour": (
+                round(done_this_run / wall * 3600, 1) if wall > 0 else None
+            ),
+            "outstanding_jobs": self._outstanding(),
+            "jobs_admitted_total": len(self._job_tenant),
+            "jobs_done_total": sum(
+                1 for s in self._terminal.values() if s == "done"
+            ),
+            "journal": {
+                "records": self.journal.count,
+                "corrupt_skipped": self.journal.corrupt_skipped,
+            },
+            # jobs failed during THIS run's journal replay (spec no
+            # longer validates): zero-lost accounting demands they count
+            # against the run's exit code, even though they never
+            # entered the live queue
+            "replay_failed_jobs": self.replay_failed,
+            "tenants": self._tenant_table(),
+            **({"resume": self.resume_report} if self.resume_report else {}),
+        }
+        return m
+
+    def _write_manifest(self, rolling: bool = False) -> None:
+        path = os.path.join(self.spool_dir, "daemon-manifest.json")
+        try:
+            doc = self._manifest_doc
+            if doc is None:
+                doc = self._daemon_manifest(
+                    max(time.monotonic() - self._t0, 1e-9)
+                )
+                if rolling:
+                    doc["daemon"]["rolling"] = True
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=2, default=str)
+            os.replace(tmp, path)
+        except (OSError, TypeError, ValueError):
+            pass  # status writing must never take the daemon down
+
+
+def submit_spec(spool_dir: str, spec_path: str,
+                tenant: "str | None" = None) -> str:
+    """`shadow-tpu submit`: atomically drop a job spec into a spool's
+    incoming/ directory (write to a dotted tmp name the scanner
+    ignores, then rename — the daemon can never read a torn file).
+    `tenant` overrides/sets job.tenant. Returns the spooled path."""
+    with open(spec_path) as f:
+        raw = yaml.safe_load(f.read())
+    if not isinstance(raw, dict) or "job" not in raw:
+        raise ValueError("spec must be a mapping with a 'job' section")
+    if tenant is not None:
+        raw = dict(raw)
+        raw["job"] = dict(raw["job"])
+        raw["job"]["tenant"] = tenant
+    inc = os.path.join(spool_dir, "incoming")
+    os.makedirs(inc, exist_ok=True)
+    name = os.path.basename(spec_path)
+    if not name.endswith((".yaml", ".yml")):
+        name += ".yaml"
+    # zero-padded nanosecond prefix: the scanner admits in sorted-name
+    # order, so submission order is admission order (and two rapid
+    # submissions of the same filename can never collide)
+    dest = os.path.join(inc, f"{time.time_ns():020d}-{name}")
+    tmp = os.path.join(inc, f".{os.path.basename(dest)}.tmp.{os.getpid()}")
+    with open(tmp, "w") as f:
+        yaml.safe_dump(raw, f, sort_keys=False)
+    os.replace(tmp, dest)
+    return dest

@@ -126,6 +126,8 @@ class FlightRecorder:
         num_shards: int = 1,
         ring: int = DEFAULT_RING,
         metrics_path: "str | None" = None,
+        metrics_max_bytes: int = 0,
+        metrics_keep: int = 3,
         prom_path: "str | None" = None,
         blackbox_path: "str | None" = None,
         heartbeat_ns: int = 0,
@@ -137,6 +139,13 @@ class FlightRecorder:
         self.num_hosts = int(num_hosts)
         self.num_shards = max(1, int(num_shards))
         self.metrics_path = metrics_path
+        # rolling retention (general.metrics_max_mb / metrics_keep): the
+        # JSONL stream rotates at the byte cap, keeping `metrics_keep`
+        # numbered segments — a week-long daemon cannot fill the disk
+        self.metrics_max_bytes = int(metrics_max_bytes or 0)
+        self.metrics_keep = max(1, int(metrics_keep))
+        self.rotations = 0
+        self._stream_bytes = 0
         self.prom_path = prom_path
         self.blackbox_path = blackbox_path
         self.heartbeat_ns = int(heartbeat_ns or 0)
@@ -258,7 +267,14 @@ class FlightRecorder:
         if self._stream is None:
             return
         try:
-            self._stream.write(json.dumps(obj, default=str) + "\n")
+            line = json.dumps(obj, default=str) + "\n"
+            self._stream.write(line)
+            self._stream_bytes += len(line)
+            if (
+                self.metrics_max_bytes > 0
+                and self._stream_bytes >= self.metrics_max_bytes
+            ):
+                self._rotate_stream()
             # flushed at heartbeat cadence so the file can be tailed live
             # without paying an fsync-ish flush on every chunk of a tight
             # dispatch loop; no cadence configured = flush every line
@@ -270,6 +286,26 @@ class FlightRecorder:
                 self._next_flush_ns = (now_ns // hb + 1) * hb
         except (OSError, ValueError):
             self._stream = None  # a broken stream must never kill the run
+
+    def _rotate_stream(self) -> None:
+        """logrotate-style shift: file -> file.1 -> ... -> file.N, N =
+        metrics_keep, oldest dropped. The live path always holds the
+        newest samples, so `shadow-tpu metrics --follow` keeps working
+        across a rotation (it re-reads the whole live file)."""
+        p = self.metrics_path
+        self._stream.flush()
+        self._stream.close()
+        self._stream = None
+        for i in range(self.metrics_keep - 1, 0, -1):
+            src = f"{p}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{p}.{i + 1}")
+        os.replace(p, f"{p}.1")
+        self._stream = open(p, "w")
+        self._stream_bytes = 0
+        self.rotations += 1
+        self.event("metrics_rotate", segment=self.rotations,
+                   keep=self.metrics_keep)
 
     def _maybe_prom(self, now_ns: int) -> None:
         """Prometheus snapshot cadence — independent of the JSONL stream,
@@ -359,9 +395,16 @@ class FlightRecorder:
             )
         if extra_gauges:
             gauges.update(extra_gauges)
+        # a gauge key may carry prometheus labels (e.g.
+        # shadow_tpu_tenant_queue_depth{tenant="alice"}); the TYPE line
+        # names the bare family, emitted once per family
         lines = []
+        typed = set()
         for name in sorted(gauges):
-            lines.append(f"# TYPE {name} gauge")
+            family = name.split("{", 1)[0]
+            if family not in typed:
+                typed.add(family)
+                lines.append(f"# TYPE {family} gauge")
             lines.append(f"{name} {gauges[name]}")
         try:
             os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
@@ -642,3 +685,44 @@ def render_summary(samples: "list[dict]", events: "list[dict]",
 def render_summary_file(path: str) -> str:
     samples, events, meta = load_series(path)
     return render_summary(samples, events, meta)
+
+
+def follow_file(path: str, interval_s: float = 2.0,
+                max_updates: "int | None" = None, out=None) -> int:
+    """`shadow-tpu metrics --follow`: tail a live metrics stream,
+    re-rendering the summary whenever the file grows (or appears) — an
+    operator watches a running daemon without restarting the renderer.
+    The whole file is re-read per update; rolling retention
+    (general.metrics_max_mb) bounds its size, and a shrink (rotation)
+    re-renders too. `max_updates` bounds the loop (tests; the CLI's
+    default None follows until Ctrl-C). Returns updates rendered."""
+    import sys
+
+    out = out or sys.stdout
+    clear = "\x1b[2J\x1b[H" if getattr(out, "isatty", lambda: False)() else ""
+    last_size = None
+    updates = 0
+    while max_updates is None or updates < max_updates:
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = -1  # not written yet (daemon still starting)
+        if size != last_size:
+            last_size = size
+            if size >= 0:
+                try:
+                    text = render_summary_file(path)
+                except (OSError, ValueError) as e:
+                    text = f"(waiting for a readable series: {e})"
+            else:
+                text = f"(waiting for {path} to appear)"
+            out.write(f"{clear}{text}\n")
+            try:
+                out.flush()
+            except OSError:
+                pass
+            updates += 1
+            if max_updates is not None and updates >= max_updates:
+                break
+        time.sleep(interval_s)
+    return updates

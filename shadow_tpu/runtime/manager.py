@@ -791,6 +791,8 @@ class Manager:
             num_hosts=len(self.hosts),
             num_shards=num_shards,
             metrics_path=g.metrics_file,
+            metrics_max_bytes=int(g.metrics_max_mb * 1_000_000),
+            metrics_keep=g.metrics_keep,
             prom_path=g.metrics_prom,
             blackbox_path=blackbox,
             heartbeat_ns=g.heartbeat_interval_ns,

@@ -74,6 +74,12 @@ class Batch:
     arrival_ns: int
     group_key: str
     index: int = -1
+    # daemon-mode fields (runtime/daemon.py): the owning tenant (fair-
+    # share accounting + tenant gauges) and a restart-stable checkpoint
+    # directory key — identical pending jobs re-pack into a batch with
+    # the same dir_key after a crash, so its checkpoints are findable
+    tenant: "str | None" = None
+    dir_key: "str | None" = None
     # mutable execution record
     preemptions: int = 0
     resume_ckpt: "str | None" = None
@@ -98,6 +104,7 @@ class Batch:
             "seed_stride": self.stride,
             "priority": self.priority,
             "arrival_ns": self.arrival_ns,
+            **({"tenant": self.tenant} if self.tenant else {}),
         }
 
 
@@ -197,9 +204,11 @@ class SweepService:
     One instance per sweep; the compile cache lives for its lifetime."""
 
     def __init__(self, spec: SweepSpec, metrics_file: "str | None" = None,
-                 metrics_prom: "str | None" = None):
+                 metrics_prom: "str | None" = None, cache=None):
         self.spec = spec
-        self.cache = CompileCache()
+        # injectable cache: the daemon passes a PersistentCompileCache
+        # so executables survive restarts (runtime/compile_cache.py)
+        self.cache = cache if cache is not None else CompileCache()
         self.batches = pack_jobs(spec.jobs, spec.capacity)
         self.clock_ns = 0  # virtual clock: cumulative sim-time executed
         self.job_progress: "dict[str, dict]" = {
@@ -230,7 +239,16 @@ class SweepService:
         # output writing reuses it instead of re-expanding the world N
         # times (the hosts/graph/IP expansion is seed-independent).
         self._group_mgr: "dict[str, Manager]" = {}
-        for j in spec.jobs:
+        self.validate_jobs(spec.jobs)
+
+    def validate_jobs(self, jobs: "list[SweepJob]") -> None:
+        """World-validate every distinct fingerprint group among `jobs`
+        (one Manager build per group), caching the Managers for the
+        per-job output writes. Raises ValueError on the first bad world
+        — BEFORE any of its jobs is queued or any compile is burned.
+        Also the daemon's admission validator (runtime/daemon.py): a
+        refused spool spec becomes a structured rejection record."""
+        for j in jobs:
             if j.group_key in self._group_mgr:
                 continue
             mgr = Manager(j.config)
@@ -247,6 +265,30 @@ class SweepService:
                     "vmapped ensemble plane)"
                 )
             self._group_mgr[j.group_key] = mgr
+
+    def enqueue(self, jobs: "list[SweepJob]", tenant: "str | None" = None,
+                dir_key: "str | None" = None) -> "list[Batch]":
+        """Live admission (the daemon's arrival path): pack `jobs` —
+        already validated via validate_jobs — into fresh batches
+        appended to self.batches, and return them for the caller to add
+        to its pending queue. Jobs from one admission pack only with
+        each other (a tenant's spool file is its own packing universe —
+        cross-tenant worlds never share a device program)."""
+        self.spec.jobs.extend(jobs)
+        for j in jobs:
+            self.job_progress.setdefault(j.name, {"now_ns": 0, "events": 0})
+            self.job_series.setdefault(j.name, [])
+        batches = pack_jobs(jobs, self.spec.capacity)
+        for b in batches:
+            b.index = len(self.batches)
+            b.tenant = tenant
+            if dir_key is not None:
+                b.dir_key = (
+                    f"{dir_key}-g{b.group_key[:8]}-p{b.priority}"
+                    f"-s{b.base_seed}x{b.replicas}k{b.stride}"
+                )
+            self.batches.append(b)
+        return batches
 
     # --- planning --------------------------------------------------------
 
@@ -301,8 +343,10 @@ class SweepService:
             with ctx:
                 self._drain(list(self.batches))
         finally:
-            self._write_prom([])
+            # close() first: its plain write_prom would otherwise clobber
+            # the final service-gauge snapshot
             self.recorder.close()
+            self._write_prom([])
         manifest = self._manifest(time.perf_counter() - t0)
         if plan is not None:
             manifest["chaos"] = plan.report()
@@ -311,15 +355,65 @@ class SweepService:
             json.dump(manifest, f, indent=2)
         return manifest
 
+    # --- scheduling seams (overridden by runtime/daemon.py) --------------
+
+    def _poll(self, pending: "list[Batch]") -> None:
+        """Admission hook, called before every scheduling decision. The
+        one-shot sweep has a pre-declared queue; the daemon scans its
+        spool directory here and appends newly admitted batches."""
+
+    def _idle(self, pending: "list[Batch]") -> bool:
+        """The queue is empty: return True to keep waiting for arrivals
+        (the daemon sleeps a poll interval), False to finish draining."""
+        return False
+
+    def _stopping(self) -> bool:
+        """Checked between batches: True ends the drain loop early (the
+        daemon's graceful SIGTERM shutdown)."""
+        return False
+
+    def _select(self, ready: "list[Batch]") -> Batch:
+        """The scheduling decision among arrived batches. One-shot
+        sweeps run strict priority (ties: arrival, then plan order);
+        the daemon adds weighted tenant fair-share within a priority."""
+        return min(ready, key=lambda b: (-b.priority, b.arrival_ns, b.index))
+
+    def _on_batch_start(self, batch: Batch, depth: int) -> None:
+        """A batch was dispatched (daemon: journal record + kill seam)."""
+
+    def _on_chunk_tick(self, batch: Batch, pending: "list[Batch]") -> None:
+        """Every fetched chunk probe of the running batch (daemon:
+        wall-cadence spool poll + prom rewrite + kill seam)."""
+
+    def _account(self, batch: Batch, delta_ns: int) -> None:
+        """`delta_ns` of sim time just executed for `batch` (daemon:
+        weighted per-tenant fair-share accounting)."""
+
+    def _on_job_terminal(self, name: str, record: dict) -> None:
+        """A job reached a terminal status — done/failed/quarantined —
+        and its record landed in job_records (daemon: journal it)."""
+
+    def _ckpt_interval_ns(self, cfgo: ConfigOptions) -> int:
+        """Periodic checkpoint cadence for a running batch. One-shot
+        sweeps write only preemption-final checkpoints (0); the daemon
+        uses the config's cadence so a SIGKILL mid-batch loses at most
+        one interval of work."""
+        return 0
+
     def _drain(self, pending: "list[Batch]") -> None:
-        while pending:
+        while True:
+            self._poll(pending)
+            if not pending:
+                if not self._idle(pending):
+                    break
+                continue
             ready = [b for b in pending if b.arrival_ns <= self.clock_ns]
             if not ready:
                 # idle queue: fast-forward the virtual clock to the next
                 # arrival (nothing is executing, so no sim time passes)
                 self.clock_ns = min(b.arrival_ns for b in pending)
                 continue
-            batch = min(ready, key=lambda b: (-b.priority, b.arrival_ns, b.index))
+            batch = self._select(ready)
             pending.remove(batch)
             # queue-depth gauge at every scheduling decision (the running
             # batch counts toward the depth); getattr because the
@@ -335,6 +429,7 @@ class SweepService:
                     jobs=[j.name for j in batch.jobs],
                     priority=batch.priority,
                 )
+            self._on_batch_start(batch, depth)
             try:
                 self._run_batch(batch, pending)
             except _Preempted:
@@ -362,6 +457,8 @@ class SweepService:
                 # still abort the sweep.
                 self._handle_failure(batch, e, pending)
             self._write_prom(pending)
+            if self._stopping():
+                break
 
     def _requeue_job(self, job: SweepJob, like: Batch) -> Batch:
         """A fresh single-job batch for a retry/split: same scheduling
@@ -375,6 +472,14 @@ class SweepService:
             arrival_ns=like.arrival_ns,
             group_key=like.group_key,
             index=len(self.batches),
+            tenant=like.tenant,
+            # a retry's checkpoint dir must never alias another batch's
+            # (daemon restarts resume by dir — a stale foreign
+            # checkpoint would be rejected by fingerprint, but the
+            # retry also starts from scratch by contract)
+            dir_key=(
+                f"{like.dir_key}-r{len(self.batches)}" if like.dir_key else None
+            ),
         )
         self.batches.append(nb)
         return nb
@@ -432,6 +537,7 @@ class SweepService:
         self.job_records[job.name] = self._job_record(
             job, batch, status=status, error=str(err), failure=kind,
         )
+        self._on_job_terminal(job.name, self.job_records[job.name])
         if rec is not None:
             # the quarantined/failed job's post-mortem black box: one
             # dump in ITS data directory (the forensics travel with the
@@ -470,7 +576,13 @@ class SweepService:
         return ConfigOptions.from_dict(raw)
 
     def _batch_dir(self, batch: Batch) -> str:
-        return os.path.join(self.spec.output_dir, "batches", f"b{batch.index:03d}")
+        # dir_key (daemon mode) is restart-stable: the same pending jobs
+        # re-pack into the same key after a crash, so the replayed batch
+        # finds its own checkpoints; index naming is the one-shot default
+        return os.path.join(
+            self.spec.output_dir, "batches",
+            batch.dir_key or f"b{batch.index:03d}",
+        )
 
     def _run_batch(self, batch: Batch, pending: "list[Batch]") -> None:
         from shadow_tpu.config.fingerprint import config_fingerprint
@@ -550,9 +662,12 @@ class SweepService:
                  f"batch {batch.index} resuming from {batch.resume_ckpt}")
 
         ckpt_dir = os.path.join(self._batch_dir(batch), "ckpts")
-        # interval 0: no periodic cadence — the only writes are the
-        # verified final checkpoint a preemption commits
-        ckpt = CheckpointManager(ckpt_dir, 0, fingerprint)
+        # one-shot sweeps: interval 0, no periodic cadence — the only
+        # writes are the verified final checkpoint a preemption commits.
+        # Daemon mode uses the config's cadence (crash-loss bound).
+        ckpt = CheckpointManager(
+            ckpt_dir, self._ckpt_interval_ns(cfgo), fingerprint
+        )
         guard = _PreemptGuard()
         recovery = None
         if cfgo.experimental.recover:
@@ -571,8 +686,15 @@ class SweepService:
 
             # the aggregated probe's `now` follows the slowest replica;
             # its delta is the sim time this batch just executed
-            self.clock_ns += max(0, probe.now - last_now[0])
+            delta = max(0, probe.now - last_now[0])
+            self.clock_ns += delta
             last_now[0] = probe.now
+            self._account(batch, delta)
+            self._on_chunk_tick(batch, pending)
+            if self._stopping():
+                # graceful shutdown (daemon SIGTERM): checkpoint at the
+                # next boundary and requeue — restart resumes bit-exact
+                guard.arm()
             if any(
                 b.arrival_ns <= self.clock_ns and b.priority > batch.priority
                 for b in pending
@@ -676,6 +798,11 @@ class SweepService:
                 },
                 wall_seconds=round(wall_per_job, 4),
             )
+            # terminal hook AFTER the job's outputs are on disk: a crash
+            # between the write and the journal record re-runs the job
+            # (idempotent — the rerun rewrites identical outputs), never
+            # loses it
+            self._on_job_terminal(job.name, self.job_records[job.name])
 
     def _write_job(self, job, final_slice, sl_hs, end, wall, recovery_report):
         """Publish one job's data dir exactly as a standalone
@@ -719,6 +846,7 @@ class SweepService:
             "name": job.name,
             "entry": job.entry,
             "seed": job.seed,
+            **({"tenant": batch.tenant} if batch.tenant else {}),
             "priority": job.priority,
             "arrival_ns": job.arrival_ns,
             "group": job.group_key[:12],
@@ -743,6 +871,24 @@ class SweepService:
 
     # --- reporting -------------------------------------------------------
 
+    def _prom_gauges(self, pending: "list[Batch]") -> dict:
+        """The service gauge set (the daemon layers its uptime/tenant
+        family on top — runtime/daemon.py)."""
+        statuses = [r.get("status") for r in self.job_records.values()]
+        return {
+            "shadow_tpu_sweep_queue_depth": len(pending),
+            "shadow_tpu_sweep_clock_ns": self.clock_ns,
+            "shadow_tpu_sweep_jobs_total": len(self.spec.jobs),
+            "shadow_tpu_sweep_jobs_done": statuses.count("done"),
+            "shadow_tpu_sweep_jobs_failed": statuses.count("failed"),
+            "shadow_tpu_sweep_jobs_quarantined": statuses.count(
+                "quarantined"
+            ),
+            "shadow_tpu_sweep_preemptions_total": sum(
+                b.preemptions for b in self.batches
+            ),
+        }
+
     def _write_prom(self, pending: "list[Batch]") -> None:
         """Rewrite the service's Prometheus textfile snapshot (the scrape
         endpoint of a long-lived sweep — docs/service.md): job/queue
@@ -750,22 +896,7 @@ class SweepService:
         rec = getattr(self, "recorder", None)
         if rec is None or not rec.prom_path:
             return
-        statuses = [r.get("status") for r in self.job_records.values()]
-        rec.write_prom(
-            extra_gauges={
-                "shadow_tpu_sweep_queue_depth": len(pending),
-                "shadow_tpu_sweep_clock_ns": self.clock_ns,
-                "shadow_tpu_sweep_jobs_total": len(self.spec.jobs),
-                "shadow_tpu_sweep_jobs_done": statuses.count("done"),
-                "shadow_tpu_sweep_jobs_failed": statuses.count("failed"),
-                "shadow_tpu_sweep_jobs_quarantined": statuses.count(
-                    "quarantined"
-                ),
-                "shadow_tpu_sweep_preemptions_total": sum(
-                    b.preemptions for b in self.batches
-                ),
-            }
-        )
+        rec.write_prom(extra_gauges=self._prom_gauges(pending))
 
     def _telemetry(self) -> dict:
         """The service-level telemetry block of sweep-manifest.json:

@@ -62,6 +62,45 @@ def _probe(**kw) -> ChunkProbe:
 # ---- unit surfaces ------------------------------------------------------
 
 
+def test_metrics_stream_rotates_at_size_cap(tmp_path):
+    """Satellite (ISSUE 11): the JSONL stream rotates at
+    general.metrics_max_mb keeping metrics_keep numbered segments, so a
+    week-long daemon soak cannot fill the disk — and the live path
+    always holds the newest samples."""
+    import os
+
+    mf = tmp_path / "m.jsonl"
+    rec = FlightRecorder(
+        num_hosts=8, metrics_path=str(mf),
+        metrics_max_bytes=2_000, metrics_keep=2,
+    )
+    for i in range(120):
+        rec.observe(_probe(now=(i + 1) * 1000, events_handled=(i + 1) * 10))
+    rec.close()
+    assert rec.rotations >= 2
+    # keep=2: live file + .1 + .2 and nothing older
+    assert mf.exists() and (tmp_path / "m.jsonl.1").exists()
+    assert (tmp_path / "m.jsonl.2").exists()
+    assert not (tmp_path / "m.jsonl.3").exists()
+    # every segment stays under cap + one line of slack
+    for p in (mf, tmp_path / "m.jsonl.1", tmp_path / "m.jsonl.2"):
+        assert os.path.getsize(p) < 2_600
+    # every segment parses; the newest sample lives in the newest
+    # segment that has samples (the live file may hold only the
+    # rotation marker when the cap fired on the final line)
+    def _samples(p):
+        return [
+            json.loads(ln) for ln in p.read_text().splitlines()
+            if json.loads(ln).get("type") == "sample"
+        ]
+
+    live, older = _samples(mf), _samples(tmp_path / "m.jsonl.1")
+    newest = (live or older)[-1]["chunk"]
+    assert newest == 119
+    if live and older:
+        assert older[-1]["chunk"] < live[0]["chunk"]  # segments ordered
+
+
 def test_ring_bound_and_sample_deltas(tmp_path):
     rec = FlightRecorder(num_hosts=8, ring=4,
                          metrics_path=str(tmp_path / "m.jsonl"))

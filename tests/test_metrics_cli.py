@@ -116,3 +116,46 @@ def test_cli_metrics_subcommand_rejects_garbage(tmp_path, capsys):
     assert cli_main(["metrics", str(bad)]) == 1
     assert "error" in capsys.readouterr().err
     assert cli_main(["metrics", str(tmp_path / "missing.jsonl")]) == 1
+
+
+def _stream_line(n, now):
+    return json.dumps({
+        "type": "sample", "chunk": n, "wall_s": n * 0.1, "now_ns": now,
+        "dt_ns": 1000, "events": 5, "events_total": 5 * (n + 1),
+    })
+
+
+def test_cli_metrics_follow_rerenders_on_growth(tmp_path, capsys):
+    """Satellite: `shadow-tpu metrics --follow` re-renders the summary
+    when the stream grows — an operator watches a live daemon without
+    restarting the renderer. Bounded here via --max-updates; the helper
+    also re-renders when the file appears or shrinks (rotation)."""
+    import threading
+    import time
+
+    from shadow_tpu.runtime.flightrec import follow_file
+
+    mf = tmp_path / "live.jsonl"
+    mf.write_text(_stream_line(0, 1000) + "\n")
+
+    # one bounded update through the CLI flag
+    assert cli_main([
+        "metrics", str(mf), "--follow", "--interval", "0.05",
+        "--max-updates", "1",
+    ]) == 0
+    out = capsys.readouterr().out
+    assert "1 samples" in out
+
+    # growth re-renders: a writer appends while follow_file watches
+    def grow():
+        time.sleep(0.15)
+        with open(mf, "a") as f:
+            f.write(_stream_line(1, 2000) + "\n")
+
+    t = threading.Thread(target=grow)
+    t.start()
+    updates = follow_file(str(mf), interval_s=0.05, max_updates=2)
+    t.join()
+    assert updates == 2
+    out = capsys.readouterr().out
+    assert "2 samples" in out  # the re-render saw the appended sample

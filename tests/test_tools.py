@@ -105,6 +105,73 @@ def test_bench_history_trajectory_and_regression(tmp_path):
     assert r.returncode == 0 and "ok:" in r.stdout
 
 
+def test_bench_history_tracks_service_metrics(tmp_path):
+    """ISSUE 11 satellite: detail.service.jobs_per_hour and
+    cache_hit_rate get the same best-prior regression flagging as the
+    headline metric, with a fallback to the older detail.sweep block."""
+
+    def _round(n, value, detail_extra):
+        (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps({
+            "n": n,
+            "parsed": {
+                "metric": "m", "value": value,
+                "detail": {
+                    "config": {"hosts": 128},
+                    "main": {"wall_s": 1.0},
+                    "attempts": [],
+                    **detail_extra,
+                },
+            },
+        }))
+
+    # r1: pre-daemon sweep block (the fallback); r2: daemon service
+    _round(1, 0.10, {"sweep": {
+        "jobs_per_hour": 400.0, "compile_cache": {"hit_rate": 0.5},
+    }})
+    _round(2, 0.12, {"service": {
+        "jobs_per_hour": 800.0, "cache_hit_rate": 0.9,
+    }})
+
+    sys.path.insert(0, str(TOOLS))
+    try:
+        import bench_history as bh
+    finally:
+        sys.path.pop(0)
+
+    rounds = bh.load_rounds(str(tmp_path))
+    assert rounds[0]["service"] == {
+        "jobs_per_hour": 400.0, "cache_hit_rate": 0.5,
+    }
+    assert rounds[1]["service"]["jobs_per_hour"] == 800.0
+    table = bh.trajectory_table(rounds)
+    assert "800.0" in table and "0.90" in table
+
+    # newest recorded round improved on the fallback round -> clean
+    v = bh.service_check(rounds)
+    assert v["regression"] is False
+    assert v["metrics"]["jobs_per_hour"]["best_prior"] == 400.0
+
+    # an in-flight collapse flags both the metric and the aggregate
+    v = bh.service_check(rounds, current={
+        "jobs_per_hour": 300.0, "cache_hit_rate": 0.95,
+    })
+    assert v["regression"] is True
+    assert v["metrics"]["jobs_per_hour"]["regression"] is True
+    assert v["metrics"]["cache_hit_rate"]["regression"] is False
+
+    # the CLI prints the service verdict lines and exits nonzero when
+    # the newest round slid
+    _round(3, 0.13, {"service": {
+        "jobs_per_hour": 100.0, "cache_hit_rate": 0.9,
+    }})
+    r = subprocess.run(
+        [sys.executable, str(TOOLS / "bench_history.py"), str(tmp_path)],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 1
+    assert "service.jobs_per_hour: REGRESSION" in r.stdout
+
+
 def test_shm_cleanup(tmp_path):
     import mmap
     import os

@@ -54,6 +54,77 @@ def _attempt_row(att: dict) -> dict:
     return row
 
 
+def _service_row(detail: dict) -> "dict | None":
+    """The service-plane SLO pair a round published: detail.service
+    (the daemon trial, ISSUE 11) with a fallback to the older
+    detail.sweep block, so the trajectory reaches back before the
+    daemon landed. None when the round measured neither."""
+    svc = detail.get("service") or {}
+    row = {
+        "jobs_per_hour": svc.get("jobs_per_hour"),
+        "cache_hit_rate": svc.get("cache_hit_rate"),
+    }
+    if row["jobs_per_hour"] is None:
+        sweep = detail.get("sweep") or {}
+        row["jobs_per_hour"] = sweep.get("jobs_per_hour")
+        row["cache_hit_rate"] = (sweep.get("compile_cache") or {}).get(
+            "hit_rate"
+        )
+    if row["jobs_per_hour"] is None and row["cache_hit_rate"] is None:
+        return None
+    return row
+
+
+def service_check(rounds: "list[dict]",
+                  current: "dict | None" = None) -> dict:
+    """The detail.service trajectory verdicts — jobs_per_hour and
+    cache_hit_rate each get the SAME best-prior/TOLERANCE flagging the
+    headline metric gets (regression_check). `current` is an in-flight
+    {jobs_per_hour, cache_hit_rate} from bench.py; None compares the
+    newest recorded round against the rest."""
+    history = [r for r in rounds if r.get("service")]
+    latest_round = None
+    if current is None and history:
+        last = history[-1]
+        current, latest_round = last["service"], last["round"]
+        history = history[:-1]
+    out = {"latest_round": latest_round, "metrics": {}, "regression": False}
+    for metric in ("jobs_per_hour", "cache_hit_rate"):
+        cur = (current or {}).get(metric)
+        prior = [
+            r for r in history if r["service"].get(metric) is not None
+        ]
+        best = (
+            max(prior, key=lambda r: r["service"][metric]) if prior else None
+        )
+        v = {
+            "latest": cur,
+            "best_prior": best["service"][metric] if best else None,
+            "best_prior_round": best["round"] if best else None,
+        }
+        if best is None:
+            v["regression"] = False
+            v["note"] = "no prior round measured this"
+        elif cur is None:
+            v["regression"] = True
+            v["note"] = (
+                f"latest is null vs best {v['best_prior']} "
+                f"(r{v['best_prior_round']})"
+            )
+        else:
+            delta = (cur - v["best_prior"]) / max(v["best_prior"], 1e-9)
+            v["delta_pct"] = round(delta * 100, 1)
+            v["regression"] = delta < -TOLERANCE
+            v["note"] = (
+                f"{'REGRESSION' if v['regression'] else 'ok'}: "
+                f"{cur:.4g} vs best {v['best_prior']:.4g} "
+                f"(r{v['best_prior_round']}, {v['delta_pct']:+.1f}%)"
+            )
+        out["metrics"][metric] = v
+        out["regression"] = out["regression"] or v["regression"]
+    return out
+
+
 def load_rounds(root: str = ".") -> "list[dict]":
     """One record per BENCH_r*.json, sorted by round number. Tolerant of
     missing/partial fields — a malformed round becomes a null-value row,
@@ -80,6 +151,7 @@ def load_rounds(root: str = ".") -> "list[dict]":
             ),
             "wall_s": main.get("wall_s"),
             "partial": bool(main.get("partial")),
+            "service": _service_row(detail),
             "attempts": [
                 _attempt_row(a) for a in detail.get("attempts", [])
             ],
@@ -97,17 +169,22 @@ def trajectory_table(rounds: "list[dict]") -> str:
     walls, and the failure kinds each round survived (or died of)."""
     lines = [
         f"{'round':>5} {'value':>10} {'hosts':>8} {'rpc':>5} {'wall_s':>8} "
-        f"{'rungs':>5}  failures"
+        f"{'rungs':>5} {'jobs/h':>8} {'hit':>5}  failures"
     ]
     for r in rounds:
         val = "null" if r["value"] is None else f"{r['value']:.4f}"
+        svc = r.get("service") or {}
+        jph = svc.get("jobs_per_hour")
+        hit = svc.get("cache_hit_rate")
         lines.append(
             f"{r['round'] if r['round'] is not None else '?':>5} "
             f"{val:>10}{'*' if r['partial'] else ' '}"
             f"{r['hosts'] if r['hosts'] is not None else '-':>7} "
             f"{r['rounds_per_chunk'] or '-':>5} "
             f"{r['wall_s'] if r['wall_s'] is not None else '-':>8} "
-            f"{len(r['attempts']):>5}  "
+            f"{len(r['attempts']):>5} "
+            f"{jph if jph is not None else '-':>8} "
+            f"{f'{hit:.2f}' if hit is not None else '-':>5}  "
             f"{','.join(r['failure_kinds']) or '-'}"
         )
     return "\n".join(lines)
@@ -167,12 +244,18 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     rounds = load_rounds(args.root)
     verdict = regression_check(rounds, current=args.current)
+    svc = service_check(rounds)
     if args.json:
-        print(json.dumps({"rounds": rounds, "verdict": verdict}, indent=2))
+        print(json.dumps(
+            {"rounds": rounds, "verdict": verdict, "service": svc}, indent=2
+        ))
     else:
         print(trajectory_table(rounds))
         print(verdict.get("note", ""))
-    return 1 if verdict.get("regression") else 0
+        for metric, v in svc["metrics"].items():
+            if v.get("latest") is not None or v.get("best_prior") is not None:
+                print(f"service.{metric}: {v['note']}")
+    return 1 if (verdict.get("regression") or svc.get("regression")) else 0
 
 
 if __name__ == "__main__":
