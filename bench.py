@@ -604,6 +604,143 @@ def _measure_ensemble(num_hosts: int, sim_sec: float, replica_counts=(1, 8, 32))
     return out
 
 
+def _measure_overlay(sizes, sim_sec: float, ensemble_replicas: int = 4):
+    """Overlay workload trial (runs in a disposable child, role=overlay;
+    docs/models.md): per-model throughput for the overlay pack — onion
+    (circuits + relay cells on TCP), cdn (fan-in) and gossip (fan-out) —
+    at two world sizes, plus an onion ensemble aggregate at R replicas
+    through the production vmapped driver. Every row prints as it lands
+    ({"overlay_row": ...}), so a timeout keeps the rows already
+    measured; tools/bench_history.py tracks the last (largest) row per
+    model with the same best-prior regression flagging as the headline
+    metric. The onion rows are ALSO the motivating measurement for the
+    event-exchange v2 rewrite (ROADMAP item 1): per-circuit queueing on
+    top of per-host state is the workload shape the dense lane layout
+    handles worst."""
+    import jax
+    import numpy as np
+
+    from shadow_tpu.engine import EngineConfig, init_state
+    from shadow_tpu.engine.ensemble import (
+        init_ensemble_state,
+        replica_seeds,
+        run_ensemble_until,
+    )
+    from shadow_tpu.engine.round import bootstrap, run_until
+    from shadow_tpu.graph import NetworkGraph, compute_routing
+    from shadow_tpu.models.registry import build_model
+    from shadow_tpu.runtime.ensemble import ensemble_stats
+
+    end = int(sim_sec * NS_PER_SEC)
+
+    def _world(num_hosts, seed=7):
+        n_nodes = 8
+        lines = ["graph [", "  directed 0"]
+        for i in range(n_nodes):
+            lines.append(f"  node [ id {i} ]")
+            lines.append(f'  edge [ source {i} target {i} latency "1 ms" ]')
+            lines.append(
+                f'  edge [ source {i} target {(i + 1) % n_nodes} latency "3 ms" ]'
+            )
+            lines.append(
+                f'  edge [ source {i} target {(i + 3) % n_nodes} latency "5 ms" ]'
+            )
+        lines.append("]")
+        graph = NetworkGraph.from_gml("\n".join(lines))
+        tables = compute_routing(graph).with_hosts(
+            [i % n_nodes for i in range(num_hosts)]
+        )
+        cfg = EngineConfig(
+            num_hosts=num_hosts,
+            queue_capacity=256,
+            outbox_capacity=64,
+            runahead_ns=graph.min_latency_ns(),
+            seed=seed,
+            tracker=True,
+        )
+        return cfg, tables
+
+    def _model_args(name, h):
+        if name == "onion":
+            return {"clients": h // 2, "relays": h - h // 2,
+                    "resp_cells": 20, "pause": "100 ms"}
+        if name == "cdn":
+            return {"mids": max(1, h // 64), "leaves": max(2, h // 16),
+                    "objects": 256, "pause": "50 ms"}
+        return {"view": 8, "fanout": 3, "interval": "20 ms"}
+
+    out = {"sizes": list(sizes), "sim_sec": sim_sec, "rows": []}
+    onion_world = None  # (cfg, model, tables) at the base size, reused below
+    for name in ("onion", "cdn", "gossip"):
+        for h in sizes:
+            row = {"model": name, "hosts": h}
+            try:
+                cfg, tables = _world(h)
+                model = build_model(name, h, _model_args(name, h))
+                st0 = bootstrap(init_state(cfg, model.init()), model, cfg)
+                run_until(st0, 20_000_000, model, tables, cfg,
+                          rounds_per_chunk=16)  # compile
+                t0 = time.perf_counter()
+                st = run_until(st0, end, model, tables, cfg,
+                               rounds_per_chunk=16)
+                jax.block_until_ready(st.events_handled)
+                wall = time.perf_counter() - t0
+                events = int(np.asarray(st.events_handled).sum())
+                row.update(
+                    wall_s=round(wall, 3),
+                    events=events,
+                    events_per_sec=round(events / wall, 1) if wall > 0 else None,
+                    sim_s_per_wall_s=round(sim_sec / wall, 4) if wall > 0 else None,
+                )
+                if name == "onion":
+                    m = st.model
+                    row.update(
+                        circuits=int(np.asarray(m.circuits_built).sum()),
+                        streams_done=int(np.asarray(m.streams_done).sum()),
+                        cells_relayed=int(np.asarray(m.cells_relayed).sum()),
+                    )
+                    if onion_world is None:
+                        onion_world = (cfg, model, tables)
+                elif name == "cdn":
+                    m = st.model
+                    hits = int(np.asarray(m.hits).sum())
+                    misses = int(np.asarray(m.misses).sum())
+                    row.update(
+                        hits=hits, misses=misses,
+                        hit_rate=round(hits / max(hits + misses, 1), 3),
+                    )
+                else:
+                    m = st.model
+                    row.update(
+                        merges=int(np.asarray(m.merges).sum()),
+                        churn_events=int(np.asarray(m.churn_events).sum()),
+                    )
+            except Exception as e:  # noqa: BLE001 — a failed size must not
+                # kill the other models' rows
+                row["error"] = str(e)[:300]
+            out["rows"].append(row)
+            print(json.dumps({"overlay_row": row}), flush=True)
+
+    # onion ensemble aggregate: R seeded replicas (R different consensus
+    # path sets) through the production vmapped driver, published exactly
+    # as a --replicas run's sim-stats ensemble block
+    if onion_world is not None:
+        cfg, model, tables = onion_world
+        try:
+            ens0 = init_ensemble_state(cfg, model, ensemble_replicas)
+            t0 = time.perf_counter()
+            s = run_ensemble_until(ens0, end, model, tables, cfg,
+                                   rounds_per_chunk=16)
+            jax.block_until_ready(s.events_handled)
+            wall = time.perf_counter() - t0
+            out["ensemble"] = ensemble_stats(
+                s, replica_seeds(cfg, ensemble_replicas, 1), wall, sim_sec
+            )
+        except Exception as e:  # noqa: BLE001
+            out["ensemble"] = {"error": str(e)[:300]}
+    return out
+
+
 def _measure_sweep(num_hosts: int, jobs: int = 8, capacity: int = 4):
     """Sweep trial (runs in a disposable child, role=sweep): an 8-job
     phold seed sweep through the PRODUCTION SweepService
@@ -911,6 +1048,11 @@ def main():
     if role == "sweep":
         sh = int(os.environ.get("SHADOW_TPU_BENCH_SWEEP_HOSTS", 128))
         print(json.dumps({"sweep": _measure_sweep(sh)}))
+        return
+    if role == "overlay":
+        oh = int(os.environ.get("SHADOW_TPU_BENCH_OVERLAY_HOSTS", 96))
+        osim = float(os.environ.get("SHADOW_TPU_BENCH_OVERLAY_SIMSEC", 0.3))
+        print(json.dumps({"overlay": _measure_overlay((oh, 4 * oh), osim)}))
         return
     if role == "service":
         sh = int(os.environ.get("SHADOW_TPU_BENCH_SERVICE_HOSTS", 128))
@@ -1298,6 +1440,54 @@ def main():
         except subprocess.TimeoutExpired:
             service = {"error": "timeout"}
 
+    # ---- overlay trial (overlay workload pack, docs/models.md): per-
+    # model throughput rows for onion/cdn/gossip at two world sizes plus
+    # the onion ensemble aggregate — salvageable row by row like the
+    # ensemble trial. SHADOW_TPU_BENCH_OVERLAY=0 disables. ----------------
+    overlay = None
+    if os.environ.get("SHADOW_TPU_BENCH_OVERLAY", "1") != "0" and _time_left() > 150:
+        oh = int(
+            os.environ.get(
+                "SHADOW_TPU_BENCH_OVERLAY_HOSTS", 1024 if tpu_up else 96
+            )
+        )
+        env_extra = dict(
+            SHADOW_TPU_BENCH_ROLE="overlay",
+            SHADOW_TPU_BENCH_OVERLAY_HOSTS=oh,
+        )
+        rows = []
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=_child_env(**env_extra) if tpu_up else _cpu_env(**env_extra),
+                capture_output=True,
+                text=True,
+                timeout=700 if tpu_up else min(500.0, max(_time_left(), 90.0)),
+            )
+            for ln in r.stdout.strip().splitlines():
+                try:
+                    obj = json.loads(ln)
+                except ValueError:
+                    continue
+                if "overlay" in obj:
+                    overlay = obj["overlay"]
+                elif "overlay_row" in obj:
+                    rows.append(obj["overlay_row"])
+            if overlay is None and rows:
+                overlay = {"rows": rows, "partial": True}
+            if overlay is None:
+                overlay = {"error": f"rc={r.returncode}: {r.stderr[-300:]}"}
+        except subprocess.TimeoutExpired as e:
+            out_s = e.stdout.decode(errors="replace") if isinstance(e.stdout, bytes) else (e.stdout or "")
+            for ln in out_s.strip().splitlines():
+                try:
+                    obj = json.loads(ln)
+                except ValueError:
+                    continue
+                if "overlay_row" in obj:
+                    rows.append(obj["overlay_row"])
+            overlay = {"rows": rows, "partial": True, "error": "timeout"}
+
     # optional: the old JAX-on-CPU measurement, for the record only
     cpu_xla = None
     if os.environ.get("SHADOW_TPU_BENCH_CPU_XLA") == "1":
@@ -1347,6 +1537,17 @@ def main():
                     "cache_hit_rate": service.get("cache_hit_rate"),
                 },
             )
+        if overlay and overlay.get("rows"):
+            # per-model overlay throughput, keyed by model AND world
+            # size (a salvaged partial round may only carry the small
+            # size; cross-size comparison would flag phantom slides)
+            cur = {
+                f"{r['model']}@{r['hosts']}h": r["events_per_sec"]
+                for r in overlay["rows"]
+                if r.get("events_per_sec") is not None
+            }
+            if cur:
+                history["overlay"] = bh.overlay_check(rounds, current=cur)
         print(json.dumps({"bench_history": history}), flush=True)
     except Exception as e:  # noqa: BLE001 — trajectory is advisory
         print(json.dumps({"bench_history": {"error": str(e)[:200]}}),
@@ -1366,6 +1567,7 @@ def main():
                     "native_baseline": base,
                     **({"scaling": scaling} if scaling else {}),
                     **({"ensemble": ensemble} if ensemble else {}),
+                    **({"overlay": overlay} if overlay else {}),
                     **({"sweep": sweep} if sweep else {}),
                     **({"service": service} if service else {}),
                     **({"cpu_xla": cpu_xla} if cpu_xla else {}),

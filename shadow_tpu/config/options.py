@@ -554,6 +554,15 @@ def _reject_unknown(section: str, leftover: dict) -> None:
         raise ValueError(f"unknown key(s) in {section}: {sorted(leftover)}")
 
 
+# Public face of the unknown-key discipline: every config section above
+# AND every scripted model's args mapping (models/registry.py — the
+# overlay pack's knobs like onion circuit length / cell size, CDN fan-in
+# depth, gossip churn rate) reject typo'd keys through this one helper,
+# so a misspelled knob is a one-line config error everywhere instead of
+# a silently ignored default.
+reject_unknown = _reject_unknown
+
+
 def load_config_str(text: str) -> ConfigOptions:
     raw = yaml.safe_load(text)
     if not isinstance(raw, dict):

@@ -1,0 +1,25 @@
+"""Overlay-network workload pack (ROADMAP item 4; docs/models.md).
+
+Three scripted device models that stress opposite traffic shapes on top
+of the same engine/transport planes:
+
+  * onion  — Tor-style onion routing: seeded circuit construction over
+    the NetworkGraph, fixed-size relay cells on the vectorized TCP
+    stack, per-circuit queues with EWMA round-robin cell scheduling on
+    relays (models/overlay/onion.py);
+  * cdn    — a cache hierarchy, fan-in heavy: leaf caches miss upward
+    through mid caches to one origin (models/overlay/cdn.py);
+  * gossip — push gossip with churn, fan-out heavy: periodic digests to
+    sampled peers while hosts join and leave (models/overlay/gossip.py).
+
+All three are SimState-compatible pytrees (host-axis leaves only), so
+they run unchanged under the plain/pump engines, `jax.vmap` ensembles,
+and sharding. Registered in models/registry.py as "onion", "cdn",
+"gossip".
+"""
+
+from shadow_tpu.models.overlay.cdn import CdnModel
+from shadow_tpu.models.overlay.gossip import GossipModel
+from shadow_tpu.models.overlay.onion import OnionModel
+
+__all__ = ["CdnModel", "GossipModel", "OnionModel"]

@@ -1,0 +1,251 @@
+"""CDN cache hierarchy: the overlay pack's fan-IN-heavy registry entry.
+
+Clients fetch objects from their assigned leaf cache; a leaf miss
+telescopes upward (leaf -> mid -> origin), so cold caches concentrate
+the whole client population's traffic onto a handful of parents — the
+opposite shape of gossip's fan-out and tgen's pairwise streams, and a
+direct stress of per-host queue/deliver-lane capacity at the fan-in
+hosts.
+
+World layout (one model, roles by host index):
+
+  host 0                      origin — authoritative for every object
+  hosts [1, 1+NM)             mid caches
+  hosts [1+NM, 1+NM+NL)       leaf caches
+  hosts [1+NM+NL, H)          clients — each pinned to one leaf
+
+Caches are direct-mapped object-id tables (slot = obj % slots): hit
+serves immediately, miss forwards the request up with the requester and
+the cache chain riding the payload lanes; the response retraces the
+chain (origin -> mid -> leaf -> client), filling each cache on the way
+down. Pure packet-plane (no TCP), phold-class cost; requests draw the
+object id from the seeded per-host PRNG, everything else is
+deterministic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from shadow_tpu.engine.state import EngineConfig, LocalEmits, PacketEmits
+from shadow_tpu.equeue import PAYLOAD_LANES
+from shadow_tpu.events import KIND_MODEL_BASE, KIND_PACKET
+from shadow_tpu.simtime import NS_PER_MS
+
+KIND_FETCH = KIND_MODEL_BASE  # client: draw an object, ask the leaf
+
+# payload lanes of REQ/RESP packets
+LANE_OBJ = 0
+LANE_REQUESTER = 1
+LANE_LEAF = 2
+LANE_MID = 3
+LANE_TAG = 4
+TAG_REQ = 1
+TAG_RESP = 2
+
+
+@flax.struct.dataclass
+class CdnState:
+    cache: jax.Array  # [H, SLOTS] i32 object id per direct-mapped slot (-1)
+    reqs: jax.Array  # [H] i64 client requests issued
+    hits: jax.Array  # [H] i64 cache hits served (leaf+mid)
+    misses: jax.Array  # [H] i64 cache misses forwarded up
+    fills: jax.Array  # [H] i64 cache inserts on the response path
+    resp_recv: jax.Array  # [H] i64 client responses received
+    bytes_down: jax.Array  # [H] i64 client object bytes received
+
+
+@dataclasses.dataclass(frozen=True)
+class CdnModel:
+    num_hosts: int
+    num_mids: int = 2
+    num_leaves: int = 4
+    objects: int = 256  # catalog size the clients draw from
+    leaf_slots: int = 8  # direct-mapped slots per leaf cache
+    mid_slots: int = 32  # direct-mapped slots per mid cache
+    obj_bytes: int = 20_000  # response wire size
+    req_bytes: int = 100  # request wire size
+    pause_ns: int = 100 * NS_PER_MS
+    start_ns: int = 1 * NS_PER_MS
+
+    DRAWS_PER_EVENT = 1  # object id on KIND_FETCH
+    LOCAL_EMITS = 1  # next-fetch timer
+    PACKET_EMITS = 1  # one REQ or RESP hop per event
+    BOOTSTRAP_DRAWS = 1  # initial fetch phase offset
+
+    def __post_init__(self):
+        if self.num_mids < 1 or self.num_leaves < 1:
+            raise ValueError("need at least one mid and one leaf cache")
+        if 1 + self.num_mids + self.num_leaves >= self.num_hosts:
+            raise ValueError(
+                "need num_hosts > 1 + mids + leaves (the rest are clients)"
+            )
+        if self.objects < 1:
+            raise ValueError("objects must be >= 1")
+        if self.leaf_slots < 1 or self.mid_slots < 1:
+            raise ValueError("cache slots must be >= 1")
+
+    @property
+    def slots(self) -> int:
+        return max(self.leaf_slots, self.mid_slots)
+
+    @property
+    def _mid0(self) -> int:
+        return 1
+
+    @property
+    def _leaf0(self) -> int:
+        return 1 + self.num_mids
+
+    @property
+    def _client0(self) -> int:
+        return 1 + self.num_mids + self.num_leaves
+
+    def _roles(self, host_id):
+        is_origin = host_id == 0
+        is_mid = (host_id >= self._mid0) & (host_id < self._leaf0)
+        is_leaf = (host_id >= self._leaf0) & (host_id < self._client0)
+        is_client = host_id >= self._client0
+        return is_origin, is_mid, is_leaf, is_client
+
+    def init(self) -> CdnState:
+        h = self.num_hosts
+        z = jnp.zeros((h,), jnp.int64)
+        return CdnState(
+            cache=jnp.full((h, self.slots), -1, jnp.int32),
+            reqs=z, hits=z, misses=z, fills=z, resp_recv=z, bytes_down=z,
+        )
+
+    def bootstrap(self, draw, host_id) -> LocalEmits:
+        h = host_id.shape[0]
+        _, _, _, is_client = self._roles(host_id)
+        offset = draw.uniform_int(0, 0, max(self.pause_ns, 1))
+        return LocalEmits(
+            valid=is_client[:, None],
+            time=(self.start_ns + offset)[:, None],
+            kind=jnp.full((h, 1), KIND_FETCH, jnp.int32),
+            data=jnp.zeros((h, 1, PAYLOAD_LANES), jnp.int32),
+        )
+
+    def _cache_probe(self, state, host_id, obj, is_mid):
+        eff = jnp.where(is_mid, self.mid_slots, self.leaf_slots)
+        slot = (obj % eff).astype(jnp.int32)
+        slot_oh = jnp.arange(self.slots, dtype=jnp.int32)[None, :] == slot[:, None]
+        hit = jnp.any(slot_oh & (state.cache == obj[:, None]), axis=1)
+        return slot_oh, hit
+
+    def handle(self, state: CdnState, ev, draw, cfg: EngineConfig, host_id):
+        h = host_id.shape[0]
+        is_origin, is_mid, is_leaf, is_client = self._roles(host_id)
+        is_pkt = ev.valid & (ev.kind == KIND_PACKET)
+        tag = ev.data[:, LANE_TAG]
+        m_req = is_pkt & (tag == TAG_REQ)
+        m_resp = is_pkt & (tag == TAG_RESP)
+        obj = jnp.where(is_pkt, ev.data[:, LANE_OBJ], 0)
+
+        # --- client: draw the next object, ask the pinned leaf -----------
+        m_fetch = ev.valid & (ev.kind == KIND_FETCH) & is_client
+        new_obj = draw.uniform_int(0, 0, self.objects).astype(jnp.int32)
+        my_leaf = (
+            self._leaf0 + (host_id - self._client0) % self.num_leaves
+        ).astype(jnp.int32)
+        my_mid = (
+            self._mid0 + (host_id - self._leaf0) % self.num_mids
+        ).astype(jnp.int32)
+
+        # --- cache probe at leaves/mids (REQ path) -----------------------
+        is_cache = is_leaf | is_mid
+        slot_oh, hit = self._cache_probe(state, host_id, obj, is_mid)
+        m_hit = m_req & is_cache & hit
+        m_miss = m_req & is_cache & ~hit
+
+        # --- response path: fill the cache, pass it down -----------------
+        m_fill = m_resp & is_cache
+        changed = m_fill & ~hit
+        cache = jnp.where(
+            slot_oh & changed[:, None], obj[:, None], state.cache
+        )
+        m_client_resp = m_resp & is_client
+
+        # --- the single packet lane this event emits ---------------------
+        # client fetch: REQ -> leaf        (payload seeds the chain)
+        # cache hit:    RESP -> requester/down-chain
+        # cache miss:   REQ -> parent      (chain grows by this cache)
+        # origin REQ:   RESP -> the mid that asked
+        # cache RESP:   RESP -> next hop down (leaf -> requester)
+        m_origin = m_req & is_origin
+        requester = ev.data[:, LANE_REQUESTER]
+        leaf_hop = ev.data[:, LANE_LEAF]
+        mid_hop = ev.data[:, LANE_MID]
+
+        out_req = m_fetch | m_miss
+        out_resp = m_hit | m_origin | m_fill
+        out_valid = out_req | out_resp
+        # REQ destinations: client -> its leaf; leaf miss -> its mid;
+        # mid miss -> origin
+        req_dst = jnp.where(
+            m_fetch, my_leaf, jnp.where(is_leaf, my_mid, 0)
+        )
+        # RESP destinations walk the recorded chain back down: a mid (or
+        # the origin) answers toward the leaf, the leaf toward the
+        # requester; a leaf-level fill forwards to the requester
+        resp_dst = jnp.where(
+            m_origin,
+            jnp.where(mid_hop >= 0, mid_hop, leaf_hop),
+            jnp.where(
+                is_mid, leaf_hop, requester
+            ),
+        )
+        dst = jnp.where(out_req, req_dst, resp_dst).astype(jnp.int32)
+
+        data = jnp.zeros((h, PAYLOAD_LANES), jnp.int32)
+        data = data.at[:, LANE_OBJ].set(jnp.where(m_fetch, new_obj, obj))
+        data = data.at[:, LANE_REQUESTER].set(
+            jnp.where(m_fetch, host_id, requester)
+        )
+        data = data.at[:, LANE_LEAF].set(
+            jnp.where(
+                m_fetch, -1, jnp.where(m_miss & is_leaf, host_id, leaf_hop)
+            )
+        )
+        data = data.at[:, LANE_MID].set(
+            jnp.where(
+                m_fetch, -1, jnp.where(m_miss & is_mid, host_id, mid_hop)
+            )
+        )
+        data = data.at[:, LANE_TAG].set(
+            jnp.where(out_resp, TAG_RESP, TAG_REQ)
+        )
+        size = jnp.where(out_resp, self.obj_bytes, self.req_bytes).astype(
+            jnp.int32
+        )
+        pemits = PacketEmits(
+            valid=out_valid[:, None],
+            dst=dst[:, None],
+            data=data[:, None, :],
+            size=size[:, None],
+        )
+
+        # --- next fetch after the pause ----------------------------------
+        lemits = LocalEmits(
+            valid=m_client_resp[:, None],
+            time=(ev.time + self.pause_ns)[:, None],
+            kind=jnp.full((h, 1), KIND_FETCH, jnp.int32),
+            data=jnp.zeros((h, 1, PAYLOAD_LANES), jnp.int32),
+        )
+
+        state = state.replace(
+            cache=cache,
+            reqs=state.reqs + m_fetch,
+            hits=state.hits + m_hit,
+            misses=state.misses + m_miss,
+            fills=state.fills + changed,
+            resp_recv=state.resp_recv + m_client_resp,
+            bytes_down=state.bytes_down
+            + jnp.where(m_client_resp, jnp.int64(self.obj_bytes), 0),
+        )
+        return state, lemits, pemits

@@ -1,0 +1,186 @@
+"""Gossip/DHT membership churn: the overlay pack's fan-OUT-heavy entry.
+
+Every host keeps a small partial view of the peer set and, on a periodic
+tick, pushes a digest (its own id plus two sampled view entries) to
+`fanout` peers drawn from the view — so each tick turns one local event
+into F cross-host packets, the opposite shape of the CDN model's fan-in
+and a direct stress of the outbox/exchange planes (F x H packets per
+gossip interval land in one conservative window).
+
+Churn: each tick also draws a join/leave toggle (probability
+churn_ppm / 1e6). An offline host skips its sends and ignores incoming
+digests (counted, so partition behavior is visible); its peers keep
+gossiping its id around, exactly the stale-view dynamic a DHT has to
+tolerate. Receivers merge unseen ids into deterministic view slots —
+views converge to a live random overlay without any draw on the receive
+path. Pure packet-plane, phold-class cost; under ensembles every replica
+churns a different deterministic subset.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from shadow_tpu.engine.state import EngineConfig, LocalEmits, PacketEmits
+from shadow_tpu.equeue import PAYLOAD_LANES
+from shadow_tpu.events import KIND_MODEL_BASE, KIND_PACKET
+from shadow_tpu.simtime import NS_PER_MS
+
+KIND_GOSSIP_TICK = KIND_MODEL_BASE  # periodic per-host gossip round
+
+# digest payload lanes: two sampled view entries ride along the sender id
+# (ev.src_host is the sender — free, from the tie key)
+LANE_SAMPLE_A = 0
+LANE_SAMPLE_B = 1
+
+
+@flax.struct.dataclass
+class GossipState:
+    view: jax.Array  # [H, V] i32 known peer ids
+    online: jax.Array  # [H] bool currently joined
+    ticks: jax.Array  # [H] i64 gossip rounds taken (online only)
+    msgs_recv: jax.Array  # [H] i64 digests accepted
+    merges: jax.Array  # [H] i64 new ids merged into the view
+    drops_offline: jax.Array  # [H] i64 digests ignored while offline
+    churn_events: jax.Array  # [H] i64 join/leave toggles
+
+
+@dataclasses.dataclass(frozen=True)
+class GossipModel:
+    num_hosts: int
+    view_size: int = 8  # V: partial-view slots per host
+    fanout: int = 3  # F: digests pushed per tick
+    interval_ns: int = 50 * NS_PER_MS
+    churn_ppm: int = 20_000  # per-tick join/leave probability, ppm (2%)
+    msg_bytes: int = 256  # digest wire size
+    start_ns: int = 1 * NS_PER_MS
+
+    BOOTSTRAP_DRAWS = 1  # initial tick phase offset
+
+    @property
+    def DRAWS_PER_EVENT(self):  # noqa: N802
+        return 1 + self.fanout  # churn toggle + one target per digest
+
+    LOCAL_EMITS = 1  # the next tick
+
+    @property
+    def PACKET_EMITS(self):  # noqa: N802
+        return self.fanout
+
+    def __post_init__(self):
+        if self.view_size < 2:
+            raise ValueError("view_size must be >= 2 (digests sample two)")
+        if self.fanout < 1:
+            raise ValueError("fanout must be >= 1")
+        if not 0 <= self.churn_ppm < 1_000_000:
+            raise ValueError("churn_ppm must be in [0, 1e6)")
+        if self.num_hosts < self.view_size + 1:
+            raise ValueError("need num_hosts > view_size (views exclude self)")
+
+    def init(self) -> GossipState:
+        h, v = self.num_hosts, self.view_size
+        host = jnp.arange(h, dtype=jnp.int32)[:, None]
+        view = (host + 1 + jnp.arange(v, dtype=jnp.int32)[None, :]) % h
+        z = jnp.zeros((h,), jnp.int64)
+        return GossipState(
+            view=view,
+            online=jnp.ones((h,), bool),
+            ticks=z, msgs_recv=z, merges=z, drops_offline=z, churn_events=z,
+        )
+
+    def bootstrap(self, draw, host_id) -> LocalEmits:
+        h = host_id.shape[0]
+        offset = draw.uniform_int(0, 0, max(self.interval_ns, 1))
+        return LocalEmits(
+            valid=jnp.ones((h, 1), bool),
+            time=(self.start_ns + offset)[:, None],
+            kind=jnp.full((h, 1), KIND_GOSSIP_TICK, jnp.int32),
+            data=jnp.zeros((h, 1, PAYLOAD_LANES), jnp.int32),
+        )
+
+    def _view_at(self, view, idx):
+        oh = jnp.arange(view.shape[1], dtype=jnp.int32)[None, :] == idx[:, None]
+        return jnp.sum(jnp.where(oh, view, 0), axis=1).astype(jnp.int32)
+
+    def handle(self, state: GossipState, ev, draw, cfg: EngineConfig, host_id):
+        h = host_id.shape[0]
+        v = self.view_size
+        f = self.fanout
+
+        # --- tick: churn toggle, then push digests if online -------------
+        m_tick = ev.valid & (ev.kind == KIND_GOSSIP_TICK)
+        flip = m_tick & (
+            draw.uniform_int(0, 0, 1_000_000) < self.churn_ppm
+        )
+        online = state.online ^ flip
+        m_send = m_tick & online
+
+        p_valid = jnp.zeros((h, f), bool)
+        p_dst = jnp.zeros((h, f), jnp.int32)
+        p_data = jnp.zeros((h, f, PAYLOAD_LANES), jnp.int32)
+        p_size = jnp.zeros((h, f), jnp.int32)
+        # two deterministic view samples ride every digest (rotating with
+        # the tick counter so views mix without extra draws)
+        base = (state.ticks % v).astype(jnp.int32)
+        samp_a = self._view_at(state.view, base)
+        samp_b = self._view_at(state.view, (base + 1) % v)
+        digest = jnp.zeros((h, PAYLOAD_LANES), jnp.int32)
+        digest = digest.at[:, LANE_SAMPLE_A].set(samp_a)
+        digest = digest.at[:, LANE_SAMPLE_B].set(samp_b)
+        for j in range(f):
+            idx = draw.uniform_int(1 + j, 0, v).astype(jnp.int32)
+            target = self._view_at(state.view, idx)
+            p_valid = p_valid.at[:, j].set(m_send)
+            p_dst = p_dst.at[:, j].set(target)
+            p_data = p_data.at[:, j, :].set(digest)
+            p_size = p_size.at[:, j].set(self.msg_bytes)
+        pemits = PacketEmits(valid=p_valid, dst=p_dst, data=p_data, size=p_size)
+
+        # ticks reschedule even while offline — churn can rejoin a host
+        lemits = LocalEmits(
+            valid=m_tick[:, None],
+            time=(ev.time + self.interval_ns)[:, None],
+            kind=jnp.full((h, 1), KIND_GOSSIP_TICK, jnp.int32),
+            data=jnp.zeros((h, 1, PAYLOAD_LANES), jnp.int32),
+        )
+
+        # --- digest arrival: merge sender + samples into the view --------
+        is_digest = ev.valid & (ev.kind == KIND_PACKET)
+        m_recv = is_digest & online
+        m_drop = is_digest & ~online
+        view = state.view
+        merged = jnp.zeros((h,), jnp.int64)
+        recv_ctr = state.msgs_recv + m_recv
+        cands = (
+            ev.src_host.astype(jnp.int32),
+            ev.data[:, LANE_SAMPLE_A],
+            ev.data[:, LANE_SAMPLE_B],
+        )
+        for k, cand in enumerate(cands):
+            present = (
+                jnp.any(view == cand[:, None], axis=1)
+                | (cand == host_id)
+                | (cand < 0)
+            )
+            ins = m_recv & ~present
+            slot = ((recv_ctr * 3 + k) % v).astype(jnp.int32)
+            slot_oh = (
+                jnp.arange(v, dtype=jnp.int32)[None, :] == slot[:, None]
+            ) & ins[:, None]
+            view = jnp.where(slot_oh, cand[:, None], view)
+            merged = merged + ins
+
+        state = state.replace(
+            view=view,
+            online=online,
+            ticks=state.ticks + m_send,
+            msgs_recv=recv_ctr,
+            merges=state.merges + merged,
+            drops_offline=state.drops_offline + m_drop,
+            churn_events=state.churn_events + flip,
+        )
+        return state, lemits, pemits

@@ -1,0 +1,558 @@
+"""Device-native onion routing: circuits, relay cells, EWMA scheduling.
+
+The reference simulator's flagship use case is Tor experimentation
+(Jansen et al., "Once is Never Enough", USENIX Security 2021 — the
+ensemble plane's own motivation), so this is the overlay pack's lead
+model: a Tor-shaped workload expressed entirely as SimState pytree
+state, bit-deterministic under plain/pump engines, `jax.vmap` ensembles
+and sharding.
+
+World layout (one model, roles by host index, like tgen):
+
+  hosts [0, NC)        clients — one circuit each, built at start:
+                       client -> guard -> [middle ->] exit, the relays
+                       drawn per client from the seeded per-host PRNG
+                       (replicas with different seeds build different
+                       consensus paths, exactly like re-sampling a Tor
+                       experiment);
+  hosts [NC, NC+NR)    relays — listen on the onion port; every
+                       adjacent circuit hop is one TCP connection on
+                       the vectorized stack (transport/tcp.py), so loss
+                       recovery, Reno and RTT dynamics shape cell flow
+                       like the reference's OR connections.
+
+Circuit construction telescopes like Tor EXTEND cells: the client sends
+a SETUP control cell naming the remaining hops; each relay records
+(prev, next), opens its own TCP connection to the next hop, and
+forwards a SETUP with one hop peeled off. Control cells are raw packets
+tagged in LANE_APP (TCP segments never set that lane), and every hop
+connection encodes its global circuit id in the client-side port
+(lport = PORT_CIRC_BASE + circ), so relays recover the circuit of any
+connection from ports alone — payload *content* is never needed, which
+is what keeps the model device-native.
+
+Data flow is byte-counted like tgen: a relay observes per-connection
+`delivered` deltas, banks them into per-circuit pending queues
+(pend_up toward the exit, pend_down toward the client), and a cell
+scheduler drains whole CELL-sized units into the next hop's TCP
+connection — picking the eligible circuit with the LOWEST activity
+score (EWMA-decayed cells-served count, Tor's circuit scheduling
+policy: quiet circuits win over bulk circuits), bounded per service and
+per connection in flight, so competing circuits genuinely round-robin
+instead of dumping into TCP buffers. The exit consumes request cells
+and originates `resp_cells` of response per request (the destination
+fetch, collapsed into the exit like tgen's server side).
+
+Scheduling runs in the full handler only (the pump's `block` hook vetoes
+every relay event), so the per-event service sequence is identical
+across engines; clients pump like tgen streams.
+
+Loss note: DATA cells ride TCP and survive loss; SETUP cells are
+fire-once raw packets, so a lossy path can kill a circuit at build time
+(visible as circuits_built < clients). Scenario graphs keep relay links
+loss-free, like Tor's TLS links.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from shadow_tpu.engine.state import EngineConfig, LocalEmits, PacketEmits
+from shadow_tpu.equeue import PAYLOAD_LANES
+from shadow_tpu.events import KIND_PACKET
+from shadow_tpu.simtime import NS_PER_MS, NS_PER_US
+from shadow_tpu.transport import tcp
+from shadow_tpu.transport.header import LANE_APP
+from shadow_tpu.transport.tcp import (
+    KIND_TCP_TIMER,
+    TCP_KIND_USER_BASE,
+    KIND_TCP_FLUSH,
+    TcpParams,
+    TcpState,
+)
+
+KIND_STREAM_START = TCP_KIND_USER_BASE  # client: write the next request
+KIND_CIRC_BUILD = TCP_KIND_USER_BASE + 1  # client: draw path, open, SETUP
+KIND_CELL_TICK = TCP_KIND_USER_BASE + 2  # relay: drain pending cells
+
+# LANE_APP tag of SETUP control cells (TCP segments never write lane 5)
+MAGIC_SETUP = 0x517
+
+PORT_ONION = 9001  # every relay listens here (slot 0)
+PORT_CIRC_BASE = 10_000  # hop lport = base + circuit id (u16 wire limit)
+
+_I64_MAX = jnp.iinfo(jnp.int64).max
+
+
+@flax.struct.dataclass
+class OnionState:
+    tcp: TcpState
+    # per-relay circuit table, [H, C] (clients leave theirs empty)
+    circ_id: jax.Array  # i32 global circuit id (-1 free row)
+    prev_host: jax.Array  # i32 hop toward the client
+    next_host: jax.Array  # i32 hop toward the exit (-1 = this IS the exit)
+    in_slot: jax.Array  # i32 TCP slot of the prev-hop connection (-1 unset)
+    out_slot: jax.Array  # i32 TCP slot of the next-hop connection (-1 exit)
+    pend_up: jax.Array  # i64 bytes queued toward the exit
+    pend_down: jax.Array  # i64 bytes queued toward the client
+    ewma: jax.Array  # i64 decayed cells-served activity score
+    # per-host
+    tick_armed: jax.Array  # [H] bool a CELL_TICK is pending
+    circuits_built: jax.Array  # [H] i64 rows allocated (relay)
+    circuits_rejected: jax.Array  # [H] i64 SETUP dropped: table/slots full
+    cells_relayed: jax.Array  # [H] i64 cells forwarded by the scheduler
+    requests_served: jax.Array  # [H] i64 exit: requests turned into responses
+    streams_started: jax.Array  # [H] i64 client requests written
+    streams_done: jax.Array  # [H] i64 client responses fully received
+    bytes_down: jax.Array  # [H] i64 client response bytes consumed
+
+
+@dataclasses.dataclass(frozen=True)
+class OnionModel:
+    num_hosts: int
+    num_clients: int
+    num_relays: int
+    hops: int = 3  # circuit length: guard [, middle [, exit]]
+    cell_bytes: int = 512  # fixed relay cell size (Tor: 514)
+    req_cells: int = 2  # request size, cells
+    resp_cells: int = 40  # response size, cells
+    pause_ns: int = 200 * NS_PER_MS  # client think time between streams
+    start_ns: int = 1 * NS_PER_MS
+    circuits_per_relay: int = 8  # C: circuit table rows per relay
+    cells_per_service: int = 4  # cells one scheduler service may move
+    inflight_cells: int = 16  # per-hop-connection unacked-byte cap, cells
+    tick_ns: int = 100 * NS_PER_US  # scheduler self-clock when backlogged
+    ewma_shift: int = 3  # activity decay: ewma -= ewma >> shift per service
+    port: int = PORT_ONION
+    tcp_params: TcpParams = None  # derived in __post_init__ when None
+
+    DRAWS_PER_EVENT = 3  # (guard, middle, exit) on KIND_CIRC_BUILD
+    BOOTSTRAP_DRAWS = 0
+    TCP_KIND_RANGE = (KIND_TCP_TIMER, TCP_KIND_USER_BASE)
+
+    def __post_init__(self):
+        if self.tcp_params is None:
+            # one listener + an inbound child and an outbound connection
+            # per circuit row; timewait never parks here (circuits are
+            # long-lived), so the default 2MSL is fine
+            object.__setattr__(
+                self,
+                "tcp_params",
+                TcpParams(num_sockets=1 + 2 * self.circuits_per_relay),
+            )
+        if self.num_clients + self.num_relays > self.num_hosts:
+            raise ValueError("need num_hosts >= clients + relays")
+        if self.num_clients < 1 or self.num_relays < 1:
+            raise ValueError("need at least one client and one relay")
+        if not 1 <= self.hops <= 3:
+            raise ValueError("hops must be 1, 2, or 3")
+        if self.num_relays < self.hops:
+            raise ValueError(
+                f"hops={self.hops} needs at least {self.hops} relays "
+                f"(got {self.num_relays}): circuit relays are distinct"
+            )
+        if self.cell_bytes < 1 or self.req_cells < 1 or self.resp_cells < 1:
+            raise ValueError("cell/req_cells/resp_cells must be >= 1")
+        if self.num_clients > 0xFFFF - PORT_CIRC_BASE:
+            raise ValueError(
+                f"at most {0xFFFF - PORT_CIRC_BASE} clients: the circuit id "
+                "rides the 16-bit hop source port"
+            )
+        if self.tcp_params.num_sockets < 3:
+            raise ValueError("num_sockets must be >= 3 (listener + one hop)")
+
+    @property
+    def LOCAL_EMITS(self):  # noqa: N802
+        # tcp (flush cont. + timer) + scheduler flush + tick + next-stream
+        return self.tcp_params.local_lanes + 3
+
+    @property
+    def PACKET_EMITS(self):  # noqa: N802
+        # tcp data/control lanes first (the pump's loss-draw lane indices
+        # must match the handler's), SETUP control cell last
+        return self.tcp_params.packet_lanes + 1
+
+    @property
+    def WIRE_HEADER_BYTES(self):  # noqa: N802
+        return self.tcp_params.header_bytes
+
+    @property
+    def req_span(self) -> int:
+        return self.req_cells * self.cell_bytes
+
+    @property
+    def resp_span(self) -> int:
+        return self.resp_cells * self.cell_bytes
+
+    def _roles(self, host_id):
+        is_client = host_id < self.num_clients
+        is_relay = (host_id >= self.num_clients) & (
+            host_id < self.num_clients + self.num_relays
+        )
+        return is_client, is_relay
+
+    @property
+    def pump_spec(self):
+        """Pump contract: relays NEVER pump (every relay event runs the
+        cell scheduler, so skipping the handler would change the service
+        sequence); clients pump like tgen, vetoing only the event whose
+        delivered crossing completes a response (the next-stream
+        trigger)."""
+        from shadow_tpu.engine.pump import TcpPumpSpec
+
+        nc, nr = self.num_clients, self.num_relays
+        span = self.resp_span
+
+        def get_tcp(ms):
+            return ms.tcp
+
+        def set_tcp(ms, ts):
+            return ms.replace(tcp=ts)
+
+        def block(ms, host_id, v_st, v_snd_end, delivered_new, delta):
+            is_relay = (host_id >= nc) & (host_id < nc + nr)
+            done_edge = (
+                (host_id < nc)
+                & (ms.streams_done < ms.streams_started)
+                & (delivered_new >= ms.streams_started * span)
+            )
+            return is_relay | done_edge
+
+        def apply(ms, take, host_id, delta):
+            is_client = host_id < nc
+            return ms.replace(
+                bytes_down=ms.bytes_down
+                + jnp.where(is_client & take, delta, 0)
+            )
+
+        return TcpPumpSpec(
+            params=self.tcp_params,
+            get_tcp=get_tcp,
+            set_tcp=set_tcp,
+            block=block,
+            apply=apply,
+        )
+
+    def init(self) -> OnionState:
+        h, c = self.num_hosts, self.circuits_per_relay
+        ts = tcp.create(h, self.tcp_params)
+        host_id = jnp.arange(h, dtype=jnp.int32)
+        _, is_relay = self._roles(host_id)
+        ts = tcp.listen(
+            ts,
+            is_relay,
+            jnp.zeros((h,), jnp.int32),
+            jnp.full((h,), self.port, jnp.int32),
+        )
+        neg = jnp.full((h, c), -1, jnp.int32)
+        z64c = jnp.zeros((h, c), jnp.int64)
+        z64 = jnp.zeros((h,), jnp.int64)
+        return OnionState(
+            tcp=ts,
+            circ_id=neg,
+            prev_host=neg,
+            next_host=neg,
+            in_slot=neg,
+            out_slot=neg,
+            pend_up=z64c,
+            pend_down=z64c,
+            ewma=z64c,
+            tick_armed=jnp.zeros((h,), bool),
+            circuits_built=z64,
+            circuits_rejected=z64,
+            cells_relayed=z64,
+            requests_served=z64,
+            streams_started=z64,
+            streams_done=z64,
+            bytes_down=z64,
+        )
+
+    def bootstrap(self, draw, host_id) -> LocalEmits:
+        """Clients schedule their circuit build; path draws happen at the
+        build event (bootstrap cannot write model state)."""
+        h = host_id.shape[0]
+        is_client, _ = self._roles(host_id)
+        return LocalEmits(
+            valid=is_client[:, None],
+            time=jnp.full((h, 1), self.start_ns, jnp.int64),
+            kind=jnp.full((h, 1), KIND_CIRC_BUILD, jnp.int32),
+            data=jnp.zeros((h, 1, PAYLOAD_LANES), jnp.int32),
+        )
+
+    def _draw_path(self, draw, host_id):
+        """(guard, second, third) relay host ids, distinct, from the
+        per-host stream — all three draws always consumed (fixed stride)."""
+        nc, nr = self.num_clients, self.num_relays
+        g = draw.uniform_int(0, 0, nr).astype(jnp.int32)
+        u1 = draw.uniform_int(1, 0, max(nr - 1, 1)).astype(jnp.int32)
+        m = u1 + (u1 >= g)
+        u2 = draw.uniform_int(2, 0, max(nr - 2, 1)).astype(jnp.int32)
+        lo, hi = jnp.minimum(g, m), jnp.maximum(g, m)
+        e = u2 + (u2 >= lo)
+        e = e + (e >= hi)
+        return nc + g, nc + m, nc + e
+
+    def _slot_field(self, a, slot):
+        """a[h, slot[h, c]] per circuit row; 0 where slot < 0. [H,S]x[H,C]."""
+        s = a.shape[1]
+        oh = slot[:, :, None] == jnp.arange(s, dtype=jnp.int32)[None, None, :]
+        return jnp.sum(jnp.where(oh, a[:, None, :], 0), axis=2).astype(a.dtype)
+
+    def handle(self, state: OnionState, ev, draw, cfg: EngineConfig, host_id):
+        h = host_id.shape[0]
+        p = self.tcp_params
+        c = self.circuits_per_relay
+        cell = jnp.int64(self.cell_bytes)
+        is_client, is_relay = self._roles(host_id)
+        row_idx = jnp.arange(c, dtype=jnp.int32)[None, :]
+
+        is_pkt = ev.valid & (ev.kind == KIND_PACKET)
+        is_setup = is_pkt & (ev.data[:, LANE_APP] == MAGIC_SETUP)
+        is_tcp_packet = is_pkt & ~is_setup
+
+        # --- client: build the circuit (path draws + open + SETUP) -------
+        m_build = ev.valid & (ev.kind == KIND_CIRC_BUILD) & is_client
+        guard_h, second_h, third_h = self._draw_path(draw, host_id)
+        neg1 = jnp.full((h,), -1, jnp.int32)
+        if self.hops == 1:
+            next_for_guard, next_next = neg1, neg1
+        elif self.hops == 2:
+            next_for_guard, next_next = second_h, neg1
+        else:
+            next_for_guard, next_next = second_h, third_h
+
+        # --- relay: SETUP arrival — allocate a circuit row, extend -------
+        m_setup = is_setup & is_relay
+        s_circ = ev.data[:, 1]
+        s_next = ev.data[:, 2]
+        s_next2 = ev.data[:, 3]
+        free_row = jnp.argmax(state.circ_id < 0, axis=1).astype(jnp.int32)
+        has_row = jnp.any(state.circ_id < 0, axis=1)
+        free_slot = jnp.argmax(state.tcp.st == tcp.CLOSED, axis=1).astype(
+            jnp.int32
+        )
+        has_slot = jnp.any(state.tcp.st == tcp.CLOSED, axis=1)
+        needs_conn = s_next >= 0
+        can_setup = m_setup & has_row & (has_slot | ~needs_conn)
+        row_oh = (row_idx == free_row[:, None]) & can_setup[:, None]
+        state = state.replace(
+            circ_id=jnp.where(row_oh, s_circ[:, None], state.circ_id),
+            prev_host=jnp.where(row_oh, ev.src_host[:, None], state.prev_host),
+            next_host=jnp.where(row_oh, s_next[:, None], state.next_host),
+            in_slot=jnp.where(row_oh, -1, state.in_slot),
+            out_slot=jnp.where(
+                row_oh,
+                jnp.where(needs_conn, free_slot, -1)[:, None],
+                state.out_slot,
+            ),
+            pend_up=jnp.where(row_oh, 0, state.pend_up),
+            pend_down=jnp.where(row_oh, 0, state.pend_down),
+            ewma=jnp.where(row_oh, 0, state.ewma),
+            circuits_built=state.circuits_built + can_setup,
+            circuits_rejected=state.circuits_rejected + (m_setup & ~can_setup),
+            streams_started=state.streams_started + m_build,
+        )
+
+        # --- fused app intents: client open-with-request / relay extend --
+        # app.slot doubles as the DEFAULT focus slot for non-TCP events
+        # (tcp_handle: focus = app.slot when no packet/timer/flush and no
+        # open fires), so clients pin it to their one circuit connection
+        # (slot 0) — a KIND_STREAM_START's view_write below must land
+        # there, not on whatever slot happens to be free
+        m_extend = can_setup & needs_conn
+        circ_of = jnp.where(m_build, host_id, s_circ)
+        app = tcp.AppOpen(
+            mask=m_build | m_extend,
+            slot=jnp.where(is_client, 0, free_slot).astype(jnp.int32),
+            lport=(PORT_CIRC_BASE + circ_of).astype(jnp.int32),
+            rhost=jnp.where(m_build, guard_h, s_next).astype(jnp.int32),
+            rport=jnp.full((h,), self.port, jnp.int32),
+            write_bytes=jnp.where(m_build, jnp.int64(self.req_span), 0),
+            close=jnp.zeros((h,), bool),
+        )
+
+        ts = state.tcp
+        slot, touched, v, emits, sig, delivered_open = tcp.tcp_handle(
+            ts, ev, host_id, p, is_tcp_packet, app=app
+        )
+
+        # --- classify the focus connection; bank delivered deltas --------
+        delta = jnp.where(touched, v.delivered - delivered_open, 0)
+        acceptor = touched & (v.lport == self.port)  # child from prev hop
+        initiator = touched & (v.rport == self.port)  # our conn to next hop
+        c_focus = jnp.where(acceptor, v.rport, v.lport) - PORT_CIRC_BASE
+        focus_row = (
+            (state.circ_id == c_focus[:, None])
+            & (c_focus >= 0)[:, None]
+            & is_relay[:, None]
+        )
+        assign_in = focus_row & acceptor[:, None] & (state.in_slot < 0)
+        in_slot = jnp.where(assign_in, slot[:, None], state.in_slot)
+        pend_up = state.pend_up + jnp.where(
+            focus_row & acceptor[:, None], delta[:, None], 0
+        )
+        pend_down = state.pend_down + jnp.where(
+            focus_row & initiator[:, None], delta[:, None], 0
+        )
+
+        # --- exit: whole requests become responses (the collapsed
+        # destination fetch, tgen's server side) --------------------------
+        is_exit_row = (state.circ_id >= 0) & (state.next_host < 0)
+        req_done = jnp.where(
+            is_exit_row, pend_up // jnp.int64(self.req_span), 0
+        )
+        pend_up = pend_up - req_done * jnp.int64(self.req_span)
+        pend_down = pend_down + req_done * jnp.int64(self.resp_span)
+        state = state.replace(
+            requests_served=state.requests_served + jnp.sum(req_done, axis=1)
+        )
+
+        # --- client bookkeeping: response bytes, stream completion -------
+        bytes_down = state.bytes_down + jnp.where(is_client & touched, delta, 0)
+        m_done = (
+            is_client
+            & (state.streams_done < state.streams_started)
+            & (bytes_down >= state.streams_started * jnp.int64(self.resp_span))
+        )
+        # next request on the existing circuit (streams reuse circuits)
+        m_next = ev.valid & (ev.kind == KIND_STREAM_START) & is_client
+        v = tcp.view_write(v, m_next, jnp.int64(self.req_span))
+        state = state.replace(
+            bytes_down=bytes_down,
+            streams_done=state.streams_done + m_done,
+            streams_started=state.streams_started + m_next,
+        )
+
+        # --- cell scheduler: one EWMA-weighted service per relay event ---
+        in_free = self._slot_field(ts.snd_end, in_slot) - self._slot_field(
+            ts.snd_una, in_slot
+        )
+        out_free = self._slot_field(ts.snd_end, state.out_slot) - (
+            self._slot_field(ts.snd_una, state.out_slot)
+        )
+        cap = jnp.int64(self.inflight_cells) * cell
+        live = state.circ_id >= 0
+        elig_up = live & (pend_up >= cell) & (state.out_slot >= 0) & (
+            out_free < cap
+        )
+        elig_down = live & (pend_down >= cell) & (in_slot >= 0) & (
+            in_free < cap
+        )
+        elig = elig_up | elig_down
+        m_evt = ev.valid & is_relay
+        m_serve = m_evt & jnp.any(elig, axis=1)
+        score = jnp.where(elig, state.ewma, _I64_MAX)
+        r_sel = jnp.argmin(score, axis=1).astype(jnp.int32)  # ties: low row
+        sel_oh = row_idx == r_sel[:, None]
+        up_sel = jnp.any(sel_oh & elig_up, axis=1)  # up wins when both
+        pend_sel = jnp.sum(
+            jnp.where(sel_oh, jnp.where(up_sel[:, None], pend_up, pend_down), 0),
+            axis=1,
+        )
+        n_cells = jnp.where(
+            m_serve,
+            jnp.minimum(pend_sel // cell, self.cells_per_service),
+            0,
+        )
+        serve_bytes = n_cells * cell
+        target_slot = jnp.sum(
+            jnp.where(
+                sel_oh,
+                jnp.where(up_sel[:, None], state.out_slot, in_slot),
+                0,
+            ),
+            axis=1,
+        ).astype(jnp.int32)
+        dec_up = sel_oh & up_sel[:, None] & m_serve[:, None]
+        dec_down = sel_oh & ~up_sel[:, None] & m_serve[:, None]
+        pend_up = pend_up - jnp.where(dec_up, serve_bytes[:, None], 0)
+        pend_down = pend_down - jnp.where(dec_down, serve_bytes[:, None], 0)
+        ewma = jnp.where(
+            m_serve[:, None], state.ewma - (state.ewma >> self.ewma_shift),
+            state.ewma,
+        )
+        ewma = ewma + jnp.where(dec_up | dec_down, n_cells[:, None], 0)
+
+        # --- commit TCP: the event's fused view, then the service write --
+        ts = tcp.commit_slot(ts, slot, touched | m_next, v)
+        ts = tcp.app_write(
+            ts,
+            m_serve,
+            jnp.clip(target_slot, 0, p.num_sockets - 1),
+            serve_bytes,
+        )
+
+        # --- scheduler self-clock: keep draining when backlog remains ----
+        m_tick = ev.valid & (ev.kind == KIND_CELL_TICK)
+        armed = state.tick_armed & ~m_tick
+        backlog = jnp.any(
+            (live & (pend_up >= cell) & (state.out_slot >= 0))
+            | (live & (pend_down >= cell) & (in_slot >= 0)),
+            axis=1,
+        )
+        arm_now = m_evt & backlog & ~armed
+        state = state.replace(
+            tcp=ts,
+            in_slot=in_slot,
+            pend_up=pend_up,
+            pend_down=pend_down,
+            ewma=ewma,
+            tick_armed=armed | arm_now,
+            cells_relayed=state.cells_relayed + n_cells,
+        )
+
+        # --- local lanes: tcp's two + flush / tick / next-stream ---------
+        el = self.LOCAL_EMITS
+        l_valid = jnp.zeros((h, el), bool)
+        l_time = jnp.zeros((h, el), jnp.int64)
+        l_kind = jnp.zeros((h, el), jnp.int32)
+        l_data = jnp.zeros((h, el, PAYLOAD_LANES), jnp.int32)
+        l_valid = l_valid.at[:, :2].set(emits.l_valid)
+        l_time = l_time.at[:, :2].set(emits.l_time)
+        l_kind = l_kind.at[:, :2].set(emits.l_kind)
+        l_data = l_data.at[:, :2, :].set(emits.l_data)
+        # a service (relay) or a fresh request (client, slot 0) must run
+        # the send engine on its slot — the tgen flush pattern
+        l_valid = l_valid.at[:, 2].set(m_serve | m_next)
+        l_time = l_time.at[:, 2].set(ev.time)
+        l_kind = l_kind.at[:, 2].set(KIND_TCP_FLUSH)
+        l_data = l_data.at[:, 2, 0].set(jnp.where(m_serve, target_slot, 0))
+        l_valid = l_valid.at[:, 3].set(arm_now)
+        l_time = l_time.at[:, 3].set(ev.time + self.tick_ns)
+        l_kind = l_kind.at[:, 3].set(KIND_CELL_TICK)
+        l_valid = l_valid.at[:, 4].set(m_done)
+        l_time = l_time.at[:, 4].set(ev.time + self.pause_ns)
+        l_kind = l_kind.at[:, 4].set(KIND_STREAM_START)
+        lemits = LocalEmits(valid=l_valid, time=l_time, kind=l_kind, data=l_data)
+
+        # --- packet lanes: tcp first (pump lane-index contract), SETUP
+        # control cell last ----------------------------------------------
+        ep = self.PACKET_EMITS
+        ep_tcp = p.packet_lanes
+        p_valid = jnp.zeros((h, ep), bool)
+        p_dst = jnp.zeros((h, ep), jnp.int32)
+        p_data = jnp.zeros((h, ep, PAYLOAD_LANES), jnp.int32)
+        p_size = jnp.zeros((h, ep), jnp.int32)
+        p_valid = p_valid.at[:, :ep_tcp].set(emits.p_valid)
+        p_dst = p_dst.at[:, :ep_tcp].set(emits.p_dst)
+        p_data = p_data.at[:, :ep_tcp, :].set(emits.p_data)
+        p_size = p_size.at[:, :ep_tcp].set(emits.p_size)
+        m_fwd = m_extend  # peel one hop and telescope onward
+        setup_valid = m_build | m_fwd
+        s_data = jnp.zeros((h, PAYLOAD_LANES), jnp.int32)
+        s_data = s_data.at[:, 1].set(circ_of)
+        s_data = s_data.at[:, 2].set(jnp.where(m_build, next_for_guard, s_next2))
+        s_data = s_data.at[:, 3].set(jnp.where(m_build, next_next, -1))
+        s_data = s_data.at[:, LANE_APP].set(MAGIC_SETUP)
+        p_valid = p_valid.at[:, ep_tcp].set(setup_valid)
+        p_dst = p_dst.at[:, ep_tcp].set(
+            jnp.where(m_build, guard_h, s_next).astype(jnp.int32)
+        )
+        p_data = p_data.at[:, ep_tcp, :].set(s_data)
+        p_size = p_size.at[:, ep_tcp].set(self.cell_bytes)
+        pemits = PacketEmits(valid=p_valid, dst=p_dst, data=p_data, size=p_size)
+        return state, lemits, pemits

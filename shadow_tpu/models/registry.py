@@ -3,81 +3,201 @@ model builder. The reference runs real executables here (reference:
 src/main/core/support/configuration.rs:560-640 ProcessOptions); scripted
 on-device models are this build's current equivalent, and the managed-
 process layer will plug into the same seam.
+
+Every builder validates its args strictly: an unknown key is a one-line
+config error naming the model's accepted knobs (the same `_reject_unknown`
+discipline config/options.py applies to its own sections), and an unknown
+model name raises a one-line error listing the registered names with a
+closest-match hint — never a bare KeyError.
 """
 
 from __future__ import annotations
 
+from shadow_tpu.config.options import reject_unknown as _reject_unknown
 from shadow_tpu.models.bulk import BulkTcpModel
 from shadow_tpu.models.phold import PholdModel
 from shadow_tpu.simtime import parse_time_ns
 from shadow_tpu.transport.tcp import TcpParams
 
 
-def _build_bulk_tcp(num_hosts: int, args: dict) -> BulkTcpModel:
+def _take(args: dict, time_keys=(), int_keys=()) -> "tuple[dict, dict]":
+    """Pop the declared keys out of `args` (times parsed to ns, ints
+    cast), then reject whatever is left — a typo'd knob must be a config
+    error, not a silently ignored default."""
+    args = dict(args)
     kwargs = {}
-    if "pairs" in args:
-        kwargs["num_pairs"] = int(args["pairs"])
-    else:
-        kwargs["num_pairs"] = num_hosts // 2
-    for k in ("total_bytes", "port", "client_port"):
-        if k in args:
-            kwargs[k] = int(args[k])
-    if "start" in args:
-        kwargs["start_ns"] = parse_time_ns(args["start"])
+    for key, attr in time_keys:
+        if key in args:
+            kwargs[attr] = parse_time_ns(args.pop(key))
+    for key, attr in int_keys:
+        if key in args:
+            kwargs[attr] = int(args.pop(key))
+    return args, kwargs
+
+
+def _build_bulk_tcp(num_hosts: int, args: dict) -> BulkTcpModel:
+    args, kwargs = _take(
+        args,
+        time_keys=[("start", "start_ns")],
+        int_keys=[
+            ("pairs", "num_pairs"),
+            ("total_bytes", "total_bytes"),
+            ("port", "port"),
+            ("client_port", "client_port"),
+        ],
+    )
+    kwargs.setdefault("num_pairs", num_hosts // 2)
     tcp_kwargs = {}
     for k in ("num_sockets", "mss", "rcv_wnd", "init_cwnd_segs"):
         if k in args:
-            tcp_kwargs[k] = int(args[k])
+            tcp_kwargs[k] = int(args.pop(k))
     if tcp_kwargs:
         kwargs["tcp_params"] = TcpParams(**tcp_kwargs)
+    _reject_unknown("model bulk-tcp args", args)
     return BulkTcpModel(num_hosts=num_hosts, **kwargs)
 
 
 def _build_phold(num_hosts: int, args: dict) -> PholdModel:
-    kwargs = {}
-    if "min_delay" in args:
-        kwargs["min_delay_ns"] = parse_time_ns(args["min_delay"])
-    if "max_delay" in args:
-        kwargs["max_delay_ns"] = parse_time_ns(args["max_delay"])
-    if "ball_bytes" in args:
-        kwargs["ball_bytes"] = int(args["ball_bytes"])
+    args, kwargs = _take(
+        args,
+        time_keys=[("min_delay", "min_delay_ns"), ("max_delay", "max_delay_ns")],
+        int_keys=[("ball_bytes", "ball_bytes")],
+    )
+    _reject_unknown("model phold args", args)
     return PholdModel(num_hosts=num_hosts, **kwargs)
 
 
 def _build_tgen(num_hosts: int, args: dict):
     from shadow_tpu.models.tgen import TgenModel
 
+    args = dict(args)
     # when only one side is given, the other takes the remaining hosts
     if "clients" in args:
-        clients = int(args["clients"])
-        servers = int(args.get("servers", num_hosts - clients))
+        clients = int(args.pop("clients"))
+        servers = int(args.pop("servers", num_hosts - clients))
     elif "servers" in args:
-        servers = int(args["servers"])
+        servers = int(args.pop("servers"))
         clients = num_hosts - servers
     else:
         clients = num_hosts // 2
         servers = num_hosts - clients
-    kwargs = {"num_clients": clients, "num_servers": servers}
-    for k in ("req_bytes", "resp_bytes", "port"):
-        if k in args:
-            kwargs[k] = int(args[k])
-    if "pause" in args:
-        kwargs["pause_ns"] = parse_time_ns(args["pause"])
-    if "start" in args:
-        kwargs["start_ns"] = parse_time_ns(args["start"])
-    return TgenModel(num_hosts=num_hosts, **kwargs)
+    args, kwargs = _take(
+        args,
+        time_keys=[("pause", "pause_ns"), ("start", "start_ns")],
+        int_keys=[
+            ("req_bytes", "req_bytes"),
+            ("resp_bytes", "resp_bytes"),
+            ("port", "port"),
+        ],
+    )
+    _reject_unknown("model tgen args", args)
+    return TgenModel(
+        num_hosts=num_hosts, num_clients=clients, num_servers=servers, **kwargs
+    )
+
+
+def _build_onion(num_hosts: int, args: dict):
+    from shadow_tpu.models.overlay.onion import OnionModel
+
+    args = dict(args)
+    # relay consensus size first, clients take the rest (like tgen's split)
+    if "relays" in args:
+        relays = int(args.pop("relays"))
+        clients = int(args.pop("clients", num_hosts - relays))
+    elif "clients" in args:
+        clients = int(args.pop("clients"))
+        relays = num_hosts - clients
+    else:
+        relays = max(3, num_hosts // 4)
+        clients = num_hosts - relays
+    args, kwargs = _take(
+        args,
+        time_keys=[("pause", "pause_ns"), ("start", "start_ns"),
+                   ("tick", "tick_ns")],
+        int_keys=[
+            ("hops", "hops"),
+            ("cell", "cell_bytes"),
+            ("req_cells", "req_cells"),
+            ("resp_cells", "resp_cells"),
+            ("circuits", "circuits_per_relay"),
+            ("cells_per_service", "cells_per_service"),
+            ("inflight_cells", "inflight_cells"),
+            ("port", "port"),
+        ],
+    )
+    _reject_unknown("model onion args", args)
+    return OnionModel(
+        num_hosts=num_hosts, num_clients=clients, num_relays=relays, **kwargs
+    )
+
+
+def _build_cdn(num_hosts: int, args: dict):
+    from shadow_tpu.models.overlay.cdn import CdnModel
+
+    args, kwargs = _take(
+        args,
+        time_keys=[("pause", "pause_ns"), ("start", "start_ns")],
+        int_keys=[
+            ("mids", "num_mids"),
+            ("leaves", "num_leaves"),
+            ("objects", "objects"),
+            ("leaf_slots", "leaf_slots"),
+            ("mid_slots", "mid_slots"),
+            ("obj_bytes", "obj_bytes"),
+            ("req_bytes", "req_bytes"),
+        ],
+    )
+    _reject_unknown("model cdn args", args)
+    return CdnModel(num_hosts=num_hosts, **kwargs)
+
+
+def _build_gossip(num_hosts: int, args: dict):
+    from shadow_tpu.models.overlay.gossip import GossipModel
+
+    args, kwargs = _take(
+        args,
+        time_keys=[("interval", "interval_ns"), ("start", "start_ns")],
+        int_keys=[
+            ("view", "view_size"),
+            ("fanout", "fanout"),
+            ("churn_ppm", "churn_ppm"),
+            ("msg_bytes", "msg_bytes"),
+        ],
+    )
+    _reject_unknown("model gossip args", args)
+    return GossipModel(num_hosts=num_hosts, **kwargs)
 
 
 _REGISTRY = {
     "phold": _build_phold,
     "bulk-tcp": _build_bulk_tcp,  # iperf-like bulk transfer over the TCP stack
     "tgen": _build_tgen,  # repeated request/response streams (src/test/tgen/)
+    # overlay workload pack (models/overlay/, docs/models.md):
+    "onion": _build_onion,  # Tor-style circuits + relay cell scheduling
+    "cdn": _build_cdn,  # cache hierarchy, fan-in heavy
+    "gossip": _build_gossip,  # membership gossip with churn, fan-out heavy
 }
+
+
+def registered_models() -> "list[str]":
+    return sorted(_REGISTRY)
+
+
+def unknown_model_error(name: str) -> str:
+    """One-line message for an unrecognized model name: the registered
+    names, plus a did-you-mean hint when one is close."""
+    import difflib
+
+    msg = f"unknown model {name!r}; registered models: {registered_models()}"
+    close = difflib.get_close_matches(str(name), _REGISTRY, n=1)
+    if close:
+        msg += f" (did you mean {close[0]!r}?)"
+    return msg
 
 
 def build_model(name: str, num_hosts: int, args: dict):
     if name not in _REGISTRY:
-        raise ValueError(f"unknown model {name!r}; available: {sorted(_REGISTRY)}")
+        raise ValueError(unknown_model_error(name))
     return _REGISTRY[name](num_hosts, args)
 
 

@@ -153,6 +153,14 @@ class Manager:
             for p in h.spec.processes:
                 exe = pathlib.Path(p.path)
                 if not (exe.is_file() and os.access(exe, os.X_OK)):
+                    from shadow_tpu.models.registry import unknown_model_error
+
+                    if os.sep not in p.path:
+                        # a bare word is a (mistyped) model name, not a
+                        # path: say what IS registered, with a hint
+                        raise ValueError(
+                            f"hosts.{h.name}: {unknown_model_error(p.path)}"
+                        )
                     raise ValueError(
                         f"hosts.{h.name}: process path {p.path!r} is neither a "
                         f"registered model nor an executable file"

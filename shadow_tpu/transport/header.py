@@ -13,7 +13,12 @@ lane 1: seq  (wire u32; i64 stream offsets are unwrapped via unwrap32)
 lane 2: ack  (wire u32)
 lane 3: flags | (payload_len << 8)         (flags: FIN/SYN/RST/ACK)
 lane 4: advertised receive window, bytes
-lane 5: free for app/model use (stream id, message marker, ...)
+lane 5: free for app/model use (stream id, message marker, ...).
+        CONTRACT: the TCP machine never writes this lane (`_mk_seg`
+        zeroes it), so an embedding model may claim nonzero values to
+        multiplex its own non-TCP control packets on the same wire —
+        the onion model's SETUP cells (models/overlay/onion.py) demux
+        on exactly this: is_tcp_packet = KIND_PACKET & (lane5 == 0).
 lane 6: SACK block start (wire u32; 0 == lane 7 means no block)
 lane 7: SACK block end   (wire u32, exclusive)
 """

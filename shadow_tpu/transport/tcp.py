@@ -496,7 +496,12 @@ def _empty_emits(h: int, p: TcpParams) -> TcpEmits:
 
 
 def _mk_seg(lport, rport, seq, ack, flags, plen, wnd, sack_s=None, sack_e=None):
-    """Build one segment's payload lanes ([H, PAYLOAD_LANES])."""
+    """Build one segment's payload lanes ([H, PAYLOAD_LANES]).
+
+    LANE_APP (lane 5) is deliberately left zero: embedding models demux
+    their own control packets from TCP segments by a nonzero value there
+    (transport/header.py lane contract; models/overlay/onion.py SETUP
+    cells) — writing it here would silently break that demux."""
     h = lport.shape[0]
     data = jnp.zeros((h, PAYLOAD_LANES), jnp.int32)
     data = data.at[:, LANE_PORTS].set(pack_ports(lport, rport))

@@ -104,6 +104,21 @@ SLOW_TESTS = {
     # ~25 s; the quick tier already runs the real checkpoint machinery
     # with adaptive windows on by default (tests/test_robustness.py)
     "test_adaptive_checkpoint_roundtrip_leaf_exact",
+    # overlay equivalence matrix (tests/test_overlay.py): each cell pays
+    # an onion/cdn/gossip XLA compile (the onion handler is tgen-class);
+    # the quick tier keeps the registry smoke (one compile per model)
+    # and the example CLI smoke
+    "test_onion_pump_matches_plain",
+    "test_overlay_ensemble_slices_exact",
+    "test_onion_chaos_capacity_recovers_leaf_exact",
+    "test_onion_circuits_streams_and_scheduling",
+    "test_cdn_hierarchy_fills_downward",
+    "test_gossip_churn_and_view_mixing",
+    # one compile per example rung is enough for the quick tier: it
+    # keeps the --replicas 2 CLI smoke (the satellite contract — the
+    # ensemble path subsumes the single-run plumbing), the single-run
+    # rung joins the full tier
+    "test_onion_example_runs",
 }
 
 

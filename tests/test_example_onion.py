@@ -1,0 +1,49 @@
+"""The onion example end-to-end through the real CLI path (`shadow-tpu
+run examples/onion/onion.yaml`). The tier-1 smoke is the --replicas 2
+ensemble rung (it exercises the whole CLI/Manager/EnsembleRunner path
+AND the aggregate block for one XLA compile); the single-run rung lives
+in the full tier."""
+
+import json
+import pathlib
+
+import pytest
+import yaml
+
+from shadow_tpu.runtime.cli_run import run_from_config
+
+pytestmark = pytest.mark.workload
+
+EX = pathlib.Path(__file__).parent.parent / "examples" / "onion" / "onion.yaml"
+
+
+def _example_config(tmp_path, **overrides):
+    raw = yaml.safe_load(EX.read_text())
+    raw["general"]["data_directory"] = str(tmp_path / "data")
+    raw["general"].update(overrides)
+    cfg = tmp_path / "onion.yaml"
+    cfg.write_text(yaml.safe_dump(raw))
+    return cfg
+
+
+def test_onion_example_runs(tmp_path):
+    cfg = _example_config(tmp_path)
+    assert run_from_config(str(cfg)) == 0
+    stats = json.loads((tmp_path / "data" / "sim-stats.json").read_text())
+    assert stats["scheduler"] == "tpu"
+    assert stats["num_hosts"] == 11
+    assert stats["events_handled"] > 0
+    assert stats["packets_unroutable"] == 0
+
+
+def test_onion_example_replicas_aggregate(tmp_path):
+    cfg = _example_config(tmp_path, stop_time="300 ms")
+    assert run_from_config(str(cfg), replicas=2, replica_seed_stride=5) == 0
+    stats = json.loads((tmp_path / "data" / "sim-stats.json").read_text())
+    ens = stats["ensemble"]
+    assert ens["replicas"] == 2
+    assert len(ens["per_replica"]) == 2
+    seeds = [r["seed"] for r in ens["per_replica"]]
+    assert seeds == [7, 12]  # seed + r * stride
+    assert all(r["events_handled"] > 0 for r in ens["per_replica"])
+    assert ens["aggregate"]["events_handled"]["mean"] > 0

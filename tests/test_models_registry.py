@@ -1,0 +1,106 @@
+"""Registry seam (models/registry.py): every registered model must build
+from a plain args mapping and actually simulate on the plain engine — the
+bit-rot canary whenever engine seams move — and registry errors must be
+one-line config errors (names listed, closest-match hint, strict arg
+validation), never bare KeyErrors."""
+
+import pytest
+
+from topo import two_node_graph
+
+from shadow_tpu.engine import EngineConfig, init_state
+from shadow_tpu.engine.round import bootstrap, run_until
+from shadow_tpu.graph import compute_routing
+from shadow_tpu.models.registry import (
+    build_model,
+    registered_models,
+    unknown_model_error,
+)
+from shadow_tpu.simtime import NS_PER_MS
+
+pytestmark = pytest.mark.workload
+
+# per-model smallest-world args: enough hosts for every role, horizons a
+# few round-trips long (the 3 ms two-node edge), everything else default
+_SMOKE_ARGS = {
+    "phold": (8, {"min_delay": "1 ms", "max_delay": "6 ms"}),
+    "bulk-tcp": (8, {"pairs": 4, "total_bytes": 20_000}),
+    "tgen": (8, {"clients": 4, "resp_bytes": 10_000, "pause": "20 ms"}),
+    "onion": (10, {"clients": 4, "relays": 6, "resp_cells": 8,
+                   "pause": "30 ms"}),
+    "cdn": (10, {"mids": 1, "leaves": 2, "objects": 16, "pause": "10 ms"}),
+    "gossip": (10, {"view": 4, "fanout": 2, "interval": "10 ms"}),
+}
+
+
+def test_smoke_table_covers_every_registered_model():
+    # a NEW registry entry must add its smoke row (this is the canary's
+    # own canary)
+    assert set(_SMOKE_ARGS) == set(registered_models())
+
+
+@pytest.mark.parametrize("name", sorted(_SMOKE_ARGS))
+def test_registered_model_simulates(name):
+    num_hosts, args = _SMOKE_ARGS[name]
+    model = build_model(name, num_hosts, args)
+    graph = two_node_graph(latency_ms=3)
+    tables = compute_routing(graph).with_hosts(
+        [i % 2 for i in range(num_hosts)]
+    )
+    cfg = EngineConfig(
+        num_hosts=num_hosts,
+        queue_capacity=128,
+        outbox_capacity=48,
+        runahead_ns=graph.min_latency_ns(),
+        seed=11,
+    )
+    st = bootstrap(init_state(cfg, model.init()), model, cfg)
+    st = run_until(st, 80 * NS_PER_MS, model, tables, cfg, rounds_per_chunk=8)
+    assert int(st.events_handled.sum()) > 0, f"{name}: no events delivered"
+    assert int(st.queue.overflow.sum()) == 0
+    assert int(st.outbox.overflow.sum()) == 0
+    assert int(st.packets_unroutable.sum()) == 0
+
+
+def test_unknown_model_lists_names_with_hint():
+    with pytest.raises(ValueError) as ei:
+        build_model("oniom", 8, {})
+    msg = str(ei.value)
+    for name in registered_models():
+        assert name in msg
+    assert "did you mean 'onion'?" in msg
+    # no near miss -> names only, no bogus hint
+    assert "did you mean" not in unknown_model_error("zzz-not-a-model")
+
+
+def test_unknown_model_in_config_is_one_line_error(tmp_path):
+    from shadow_tpu.config import load_config_str
+    from shadow_tpu.runtime.manager import Manager
+
+    cfg = load_config_str(
+        """
+general: { stop_time: "1 s" }
+hosts:
+  peer:
+    network_node_id: 0
+    processes: [ { path: pholdd } ]
+"""
+    )
+    with pytest.raises(ValueError, match=r"did you mean 'phold'\?"):
+        Manager(cfg)
+
+
+@pytest.mark.parametrize(
+    "name,args",
+    [
+        ("phold", {"mindelay": "1 ms"}),
+        ("tgen", {"resp_byte": 100}),
+        ("onion", {"cells": 512}),
+        ("cdn", {"leafs": 2}),
+        ("gossip", {"fan_out": 3}),
+        ("bulk-tcp", {"bytes": 1}),
+    ],
+)
+def test_typoed_model_arg_is_config_error(name, args):
+    with pytest.raises(ValueError, match="unknown key"):
+        build_model(name, 16, args)

@@ -1,0 +1,269 @@
+"""Overlay workload pack (models/overlay/, docs/models.md): the slow-tier
+equivalence matrix + behavior pins for onion / cdn / gossip.
+
+Contracts pinned here, mirroring tests/test_ensemble.py:
+
+  * plain-vs-pump leaf-exactness for the onion model (the only overlay
+    model embedding TCP): identical leaves except the iteration-structure
+    diagnostics (iters_done / lanes_live) every engine-equivalence suite
+    excludes;
+  * ensemble slice r of each overlay model is leaf-identical to a
+    standalone run seeded seed + r * stride;
+  * an injected chaos capacity fault on the onion scenario takes the
+    standard rollback-and-regrow path and the recovered run is leaf-exact
+    vs starting at the regrown capacity;
+  * model-level behavior: circuits telescope to hops x clients relay
+    rows, cells actually round-robin through relays, CDN misses fill
+    caches downward, gossip churn toggles membership.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from topo import two_node_graph
+
+from shadow_tpu.engine import EngineConfig, init_state
+from shadow_tpu.engine.ensemble import (
+    init_ensemble_state,
+    replica_seeds,
+    replica_slice,
+    run_ensemble_until,
+)
+from shadow_tpu.engine.round import bootstrap, run_until
+from shadow_tpu.graph import NetworkGraph, compute_routing
+from shadow_tpu.models.overlay import CdnModel, GossipModel, OnionModel
+from shadow_tpu.simtime import NS_PER_MS
+
+pytestmark = pytest.mark.workload
+
+# the engine-iteration diagnostics every engine-equivalence suite skips
+# (engine/state.py: they count iteration structure, not simulation state)
+_ENGINE_DIAG = ("iters_done", "lanes_live")
+
+
+def _assert_leaves_exact(a, b, what="", skip=()):
+    fa = jax.tree_util.tree_leaves_with_path(a)
+    fb = jax.tree.leaves(b)
+    assert len(fa) == len(fb)
+    for (path, la), lb in zip(fa, fb):
+        key = jax.tree_util.keystr(path)
+        if any(s in key for s in skip):
+            continue
+        assert jnp.array_equal(la, lb), f"mismatch{what} at {key}"
+
+
+def _tri_node_graph(loss=0.0):
+    lossy = f" packet_loss {loss}" if loss else ""
+    return NetworkGraph.from_gml(
+        "\n".join(
+            [
+                "graph [",
+                "  directed 0",
+                "  node [ id 0 ]",
+                "  node [ id 1 ]",
+                "  node [ id 2 ]",
+                '  edge [ source 0 target 0 latency "1 ms" ]',
+                '  edge [ source 1 target 1 latency "1 ms" ]',
+                '  edge [ source 2 target 2 latency "1 ms" ]',
+                f'  edge [ source 0 target 1 latency "3 ms"{lossy} ]',
+                f'  edge [ source 1 target 2 latency "2 ms"{lossy} ]',
+                f'  edge [ source 0 target 2 latency "5 ms"{lossy} ]',
+                "]",
+            ]
+        )
+    )
+
+
+def _world(model, seed=9, queue_capacity=192, outbox_capacity=64, nodes=3,
+           loss=0.0):
+    graph = (
+        _tri_node_graph(loss) if nodes == 3 else two_node_graph(latency_ms=3)
+    )
+    h = model.num_hosts
+    tables = compute_routing(graph).with_hosts([i % nodes for i in range(h)])
+    cfg = EngineConfig(
+        num_hosts=h,
+        queue_capacity=queue_capacity,
+        outbox_capacity=outbox_capacity,
+        runahead_ns=graph.min_latency_ns(),
+        seed=seed,
+        tracker=True,
+    )
+    return cfg, tables
+
+
+def _onion(h=12, clients=5, **kw):
+    return OnionModel(
+        num_hosts=h, num_clients=clients, num_relays=h - clients, **kw
+    )
+
+
+def test_onion_pump_matches_plain():
+    # lossy links: the loss-draw lane mapping and the TCP recovery paths
+    # must agree between engines, not just the loss-free fast path (at
+    # seed 9 the run takes real drops AND retransmits, asserted below)
+    model = _onion()
+    cfg, tables = _world(model, loss=0.02)
+    end = 400 * NS_PER_MS
+
+    def run(engine, k):
+        c = dataclasses.replace(cfg, engine=engine, pump_k=k)
+        st = bootstrap(init_state(c, model.init()), model, c)
+        return run_until(st, end, model, tables, c, rounds_per_chunk=8)
+
+    plain = run("plain", 0)
+    pump = run("pump", 3)
+    _assert_leaves_exact(plain, pump, " (plain vs pump)", skip=_ENGINE_DIAG)
+    assert int(plain.model.streams_done.sum()) > 0  # full streams completed
+    assert int(plain.packets_dropped.sum()) > 0  # loss actually exercised
+    assert int(plain.model.tcp.retransmits.sum()) > 0  # ...and recovered
+
+
+@pytest.mark.parametrize(
+    "name",
+    ["onion", "cdn", "gossip"],
+)
+def test_overlay_ensemble_slices_exact(name):
+    model = {
+        "onion": _onion(),
+        "cdn": CdnModel(num_hosts=12, num_mids=1, num_leaves=2, objects=32),
+        "gossip": GossipModel(num_hosts=12, view_size=4, fanout=2,
+                              churn_ppm=100_000),
+    }[name]
+    cfg, tables = _world(model, seed=3)
+    end = 200 * NS_PER_MS
+    stride = 3
+    ens = run_ensemble_until(
+        init_ensemble_state(cfg, model, 2, stride), end, model, tables, cfg,
+        rounds_per_chunk=8,
+    )
+    assert int(ens.events_handled.sum()) > 0
+    for r, seed in enumerate(replica_seeds(cfg, 2, stride)):
+        rcfg = dataclasses.replace(cfg, seed=seed)
+        st = bootstrap(init_state(rcfg, model.init()), model, rcfg)
+        single = run_until(st, end, model, tables, rcfg, rounds_per_chunk=8)
+        _assert_leaves_exact(
+            replica_slice(ens, r), single, f" ({name} replica {r})"
+        )
+
+
+def test_onion_chaos_capacity_recovers_leaf_exact():
+    """The acceptance pin: an injected capacity fault on the onion
+    scenario rolls back to the retained snapshot, regrows the saturated
+    buffer, replays, and finishes leaf-exact vs a fault-free run that
+    STARTED at the regrown capacity — the same bar as phold's
+    test_injected_capacity_recovers_leaf_exact."""
+    from shadow_tpu.runtime import chaos
+    from shadow_tpu.runtime.chaos import FaultPlan
+    from shadow_tpu.runtime.recovery import RecoveryPolicy, run_until_recovering
+
+    model = _onion(h=10, clients=4)
+    cfg, tables = _world(model, queue_capacity=96, outbox_capacity=48)
+    end = 200 * NS_PER_MS
+    st0 = bootstrap(init_state(cfg, model.init()), model, cfg)
+    plan = FaultPlan(faults=[{"kind": "capacity", "at": 1}])
+    with chaos.installed(plan):
+        final, recoveries = run_until_recovering(
+            st0, end, model, tables, cfg, rounds_per_chunk=4,
+            policy=RecoveryPolicy(max_recoveries=2, snapshot_interval_chunks=2),
+        )
+    assert [r["kind"] for r in recoveries] == ["capacity"]
+    assert recoveries[0]["injected"] is True
+    grown = final.queue.capacity
+    assert grown == 2 * cfg.queue_capacity  # x2 growth ladder
+
+    cfg2 = dataclasses.replace(cfg, queue_capacity=grown)
+    st2 = bootstrap(init_state(cfg2, model.init()), model, cfg2)
+    reference = run_until(st2, end, model, tables, cfg2, rounds_per_chunk=4)
+    _assert_leaves_exact(reference, final, " (recovered vs big-capacity)")
+    assert int(final.model.streams_done.sum()) > 0
+
+
+def test_onion_circuits_streams_and_scheduling():
+    """Behavior pins: every client telescopes a hops-length circuit
+    (circuits_built == hops * clients), streams complete end to end with
+    the exact response byte count, cells flow through the scheduler, the
+    exit converts whole requests, and the EWMA table shows multiplexed
+    relays actually alternating circuits."""
+    model = _onion(h=12, clients=5)
+    cfg, tables = _world(model)
+    end = 500 * NS_PER_MS
+    st = bootstrap(init_state(cfg, model.init()), model, cfg)
+    st = run_until(st, end, model, tables, cfg, rounds_per_chunk=8)
+    m = st.model
+
+    assert int(m.circuits_built.sum()) == model.hops * model.num_clients
+    assert int(m.circuits_rejected.sum()) == 0
+    done = int(m.streams_done.sum())
+    # MORE than one stream per client: circuits are reused across
+    # streams, so clients must keep cycling (a focus-slot regression
+    # that drops the next-stream write stalls every client at 1)
+    assert done > model.num_clients
+    # each completed stream delivered exactly resp_span bytes to a client
+    assert int(m.bytes_down.sum()) >= done * model.resp_span
+    assert int(m.requests_served.sum()) >= done
+    assert int(m.cells_relayed.sum()) >= done * model.resp_cells
+    # some relay carries >1 circuit (5 clients x 3 hops over 7 relays) and
+    # its scheduler has touched more than one of them
+    live = np.asarray(m.circ_id) >= 0
+    multiplexed = live.sum(axis=1) > 1
+    assert multiplexed.any()
+    served = np.asarray(m.ewma) > 0
+    assert (served & live).sum(axis=1)[multiplexed].max() > 1
+    # determinism: run-twice identical
+    st2 = bootstrap(init_state(cfg, model.init()), model, cfg)
+    st2 = run_until(st2, end, model, tables, cfg, rounds_per_chunk=8)
+    _assert_leaves_exact(st, st2, " (run twice)")
+
+
+def test_cdn_hierarchy_fills_downward():
+    model = CdnModel(num_hosts=16, num_mids=1, num_leaves=3, objects=24,
+                     leaf_slots=4, mid_slots=12, pause_ns=10 * NS_PER_MS)
+    cfg, tables = _world(model)
+    end = 400 * NS_PER_MS
+    st = bootstrap(init_state(cfg, model.init()), model, cfg)
+    st = run_until(st, end, model, tables, cfg, rounds_per_chunk=8)
+    m = st.model
+    assert int(m.reqs.sum()) > 0
+    assert int(m.resp_recv.sum()) > 0
+    assert int(m.misses.sum()) > 0  # cold caches missed upward
+    assert int(m.hits.sum()) > 0  # ...and later requests hit
+    assert int(m.fills.sum()) > 0  # responses filled caches on the way down
+    # fills landed on both tiers (fan-in actually exercised the hierarchy)
+    fills = np.asarray(m.fills)
+    assert fills[1 : 1 + model.num_mids].sum() > 0
+    assert fills[model._leaf0 : model._client0].sum() > 0
+    assert int(m.bytes_down.sum()) == int(m.resp_recv.sum()) * model.obj_bytes
+
+
+def test_gossip_churn_and_view_mixing():
+    model = GossipModel(num_hosts=16, view_size=4, fanout=3,
+                        interval_ns=10 * NS_PER_MS, churn_ppm=150_000)
+    cfg, tables = _world(model)
+    end = 400 * NS_PER_MS
+    st = bootstrap(init_state(cfg, model.init()), model, cfg)
+    st = run_until(st, end, model, tables, cfg, rounds_per_chunk=8)
+    m = st.model
+    assert int(m.ticks.sum()) > 0
+    assert int(m.msgs_recv.sum()) > 0
+    assert int(m.merges.sum()) > 0  # views actually mixed beyond the ring
+    assert int(m.churn_events.sum()) > 0  # members joined/left
+    assert int(m.drops_offline.sum()) > 0  # someone gossiped at a dead peer
+    # views never contain self or out-of-range ids
+    view = np.asarray(m.view)
+    host = np.arange(model.num_hosts)[:, None]
+    assert (view != host).all()
+    assert ((view >= 0) & (view < model.num_hosts)).all()
+
+
+def test_onion_builder_validation():
+    with pytest.raises(ValueError, match="hops must be"):
+        _onion(hops=5)
+    with pytest.raises(ValueError, match="at least 3 relays"):
+        OnionModel(num_hosts=4, num_clients=2, num_relays=2, hops=3)
+    with pytest.raises(ValueError, match="clients \\+ relays"):
+        OnionModel(num_hosts=4, num_clients=3, num_relays=3)

@@ -172,6 +172,79 @@ def test_bench_history_tracks_service_metrics(tmp_path):
     assert "service.jobs_per_hour: REGRESSION" in r.stdout
 
 
+def test_bench_history_tracks_overlay_metrics(tmp_path):
+    """ISSUE 12 satellite: detail.overlay per-model events_per_sec gets
+    the same best-prior regression flagging as the headline metric,
+    keyed per world size ("model@Nh") so a salvaged partial round's
+    small-size row is never compared against a prior large-size row."""
+
+    def _round(n, value, detail_extra):
+        (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps({
+            "n": n,
+            "parsed": {
+                "metric": "m", "value": value,
+                "detail": {
+                    "config": {"hosts": 128},
+                    "main": {"wall_s": 1.0},
+                    "attempts": [],
+                    **detail_extra,
+                },
+            },
+        }))
+
+    _round(1, 0.10, {})  # pre-overlay round: no block at all
+    _round(2, 0.12, {"overlay": {"rows": [
+        {"model": "onion", "hosts": 96, "events_per_sec": 500.0},
+        {"model": "onion", "hosts": 384, "events_per_sec": 900.0},
+        {"model": "cdn", "hosts": 384, "events_per_sec": 4000.0},
+        {"model": "gossip", "hosts": 384, "error": "boom"},
+    ]}})
+
+    sys.path.insert(0, str(TOOLS))
+    try:
+        import bench_history as bh
+    finally:
+        sys.path.pop(0)
+
+    rounds = bh.load_rounds(str(tmp_path))
+    assert rounds[0]["overlay"] is None
+    # rows key per size; the errored gossip row contributes nothing
+    assert rounds[1]["overlay"] == {
+        "onion@96h": 500.0, "onion@384h": 900.0, "cdn@384h": 4000.0,
+    }
+
+    v = bh.overlay_check(rounds)  # newest round vs (empty) history
+    assert v["regression"] is False
+    assert v["models"]["onion@384h"]["note"] == "no prior round measured this"
+
+    # an in-flight slide on one model flags it and the aggregate; a new
+    # model with no history never flags; a partial round carrying only
+    # the SMALL onion row is compared against the prior small row — the
+    # absent large row flags as null (the r05 policy), never as a
+    # phantom cross-size slide
+    v = bh.overlay_check(rounds, current={
+        "onion@96h": 490.0, "cdn@384h": 4100.0, "gossip@384h": 9000.0,
+    })
+    assert v["models"]["onion@96h"]["regression"] is False  # vs 500, -2%
+    assert v["models"]["onion@384h"]["regression"] is True  # went missing
+    assert v["models"]["cdn@384h"]["regression"] is False
+    assert v["models"]["gossip@384h"]["regression"] is False
+
+    # the CLI prints the overlay verdict lines and exits nonzero when
+    # the newest round slid
+    _round(3, 0.13, {"overlay": {"rows": [
+        {"model": "onion", "hosts": 384, "events_per_sec": 100.0},
+        {"model": "onion", "hosts": 96, "events_per_sec": 480.0},
+        {"model": "cdn", "hosts": 384, "events_per_sec": 4000.0},
+    ]}})
+    r = subprocess.run(
+        [sys.executable, str(TOOLS / "bench_history.py"), str(tmp_path)],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 1
+    assert "overlay.onion@384h: REGRESSION" in r.stdout
+
+
 def test_shm_cleanup(tmp_path):
     import mmap
     import os

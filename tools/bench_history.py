@@ -75,31 +75,43 @@ def _service_row(detail: dict) -> "dict | None":
     return row
 
 
-def service_check(rounds: "list[dict]",
-                  current: "dict | None" = None) -> dict:
-    """The detail.service trajectory verdicts — jobs_per_hour and
-    cache_hit_rate each get the SAME best-prior/TOLERANCE flagging the
-    headline metric gets (regression_check). `current` is an in-flight
-    {jobs_per_hour, cache_hit_rate} from bench.py; None compares the
-    newest recorded round against the rest."""
-    history = [r for r in rounds if r.get("service")]
-    latest_round = None
-    if current is None and history:
-        last = history[-1]
-        current, latest_round = last["service"], last["round"]
-        history = history[:-1]
-    out = {"latest_round": latest_round, "metrics": {}, "regression": False}
-    for metric in ("jobs_per_hour", "cache_hit_rate"):
-        cur = (current or {}).get(metric)
-        prior = [
-            r for r in history if r["service"].get(metric) is not None
-        ]
+def _overlay_row(detail: dict) -> "dict | None":
+    """Per-model overlay throughput a round published: detail.overlay
+    (the overlay workload trial, ISSUE 12) as {"model@Nh":
+    events_per_sec}. Keyed by model AND world size: bench.py measures
+    each model at two sizes and a salvaged partial round may only carry
+    the small one — comparing across sizes would flag phantom
+    regressions, so each (model, hosts) pair tracks its own history.
+    None when the round measured no overlay model."""
+    ov = detail.get("overlay") or {}
+    row = {}
+    for r in ov.get("rows", []):
+        model, hosts = r.get("model"), r.get("hosts")
+        eps = r.get("events_per_sec")
+        if model and eps is not None:
+            row[f"{model}@{hosts}h"] = eps
+    return row or None
+
+
+def _metric_verdicts(rounds_key: str, keys, history, current,
+                     latest_round) -> dict:
+    """The shared best-prior/TOLERANCE verdict core behind service_check
+    and overlay_check (and regression_check's policy): for each key,
+    compare `current[key]` against the best prior round's value under
+    `rounds_key`, flagging a slide past TOLERANCE — and flagging a NULL
+    latest when a prior round did measure it (the r05 policy: a metric
+    that stops being published must announce itself)."""
+    out = {"latest_round": latest_round, "regression": False}
+    verdicts = {}
+    for key in keys:
+        cur = (current or {}).get(key)
+        prior = [r for r in history if r[rounds_key].get(key) is not None]
         best = (
-            max(prior, key=lambda r: r["service"][metric]) if prior else None
+            max(prior, key=lambda r: r[rounds_key][key]) if prior else None
         )
         v = {
             "latest": cur,
-            "best_prior": best["service"][metric] if best else None,
+            "best_prior": best[rounds_key][key] if best else None,
             "best_prior_round": best["round"] if best else None,
         }
         if best is None:
@@ -120,8 +132,55 @@ def service_check(rounds: "list[dict]",
                 f"{cur:.4g} vs best {v['best_prior']:.4g} "
                 f"(r{v['best_prior_round']}, {v['delta_pct']:+.1f}%)"
             )
-        out["metrics"][metric] = v
+        verdicts[key] = v
         out["regression"] = out["regression"] or v["regression"]
+    return out, verdicts
+
+
+def _pop_latest(rounds_key: str, rounds, current):
+    """History rows carrying `rounds_key`, with the newest one promoted
+    to `current` when the caller passed none (the recorded-rounds mode
+    of the check functions)."""
+    history = [r for r in rounds if r.get(rounds_key)]
+    latest_round = None
+    if current is None and history:
+        last = history[-1]
+        current, latest_round = last[rounds_key], last["round"]
+        history = history[:-1]
+    return history, current, latest_round
+
+
+def overlay_check(rounds: "list[dict]",
+                  current: "dict | None" = None) -> dict:
+    """The detail.overlay trajectory verdicts — each overlay model's
+    events_per_sec (per world size, "model@Nh") gets the SAME
+    best-prior/TOLERANCE flagging as the headline metric. `current` is
+    an in-flight {"model@Nh": events_per_sec} from bench.py; None
+    compares the newest recorded round against the rest."""
+    history, current, latest_round = _pop_latest("overlay", rounds, current)
+    keys = sorted(
+        set(current or {}) | {m for r in history for m in r["overlay"]}
+    )
+    out, verdicts = _metric_verdicts(
+        "overlay", keys, history, current, latest_round
+    )
+    out["models"] = verdicts
+    return out
+
+
+def service_check(rounds: "list[dict]",
+                  current: "dict | None" = None) -> dict:
+    """The detail.service trajectory verdicts — jobs_per_hour and
+    cache_hit_rate each get the SAME best-prior/TOLERANCE flagging the
+    headline metric gets (regression_check). `current` is an in-flight
+    {jobs_per_hour, cache_hit_rate} from bench.py; None compares the
+    newest recorded round against the rest."""
+    history, current, latest_round = _pop_latest("service", rounds, current)
+    out, verdicts = _metric_verdicts(
+        "service", ("jobs_per_hour", "cache_hit_rate"), history, current,
+        latest_round,
+    )
+    out["metrics"] = verdicts
     return out
 
 
@@ -152,6 +211,7 @@ def load_rounds(root: str = ".") -> "list[dict]":
             "wall_s": main.get("wall_s"),
             "partial": bool(main.get("partial")),
             "service": _service_row(detail),
+            "overlay": _overlay_row(detail),
             "attempts": [
                 _attempt_row(a) for a in detail.get("attempts", [])
             ],
@@ -245,9 +305,11 @@ def main(argv=None) -> int:
     rounds = load_rounds(args.root)
     verdict = regression_check(rounds, current=args.current)
     svc = service_check(rounds)
+    ovl = overlay_check(rounds)
     if args.json:
         print(json.dumps(
-            {"rounds": rounds, "verdict": verdict, "service": svc}, indent=2
+            {"rounds": rounds, "verdict": verdict, "service": svc,
+             "overlay": ovl}, indent=2
         ))
     else:
         print(trajectory_table(rounds))
@@ -255,7 +317,14 @@ def main(argv=None) -> int:
         for metric, v in svc["metrics"].items():
             if v.get("latest") is not None or v.get("best_prior") is not None:
                 print(f"service.{metric}: {v['note']}")
-    return 1 if (verdict.get("regression") or svc.get("regression")) else 0
+        for model, v in ovl["models"].items():
+            if v.get("latest") is not None or v.get("best_prior") is not None:
+                print(f"overlay.{model}: {v['note']}")
+    return 1 if (
+        verdict.get("regression")
+        or svc.get("regression")
+        or ovl.get("regression")
+    ) else 0
 
 
 if __name__ == "__main__":
