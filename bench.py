@@ -741,6 +741,152 @@ def _measure_overlay(sizes, sim_sec: float, ensemble_replicas: int = 4):
     return out
 
 
+def _measure_mesh(num_hosts: int, sim_sec: float, replicas: int = 4):
+    """2-D mesh trial (runs in a disposable child, role=mesh;
+    docs/parallelism.md "2-D mesh"): the SAME R-replica phold batch
+    measured on every plane that can hold it — the R x 1 single-device
+    ensemble baseline, the 1 x S pure-sharded baseline (one replica
+    over all devices), and the RxS mesh grids in between — publishing
+    sim-s/wall-s and wall-per-replica per row so the trajectory record
+    (tools/bench_history.py detail.mesh) tracks where the 2-D
+    decomposition pays. Every row prints as it lands ({"mesh_row": ...}),
+    so a timeout keeps the rows already measured."""
+    import jax
+    import numpy as np
+
+    from shadow_tpu.engine import EngineConfig, ShardedRunner, init_state
+    from shadow_tpu.engine.ensemble import (
+        init_ensemble_state,
+        run_ensemble_until,
+    )
+    from shadow_tpu.engine.mesh import MeshPlan, init_mesh_state, run_mesh_until
+    from shadow_tpu.engine.round import bootstrap
+    from shadow_tpu.engine.sharded import AXIS
+    from shadow_tpu.graph import NetworkGraph, compute_routing
+    from shadow_tpu.models.phold import PholdModel
+    from shadow_tpu.simtime import NS_PER_MS
+
+    end = int(sim_sec * NS_PER_SEC)
+    n_nodes = 8
+    lines = ["graph [", "  directed 0"]
+    for i in range(n_nodes):
+        lines.append(f"  node [ id {i} ]")
+        lines.append(f'  edge [ source {i} target {i} latency "1 ms" ]')
+        lines.append(
+            f'  edge [ source {i} target {(i + 1) % n_nodes} latency "3 ms" ]'
+        )
+    lines.append("]")
+    graph = NetworkGraph.from_gml("\n".join(lines))
+    tables = compute_routing(graph).with_hosts(
+        [i % n_nodes for i in range(num_hosts)]
+    )
+    cfg = EngineConfig(
+        num_hosts=num_hosts,
+        runahead_ns=graph.min_latency_ns(),
+        seed=7,
+        tracker=True,
+    )
+    model = PholdModel(
+        num_hosts=num_hosts,
+        min_delay_ns=1 * NS_PER_MS,
+        max_delay_ns=8 * NS_PER_MS,
+    )
+    ndev = jax.device_count()
+    out = {
+        "hosts": num_hosts,
+        "sim_sec": sim_sec,
+        "replicas": replicas,
+        "devices": ndev,
+        "rows": [],
+    }
+
+    def _timed(build_state, run):
+        st0 = build_state()
+        t0 = time.perf_counter()
+        s = run(st0)
+        jax.block_until_ready(s.events_handled)
+        compile_plus_run = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        s = run(build_state())
+        jax.block_until_ready(s.events_handled)
+        wall = time.perf_counter() - t0
+        return s, wall, compile_plus_run
+
+    def _finish_row(row, s, wall, cpr, r_count):
+        row.update(
+            compile_plus_run_s=round(cpr, 3),
+            wall_s=round(wall, 4),
+            wall_per_replica_ms=round(wall / r_count * 1e3, 2),
+            sim_s_per_wall_s=round(sim_sec * r_count / wall, 4)
+            if wall > 0 else None,
+            events=int(np.asarray(s.events_handled).sum()),
+        )
+
+    trials = [("ensemble", f"{replicas}x1"), ("sharded", f"1x{ndev}")]
+    trials += [
+        ("mesh", f"{r}x{ndev // r}")
+        for r in (2, replicas)
+        if replicas % r == 0 and r <= ndev and ndev % r == 0 and r < ndev
+        and num_hosts % (ndev // r) == 0
+    ]
+    seen = set()
+    for kind, grid in trials:
+        if (kind, grid) in seen:
+            continue
+        seen.add((kind, grid))
+        row = {"kind": kind, "grid": grid}
+        try:
+            if kind == "ensemble":
+                s, wall, cpr = _timed(
+                    lambda: init_ensemble_state(cfg, model, replicas),
+                    lambda st: run_ensemble_until(
+                        st, end, model, tables, cfg, rounds_per_chunk=32
+                    ),
+                )
+                _finish_row(row, s, wall, cpr, replicas)
+            elif kind == "sharded":
+                from jax.sharding import Mesh
+
+                if num_hosts % ndev:
+                    raise ValueError(f"{num_hosts} hosts % {ndev} devices")
+                runner = ShardedRunner(
+                    Mesh(np.array(jax.devices()), (AXIS,)), model, tables,
+                    cfg, rounds_per_chunk=32,
+                )
+
+                def _single():
+                    return bootstrap(init_state(cfg, model.init()), model, cfg)
+
+                s, wall, cpr = _timed(
+                    _single, lambda st: runner.run_until(st, end)
+                )
+                _finish_row(row, s, wall, cpr, 1)
+            else:
+                rows_, shards_ = (int(x) for x in grid.split("x"))
+                plan = MeshPlan(replicas=replicas, shards=shards_, rows=rows_)
+                s, wall, cpr = _timed(
+                    lambda: init_mesh_state(cfg, model, plan),
+                    lambda st: run_mesh_until(
+                        st, end, model, tables, cfg, plan, rounds_per_chunk=32
+                    ),
+                )
+                _finish_row(row, s, wall, cpr, replicas)
+        except Exception as e:  # noqa: BLE001 — one failed grid must not
+            # kill the other rows already measured
+            row["error"] = str(e)[:300]
+        out["rows"].append(row)
+        print(json.dumps({"mesh_row": row}), flush=True)
+    done = [r for r in out["rows"] if "wall_per_replica_ms" in r]
+    mesh_done = [r for r in done if r["kind"] == "mesh"]
+    ens = next((r for r in done if r["kind"] == "ensemble"), None)
+    if mesh_done and ens:
+        best = min(mesh_done, key=lambda r: r["wall_per_replica_ms"])
+        out["best_mesh_vs_ensemble_per_replica"] = round(
+            ens["wall_per_replica_ms"] / best["wall_per_replica_ms"], 2
+        )
+    return out
+
+
 def _measure_sweep(num_hosts: int, jobs: int = 8, capacity: int = 4):
     """Sweep trial (runs in a disposable child, role=sweep): an 8-job
     phold seed sweep through the PRODUCTION SweepService
@@ -1044,6 +1190,12 @@ def main():
         eh = int(os.environ.get("SHADOW_TPU_BENCH_ENSEMBLE_HOSTS", 128))
         es = float(os.environ.get("SHADOW_TPU_BENCH_ENSEMBLE_SIMSEC", 0.1))
         print(json.dumps({"ensemble": _measure_ensemble(eh, es)}))
+        return
+    if role == "mesh":
+        mh = int(os.environ.get("SHADOW_TPU_BENCH_MESH_HOSTS", 128))
+        ms = float(os.environ.get("SHADOW_TPU_BENCH_MESH_SIMSEC", 0.1))
+        mr = int(os.environ.get("SHADOW_TPU_BENCH_MESH_REPLICAS", 4))
+        print(json.dumps({"mesh": _measure_mesh(mh, ms, replicas=mr)}))
         return
     if role == "sweep":
         sh = int(os.environ.get("SHADOW_TPU_BENCH_SWEEP_HOSTS", 128))
@@ -1369,6 +1521,70 @@ def main():
                     rows.append(obj["ensemble_row"])
             ensemble = {"rows": rows, "partial": True, "error": "timeout"}
 
+    # ---- 2-D mesh trial (mesh round, docs/parallelism.md "2-D mesh"):
+    # the same R-replica batch on the RxS grids vs the Rx1 ensemble and
+    # 1xS sharded baselines — salvageable row by row like the ensemble
+    # trial. SHADOW_TPU_BENCH_MESH=0 disables. ---------------------------
+    mesh_trial = None
+    if os.environ.get("SHADOW_TPU_BENCH_MESH", "1") != "0" and _time_left() > 150:
+        mh = int(
+            os.environ.get(
+                "SHADOW_TPU_BENCH_MESH_HOSTS", 1024 if tpu_up else 128
+            )
+        )
+        env_extra = dict(
+            SHADOW_TPU_BENCH_ROLE="mesh",
+            SHADOW_TPU_BENCH_MESH_HOSTS=mh,
+        )
+        mesh_env = _child_env(**env_extra) if tpu_up else _cpu_env(**env_extra)
+        if not tpu_up:
+            # the CPU rung still measures the mesh PATH (grids, probe
+            # rows, collective structure) on the virtual 8-device mesh
+            # the test harness uses — 1 visible device would skip every
+            # RxS row and publish only the baselines
+            mesh_env["XLA_FLAGS"] = (
+                mesh_env.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        rows = []
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=mesh_env,
+                capture_output=True,
+                text=True,
+                timeout=700 if tpu_up else min(500.0, max(_time_left(), 90.0)),
+            )
+            for ln in r.stdout.strip().splitlines():
+                try:
+                    obj = json.loads(ln)
+                except ValueError:
+                    continue
+                if "mesh" in obj:
+                    mesh_trial = obj["mesh"]
+                elif "mesh_row" in obj:
+                    rows.append(obj["mesh_row"])
+            if mesh_trial is None and rows:
+                # carry `hosts` on the salvage too: bench_history keys
+                # mesh rows by world size, and "@?h" would collapse
+                # incomparable shapes into one history
+                mesh_trial = {"hosts": mh, "rows": rows, "partial": True}
+            if mesh_trial is None:
+                mesh_trial = {"error": f"rc={r.returncode}: {r.stderr[-300:]}"}
+        except subprocess.TimeoutExpired as e:
+            out_s = e.stdout.decode(errors="replace") if isinstance(e.stdout, bytes) else (e.stdout or "")
+            for ln in out_s.strip().splitlines():
+                try:
+                    obj = json.loads(ln)
+                except ValueError:
+                    continue
+                if "mesh_row" in obj:
+                    rows.append(obj["mesh_row"])
+            mesh_trial = {
+                "hosts": mh, "rows": rows, "partial": True,
+                "error": "timeout",
+            }
+
     # ---- sweep trial (sweep-scheduler round, docs/service.md): 8-job
     # phold seed sweep through the production SweepService — jobs/hour
     # and the compile-cache hit rate (two R=4 batches, one compile).
@@ -1548,6 +1764,17 @@ def main():
             }
             if cur:
                 history["overlay"] = bh.overlay_check(rounds, current=cur)
+        if mesh_trial and mesh_trial.get("rows"):
+            # per-grid mesh throughput, keyed by plane AND grid AND
+            # world size like the overlay rows
+            cur = {
+                f"{r['kind']}{r['grid']}@{mesh_trial.get('hosts', '?')}h":
+                    r["sim_s_per_wall_s"]
+                for r in mesh_trial["rows"]
+                if r.get("sim_s_per_wall_s") is not None
+            }
+            if cur:
+                history["mesh"] = bh.mesh_check(rounds, current=cur)
         print(json.dumps({"bench_history": history}), flush=True)
     except Exception as e:  # noqa: BLE001 — trajectory is advisory
         print(json.dumps({"bench_history": {"error": str(e)[:200]}}),
@@ -1567,6 +1794,7 @@ def main():
                     "native_baseline": base,
                     **({"scaling": scaling} if scaling else {}),
                     **({"ensemble": ensemble} if ensemble else {}),
+                    **({"mesh": mesh_trial} if mesh_trial else {}),
                     **({"overlay": overlay} if overlay else {}),
                     **({"sweep": sweep} if sweep else {}),
                     **({"service": service} if service else {}),

@@ -103,6 +103,16 @@ def main(argv: "list[str] | None" = None) -> int:
         "(default 1; general.replica_seed_stride)",
     )
     run_p.add_argument(
+        "--mesh",
+        metavar="RxS",
+        help="lay the replica batch over a 2-D Mesh(replica, hosts) "
+        "device grid: R replica rows x S host-shards (hosts block-"
+        "sharded inside each row; replicas never communicate). The "
+        "replica count is --replicas when given (a multiple of R), "
+        "else R; every replica slice stays leaf-identical to its "
+        "single-device run (general.mesh; docs/parallelism.md)",
+    )
+    run_p.add_argument(
         "--autotune",
         type=float,
         nargs="?",
@@ -284,6 +294,20 @@ def main(argv: "list[str] | None" = None) -> int:
         "at --prom-interval cadence even mid-batch",
     )
     serve_p.add_argument(
+        "--mesh", metavar="RxS",
+        help="dispatch every packed batch over a 2-D Mesh(replica, "
+        "hosts) device grid — R replica rows x S host-shards; packing "
+        "prefers batch sizes that fill whole rows, and ragged/split "
+        "batches degrade their rows (docs/parallelism.md '2-D mesh')",
+    )
+    serve_p.add_argument(
+        "--journal-compact-every", type=int, default=512, metavar="N",
+        help="fold terminal journal records into a sha-digested "
+        "snapshot + tail once N record files accumulate, so a "
+        "months-long spool's journal stays bounded (default 512; "
+        "0 = never compact)",
+    )
+    serve_p.add_argument(
         "--chaos-seed", type=int, metavar="N",
         help="chaos-plane PRNG seed (docs/robustness.md)",
     )
@@ -354,6 +378,7 @@ def main(argv: "list[str] | None" = None) -> int:
                 no_autotune=args.no_autotune,
                 replicas=args.replicas,
                 replica_seed_stride=args.replica_seed_stride,
+                mesh=args.mesh,
                 chunk_watchdog=args.chunk_watchdog,
                 chaos_seed=args.chaos_seed,
                 chaos_faults=args.chaos_fault,
@@ -403,6 +428,8 @@ def main(argv: "list[str] | None" = None) -> int:
                 metrics_prom=args.metrics_prom,
                 chaos_seed=args.chaos_seed,
                 chaos_faults=args.chaos_fault,
+                mesh=args.mesh,
+                journal_compact_every=args.journal_compact_every,
             )
         except CliUserError as e:
             print(f"shadow-tpu: error: {e}", file=sys.stderr)

@@ -50,6 +50,34 @@ FAULT_KINDS = (
 )
 
 
+def parse_mesh(spec: str) -> "tuple[int, int]":
+    """Parse the user-facing `--mesh RxS` / `general.mesh` grid spec
+    into (replica rows, host shards). Accepts 'x', 'X' or the Unicode
+    multiplication sign as the separator. Lives in the config layer (no
+    device imports) so config validation and the engine's MeshPlan
+    (engine/mesh.py) share one definition."""
+    s = str(spec).strip().lower().replace("×", "x")
+    parts = s.split("x")
+    if len(parts) != 2 or not all(p.strip().isdigit() for p in parts):
+        raise ValueError(
+            f"mesh spec {spec!r} must be 'RxS' (replica rows x host "
+            "shards), e.g. '2x4'"
+        )
+    rows, shards = (int(p) for p in parts)
+    if rows < 1 or shards < 1:
+        raise ValueError(f"mesh spec {spec!r}: both grid sizes must be >= 1")
+    return rows, shards
+
+
+def canonical_mesh(spec: str) -> str:
+    """Validate and canonicalize a mesh grid spec to "RxS" — the ONE
+    form config fingerprints, compile-cache keys, and batch configs
+    store (every entry point canonicalizes through here, so the same
+    grid can never hash two ways)."""
+    rows, shards = parse_mesh(spec)
+    return f"{rows}x{shards}"
+
+
 def deep_merge(base: dict, overrides: dict) -> dict:
     """Recursive dict merge, overrides winning: nested mappings merge
     key-by-key, anything else (scalars, lists) replaces wholesale. Used
@@ -124,6 +152,15 @@ class GeneralOptions:
     # --replica-seed-stride.
     replicas: int = 1
     replica_seed_stride: int = 1
+    # 2-D mesh plane (docs/parallelism.md "2-D mesh"): "RxS" lays the
+    # replica batch over a Mesh(replica, hosts) device grid — R replica
+    # rows x S host-shards, hosts block-sharded inside each row. The
+    # run's replica count is general.replicas when > 1 (must be a
+    # multiple of R; each row vmaps replicas/R locally), else R. Slice r
+    # stays leaf-identical to a single-device run seeded
+    # seed + r * stride. CLI: --mesh RxS. None = no mesh (the
+    # single-device ensemble / parallelism sharding planes).
+    mesh: Optional[str] = None
 
     @classmethod
     def from_dict(cls, d: dict) -> "GeneralOptions":
@@ -156,10 +193,13 @@ class GeneralOptions:
             "resume",
             "replicas",
             "replica_seed_stride",
+            "mesh",
         ):
             if k in d:
                 setattr(out, k, d.pop(k))
         _reject_unknown("general", d)
+        if out.mesh is not None:
+            out.mesh = canonical_mesh(out.mesh)  # loud on a bad spec
         out.metrics_max_mb = float(out.metrics_max_mb)
         if out.metrics_max_mb < 0:
             raise ValueError("general.metrics_max_mb must be >= 0 (0 = unbounded)")

@@ -79,6 +79,14 @@ class SweepSpec:
     # is quarantined so the rest of the sweep completes.
     retry_max: int = 1
     retry_backoff_s: float = 0.0
+    # 2-D mesh batches (docs/parallelism.md "2-D mesh"): "RxS" runs
+    # every packed batch through the mesh plane — R replica rows x S
+    # host-shards per batch — instead of the single-device ensemble.
+    # Packing then prefers batch sizes that fill whole mesh rows
+    # (pack_jobs mesh_rows), and a split/retried batch degrades its
+    # rows to the largest divisor of its job count (1xS = pure
+    # sharded). None = the single-device ensemble plane.
+    mesh: "str | None" = None
 
 
 def _expand_seeds(entry_name: str, d: dict) -> "list[int]":
@@ -121,6 +129,11 @@ def load_sweep_spec(
     retry_backoff_s = float(s.pop("retry_backoff_s", 0.0))
     if retry_backoff_s < 0:
         raise ValueError("sweep.retry_backoff_s must be >= 0")
+    mesh = s.pop("mesh", None)
+    if mesh is not None:
+        from shadow_tpu.config.options import canonical_mesh
+
+        mesh = canonical_mesh(mesh)  # loud on a bad grid spec
 
     base_cfg = s.pop("config", None)
     base_path = s.pop("base", None)
@@ -182,6 +195,12 @@ def load_sweep_spec(
                     "the sweep scheduler owns replica batching — drop "
                     "general.replicas from the base/overrides"
                 )
+            if cfg.general.mesh is not None:
+                raise ValueError(
+                    f"sweep.jobs.{ename}: jobs are single-world configs; "
+                    "the sweep owns the mesh layout — use `sweep.mesh: "
+                    "RxS` instead of general.mesh in the base/overrides"
+                )
             jobs.append(
                 SweepJob(
                     name=jname,
@@ -196,7 +215,7 @@ def load_sweep_spec(
             )
     return SweepSpec(name=name, output_dir=out_dir, capacity=capacity,
                      jobs=jobs, retry_max=retry_max,
-                     retry_backoff_s=retry_backoff_s)
+                     retry_backoff_s=retry_backoff_s, mesh=mesh)
 
 
 def load_sweep_file(path: str, output_dir: "str | None" = None) -> SweepSpec:

@@ -23,10 +23,18 @@ from shadow_tpu.engine.ensemble import (
     run_ensemble_until,
 )
 from shadow_tpu.engine.sharded import ShardedRunner, shard_state, state_specs
+from shadow_tpu.engine.mesh import (
+    MeshPlan,
+    init_mesh_state,
+    run_mesh_until,
+)
 
 __all__ = [
     "ChunkProbe",
     "EngineConfig",
+    "MeshPlan",
+    "init_mesh_state",
+    "run_mesh_until",
     "init_ensemble_state",
     "replica_slice",
     "run_ensemble_until",

@@ -316,6 +316,7 @@ def _drive_ensemble(
     launch, st, end_time, max_chunks, on_chunk, pipeline, desc,
     tracker=None, on_state=None, on_rows=None,
     watchdog_s: float = 0.0, engine: str = "pump",
+    capacity_error=None,
 ):
     """The ensemble twin of engine/round.py `_drive`: same depth-2
     pipeline and donation discipline, same two-phase checkpoint commit,
@@ -327,7 +328,11 @@ def _drive_ensemble(
     sweep scheduler's per-job progress stream (one row per job, zero
     extra device syncs; runtime/sweep.py). `watchdog_s`/`engine` and
     the chaos capacity/stall/compile hooks mirror engine/round.py
-    `_drive` — the degradation ladder covers both drivers."""
+    `_drive` — the degradation ladder covers both drivers.
+    `capacity_error(rows, live_state)` overrides how an overflow
+    becomes an exception (the 2-D mesh driver names (replica, shard)
+    coordinates from the live state — engine/mesh.py); the default
+    names the replica from the probe rows alone."""
     from shadow_tpu.runtime import chaos, flightrec
 
     R = num_replicas(st)
@@ -366,6 +371,10 @@ def _drive_ensemble(
         if injected is not None:
             raise chaos.injected_capacity_error(fetched - 1, injected)
         if int(rows[:, PROBE_OVERFLOW].sum()):
+            if capacity_error is not None:
+                raise capacity_error(
+                    rows, nxt[0] if nxt is not None else pend_st
+                )
             raise _replica_capacity_error(rows)
         if on_rows is not None:
             on_rows(rows)

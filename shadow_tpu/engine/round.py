@@ -1063,6 +1063,11 @@ class CapacityError(RuntimeError):
     # ensemble runs (engine/ensemble.py): index of the replica whose
     # probe row carried the overflow (None for single-world runs)
     replica: "int | None" = None
+    # 2-D mesh runs (engine/mesh.py): host-shard index of the first
+    # saturated (replica, shard) cell, with the full per-cell breakdown
+    # on mesh_cells (None outside the mesh plane)
+    shard: "int | None" = None
+    mesh_cells: "list | None" = None
 
 
 class RunInterrupted(RuntimeError):

@@ -66,10 +66,15 @@ class AutotunePlan:
         return {k: v for k, v in dataclasses.asdict(self).items() if v is not None}
 
 
-def _cache_key(cfg, probe_rpc: int, backend: str) -> str:
+def _cache_key(cfg, probe_rpc: int, backend: str, shape_key: str = "") -> str:
     from shadow_tpu.engine.state import trace_static_cfg
 
     blob = f"{trace_static_cfg(cfg)!r}|rpc={probe_rpc}|{backend}"
+    if shape_key:
+        # the dispatch shape (ensemble [R] batch, RxS mesh) scales the
+        # compile wall independently of the static cfg: a single-device
+        # probe wall must never answer for a mesh-shaped run
+        blob += f"|{shape_key}"
     return hashlib.sha256(blob.encode()).hexdigest()[:24]
 
 
@@ -117,6 +122,8 @@ def plan_rounds_per_chunk(
     floor: int = RPC_FLOOR,
     cache_path: "str | None" = None,
     tracker=None,
+    probe_runner=None,
+    shape_key: str = "",
 ) -> AutotunePlan:
     """Measure (or recall) the tiny-chunk compile wall and choose the
     largest rounds_per_chunk whose projected compile cost fits
@@ -132,6 +139,15 @@ def plan_rounds_per_chunk(
     that state lazily: cache hits, the rpc floor, and a zero budget
     all return before the probe, and a lazy state means those paths
     never pay a full-width init_state/bootstrap at all.
+
+    `probe_runner(st, end_ns, rpc, cfg, tracker)` overrides the probe's
+    driver so the probe compiles the shape the run will ACTUALLY trace:
+    a `--replicas` run passes the vmapped ensemble driver, a `--mesh`
+    run the 2-D shard_map driver — a single-device probe under-projects
+    both (the batched/collective program costs more to compile), and
+    the budget walk would pick a too-large rounds_per_chunk. `shape_key`
+    names that dispatch shape in the probe cache key so shapes never
+    answer for each other.
     """
     import jax
 
@@ -151,7 +167,7 @@ def plan_rounds_per_chunk(
             backend=backend,
         )
 
-    key = _cache_key(cfg, probe_rpc, backend)
+    key = _cache_key(cfg, probe_rpc, backend, shape_key)
     cache = _load_cache(cache_path)
     probe_wall = cache.get(key, {}).get("probe_wall_s")
     source = "cache" if probe_wall is not None else "probe"
@@ -177,14 +193,19 @@ def plan_rounds_per_chunk(
             # real driver — its per-chunk probes must not pollute the
             # run's metrics stream/ring (the decision event below is the
             # probe's footprint there)
-            run_until(
-                probe_st, probe_end_ns, model, tables, probe_cfg,
-                rounds_per_chunk=probe_rpc, tracker=tracker,
-            )
+            if probe_runner is not None:
+                probe_runner(
+                    probe_st, probe_end_ns, probe_rpc, probe_cfg, tracker
+                )
+            else:
+                run_until(
+                    probe_st, probe_end_ns, model, tables, probe_cfg,
+                    rounds_per_chunk=probe_rpc, tracker=tracker,
+                )
         probe_wall = time.perf_counter() - t0
         flightrec.record_event(
             "autotune_probe", wall_s=round(probe_wall, 4), rpc=probe_rpc,
-            backend=backend,
+            backend=backend, **({"shape": shape_key} if shape_key else {}),
         )
         cache[key] = {
             "probe_wall_s": round(probe_wall, 4),

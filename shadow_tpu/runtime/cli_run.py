@@ -41,6 +41,7 @@ def run_from_config(
     no_autotune: bool = False,
     replicas: "int | None" = None,
     replica_seed_stride: "int | None" = None,
+    mesh: "str | None" = None,
     chunk_watchdog: "float | None" = None,
     chaos_seed: "int | None" = None,
     chaos_faults: "list[str] | None" = None,
@@ -89,6 +90,13 @@ def run_from_config(
         if replica_seed_stride < 1:
             raise CliUserError("--replica-seed-stride must be >= 1")
         config.general.replica_seed_stride = replica_seed_stride
+    if mesh is not None:
+        from shadow_tpu.config.options import canonical_mesh
+
+        try:
+            config.general.mesh = canonical_mesh(mesh)
+        except ValueError as e:
+            raise CliUserError(f"invalid --mesh: {e}") from e
     if chunk_watchdog is not None:
         if chunk_watchdog < 0:
             raise CliUserError("--chunk-watchdog must be >= 0")
@@ -227,6 +235,8 @@ def run_serve(
     metrics_prom: "str | None" = None,
     chaos_seed: "int | None" = None,
     chaos_faults: "list[str] | None" = None,
+    mesh: "str | None" = None,
+    journal_compact_every: int = 512,
 ) -> int:
     """`shadow-tpu serve` implementation (docs/service.md "Daemon
     mode"). Exit 0 when the daemon shut down cleanly with no job left
@@ -251,6 +261,15 @@ def run_serve(
             faults.append(parse_fault_arg(arg))
         except ValueError as e:
             raise CliUserError(f"invalid --chaos-fault {arg!r}: {e}") from e
+    if mesh is not None:
+        from shadow_tpu.config.options import canonical_mesh
+
+        try:
+            mesh = canonical_mesh(mesh)
+        except ValueError as e:
+            raise CliUserError(f"invalid --mesh: {e}") from e
+    if journal_compact_every < 0:
+        raise CliUserError("--journal-compact-every must be >= 0 (0 = off)")
     try:
         service = DaemonService(
             spool,
@@ -270,6 +289,8 @@ def run_serve(
             metrics_max_mb=metrics_max_mb,
             metrics_keep=metrics_keep,
             metrics_prom=metrics_prom,
+            mesh=mesh,
+            journal_compact_every=journal_compact_every,
         )
     except (ValueError, OSError) as e:
         raise CliUserError(str(e)) from e

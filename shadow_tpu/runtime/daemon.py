@@ -88,31 +88,77 @@ def _record_digest(rec: dict) -> str:
 
 
 class Journal:
-    """Append-only write-ahead journal: one JSON file per record, named
-    by sequence number, committed with the checkpoint plane's
-    atomic-write + payload-digest idiom. Replay returns valid records
-    in sequence order; corrupt/unreadable records are skipped with a
-    warning and counted (`corrupt_skipped`) — the daemon's accepted/
-    rescan recovers any admission whose record was lost."""
+    """Append-only write-ahead journal with periodic compaction: one
+    JSON file per record, named by sequence number, committed with the
+    checkpoint plane's atomic-write + payload-digest idiom.
+
+    Without compaction a months-long spool grows one file per record
+    forever. `compact()` folds the durable STATE the records carry —
+    terminal job statuses, rejection counts, admissions (live ones kept
+    verbatim with their hermetic specs; fully-terminal ones folded to
+    digests + job names) — into a sha-digested snapshot file
+    (``snap-<through_seq>.json``), then deletes the record files it
+    covers. Replay prefers snapshot + tail: the newest valid snapshot
+    seeds the state and only records with seq > its through_seq are
+    read. The two newest snapshots are retained (the checkpoint plane's
+    keep=2 idiom), so one corrupt snapshot falls back to the previous
+    one plus the accepted/ archive rescan — detected loudly by the
+    digest, never a silently different queue state. A kill at ANY point
+    of compaction is safe: the snapshot commit is atomic, stale records
+    <= through_seq are simply ignored by replay, and deletions are
+    idempotent (tests/test_daemon_cli.py pins kill-during-compaction).
+
+    Operational records (batch-start, resume, shutdown) fold away
+    entirely — only the last folded record's type survives as
+    ``last_type`` for crash detection. Corrupt/unreadable records are
+    skipped with a warning and counted (`corrupt_skipped`) — the
+    daemon's accepted/ rescan recovers any admission whose record was
+    lost."""
+
+    _SNAP_RE = re.compile(r"^snap-(\d{8})\.json$")
+    _REC_RE = re.compile(r"^r(\d{8})\.json$")
 
     def __init__(self, directory: str):
         self.directory = directory
         self.corrupt_skipped = 0
+        self.snapshot: "dict | None" = None
+        self.compactions = 0
+        # tail_files value of the last compact() that found nothing
+        # valid to fold (None = never stuck): the cadence check skips
+        # until the count moves past it
+        self._compact_stuck_at: "int | None" = None
         os.makedirs(directory, exist_ok=True)
+        names = os.listdir(directory)
         seqs = [
             int(m.group(1))
-            for m in (re.match(r"^r(\d{8})\.json$", f)
-                      for f in os.listdir(directory))
+            for m in (self._REC_RE.match(f) for f in names)
             if m
         ]
-        self._seq = max(seqs) + 1 if seqs else 0
+        snaps = [
+            int(m.group(1))
+            for m in (self._SNAP_RE.match(f) for f in names)
+            if m
+        ]
+        self._seq = max(
+            [s + 1 for s in seqs] + [s + 1 for s in snaps] + [0]
+        )
+        self._tail_files = len(seqs)
 
     @property
     def count(self) -> int:
         return self._seq
 
+    @property
+    def tail_files(self) -> int:
+        """Record FILES currently on disk (the growth compaction bounds;
+        `count` keeps counting every record ever appended)."""
+        return self._tail_files
+
     def _path(self, seq: int) -> str:
         return os.path.join(self.directory, f"r{seq:08d}.json")
+
+    def _snap_path(self, through_seq: int) -> str:
+        return os.path.join(self.directory, f"snap-{through_seq:08d}.json")
 
     def append(self, _type: str, **data) -> dict:
         from shadow_tpu.runtime import chaos
@@ -135,12 +181,43 @@ class Journal:
         if chaos.fire("spool-corrupt", at=rec["seq"]) is not None:
             chaos.damage_file(path, truncate=False)
         self._seq += 1
+        self._tail_files += 1
         return rec
 
-    def replay(self) -> "list[dict]":
+    def _load_snapshot(self) -> "dict | None":
+        """The newest snapshot that passes its sha-256 check; a corrupt
+        one is skipped with a warning and the previous one tried (its
+        covered-but-not-yet-deleted records and the accepted/ rescan
+        close the gap)."""
+        snaps = sorted(
+            (int(m.group(1)), f)
+            for m, f in (
+                (self._SNAP_RE.match(f), f)
+                for f in os.listdir(self.directory)
+            )
+            if m
+        )
+        for _through, fname in reversed(snaps):
+            path = os.path.join(self.directory, fname)
+            try:
+                with open(path) as f:
+                    snap = json.load(f)
+                if snap.get("sha256") != _record_digest(snap):
+                    raise ValueError("payload failed its sha-256 check")
+            except (OSError, ValueError) as e:
+                self.corrupt_skipped += 1
+                slog("warning", 0, "daemon",
+                     f"skipping corrupt journal snapshot {path}: {e} — "
+                     "falling back to the previous snapshot + records")
+                continue
+            return snap
+        return None
+
+    def _read_records(self, after_seq: int = -1) -> "list[dict]":
         records = []
         for fname in sorted(os.listdir(self.directory)):
-            if not re.match(r"^r\d{8}\.json$", fname):
+            m = self._REC_RE.match(fname)
+            if not m or int(m.group(1)) <= after_seq:
                 continue
             path = os.path.join(self.directory, fname)
             try:
@@ -157,6 +234,130 @@ class Journal:
             records.append(rec)
         records.sort(key=lambda r: r.get("seq", 0))
         return records
+
+    def replay(self) -> "list[dict]":
+        """Valid TAIL records in sequence order: everything after the
+        newest valid snapshot (left on self.snapshot; None when the
+        journal was never compacted). Records a snapshot already covers
+        are ignored even when still on disk — the kill-during-compaction
+        invariant."""
+        self.snapshot = self._load_snapshot()
+        after = self.snapshot["through_seq"] if self.snapshot else -1
+        return self._read_records(after_seq=after)
+
+    def compact(self) -> "dict | None":
+        """Fold snapshot + all current records into a fresh snapshot and
+        delete the record files it covers. Returns the new snapshot, or
+        None when there was nothing to fold. Crash-ordering: snapshot
+        commit (atomic) -> chaos kill seam -> deletions — so a SIGKILL
+        anywhere leaves either the old state or a committed snapshot
+        with redundant stale records, both of which replay identically."""
+        from shadow_tpu.runtime import chaos
+
+        # replay() already counted this tail's corrupt records into
+        # corrupt_skipped; re-reading here must not double-report them
+        skipped_before = self.corrupt_skipped
+        prev = self._load_snapshot()
+        after = prev["through_seq"] if prev else -1
+        tail = self._read_records(after_seq=after)
+        self.corrupt_skipped = skipped_before
+        if not tail:
+            # nothing valid to fold (e.g. an all-corrupt tail): remember
+            # the file count so the cadence check does not re-scan every
+            # idle tick until new records actually land
+            self._compact_stuck_at = self._tail_files
+            return None
+        snap = _fold_records(prev, tail)
+        snap["sha256"] = _record_digest(snap)
+        path = self._snap_path(snap["through_seq"])
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(snap, f)
+        os.replace(tmp, path)
+        self.compactions += 1
+        # chaos seam (tags=("compact",)): SIGKILL between the snapshot
+        # commit and the deletions below — restart must replay the same
+        # state from snapshot + (now-redundant) stale records
+        if chaos.fire("daemon-kill", at=self.compactions - 1,
+                      tags=("compact",)) is not None:
+            slog("warning", 0, "chaos",
+                 "injected fault: daemon-kill during journal compaction "
+                 "— SIGKILL now")
+            os.kill(os.getpid(), signal.SIGKILL)
+        removed = 0
+        for fname in list(os.listdir(self.directory)):
+            m = self._REC_RE.match(fname)
+            if m and int(m.group(1)) <= snap["through_seq"]:
+                try:
+                    os.remove(os.path.join(self.directory, fname))
+                    removed += 1
+                except OSError:
+                    pass
+            ms = self._SNAP_RE.match(fname)
+            if ms and int(ms.group(1)) < (after if prev else -1):
+                # keep exactly the new snapshot and its predecessor
+                try:
+                    os.remove(os.path.join(self.directory, fname))
+                except OSError:
+                    pass
+        self._tail_files = max(0, self._tail_files - removed)
+        self.snapshot = snap
+        slog("info", 0, "daemon",
+             f"compacted journal: {removed} record file(s) folded into "
+             f"{os.path.basename(path)} "
+             f"({len(snap['admits'])} live admission(s), "
+             f"{len(snap['folded_admits'])} folded, "
+             f"{len(snap['terminal'])} terminal job(s))")
+        return snap
+
+
+def _fold_records(prev: "dict | None", tail: "list[dict]") -> dict:
+    """The compaction fold: durable state out, operational history off.
+    Admissions whose jobs are ALL terminal drop their embedded spec
+    (the accepted/ archive keeps the hermetic copy) and keep only the
+    digests + names replay needs for idempotency; live admissions are
+    kept verbatim so _replay_admit can re-queue them."""
+    terminal = dict((prev or {}).get("terminal", {}))
+    rejected = dict((prev or {}).get("rejected", {}))
+    admits: "dict[str, dict]" = {
+        r["spec_sha256"]: r for r in (prev or {}).get("admits", [])
+    }
+    folded: "dict[str, dict]" = {
+        r["spec_sha256"]: r for r in (prev or {}).get("folded_admits", [])
+    }
+    last_type = (prev or {}).get("last_type")
+    for rec in tail:
+        t = rec.get("type")
+        last_type = t
+        if t == "admit":
+            admits[rec.get("spec_sha256")] = rec
+        elif t in ("job-done", "job-failed", "job-quarantined"):
+            terminal[rec.get("job")] = t[len("job-"):]
+        elif t == "reject":
+            tn = rec.get("tenant") or "?"
+            rejected[tn] = rejected.get(tn, 0) + 1
+    for sha, rec in list(admits.items()):
+        names = rec.get("jobs", [])
+        if names and all(n in terminal for n in names):
+            folded[sha] = {
+                k: rec.get(k)
+                for k in ("spec_sha256", "source_sha256", "tenant",
+                          "entry", "jobs", "seeds", "priority",
+                          "spec_file")
+                if rec.get(k) is not None
+            }
+            del admits[sha]
+    return {
+        "type": "snapshot",
+        "version": JOURNAL_VERSION,
+        "through_seq": tail[-1]["seq"],
+        "wall": round(time.time(), 3),
+        "last_type": last_type,
+        "terminal": terminal,
+        "rejected": rejected,
+        "admits": list(admits.values()),
+        "folded_admits": list(folded.values()),
+    }
 
 
 def parse_spool_spec(text: str, spool_dir: str,
@@ -242,6 +443,12 @@ def parse_spool_spec(text: str, spool_dir: str,
                 f"job {ename!r}: spool jobs are single-world configs; "
                 "the daemon owns replica batching — drop general.replicas"
             )
+        if cfg.general.mesh is not None:
+            raise ValueError(
+                f"job {ename!r}: spool jobs are single-world configs; "
+                "the daemon owns the mesh layout (serve --mesh RxS) — "
+                "drop general.mesh"
+            )
         jobs.append(
             SweepJob(
                 name=jname,
@@ -300,6 +507,8 @@ class DaemonService(SweepService):
         metrics_keep: int = 3,
         metrics_prom: "str | None" = None,
         default_tenant: str = "default",
+        mesh: "str | None" = None,
+        journal_compact_every: int = 512,
     ):
         self.spool_dir = os.path.abspath(spool_dir)
         for sub in ("incoming", "accepted", "rejected", "journal",
@@ -312,6 +521,7 @@ class DaemonService(SweepService):
             jobs=[],
             retry_max=retry_max,
             retry_backoff_s=retry_backoff_s,
+            mesh=mesh,
         )
         cache = None
         if persist_cache:
@@ -323,6 +533,10 @@ class DaemonService(SweepService):
             cache=cache,
         )
         self.journal = Journal(os.path.join(self.spool_dir, "journal"))
+        # journal compaction cadence: fold terminal records into a
+        # snapshot once this many record FILES accumulate (0 = never —
+        # the pre-compaction behavior)
+        self.journal_compact_every = int(journal_compact_every)
         self.default_quota = int(default_quota)
         self.quotas = {str(k): int(v) for k, v in (quotas or {}).items()}
         self.weights = {str(k): float(v) for k, v in (weights or {}).items()}
@@ -441,8 +655,26 @@ class DaemonService(SweepService):
 
     def _replay(self) -> None:
         records = self.journal.replay()
+        snap = self.journal.snapshot
         crashed = bool(records) and records[-1].get("type") != "shutdown"
+        if not records and snap is not None:
+            # an empty tail means the last record before compaction
+            # carries the crash signal — the snapshot folded its type
+            crashed = snap.get("last_type") != "shutdown"
         admits: "list[dict]" = []
+        if snap is not None:
+            # replay prefers snapshot + tail: the folded state seeds the
+            # mirrors FIRST so tail records and live admissions layer on
+            # top (terminal before admits keeps outstanding counts right)
+            self._terminal.update(snap.get("terminal", {}))
+            for tn, n in snap.get("rejected", {}).items():
+                self._rejected[tn] = self._rejected.get(tn, 0) + int(n)
+            for rec in snap.get("folded_admits", []):
+                self._register_admit(
+                    rec.get("tenant") or self.default_tenant,
+                    rec.get("entry") or "?", rec, rec.get("jobs", []),
+                )
+            admits.extend(snap.get("admits", []))
         for rec in records:
             t = rec.get("type")
             if t == "admit":
@@ -456,7 +688,7 @@ class DaemonService(SweepService):
         resumed: "list[dict]" = []
         for rec in admits:
             resumed.extend(self._replay_admit(rec))
-        if records or resumed:
+        if records or resumed or snap is not None:
             self.resume_report = {
                 "crashed": crashed,
                 "journal_records": len(records),
@@ -478,7 +710,12 @@ class DaemonService(SweepService):
         record to corruption — re-journal it from the archived file
         (the journal and the archive are two independent copies of
         every admission; losing one must lose nothing)."""
-        known = {r.get("spec_sha256") for r in admits}
+        # folded (compacted) admissions are known through the digest
+        # mirror, not the admit list — without them every long-finished
+        # spec in accepted/ would re-journal after each compaction
+        known = {r.get("spec_sha256") for r in admits} | set(
+            self._admitted_digests
+        )
         recovered = []
         for fname in sorted(os.listdir(self._sub("accepted"))):
             path = os.path.join(self._sub("accepted"), fname)
@@ -585,16 +822,19 @@ class DaemonService(SweepService):
     def _register_admit(self, tenant, entry, rec, jobs) -> None:
         # both digests dedupe: spec_sha256 is the canonical (hermetic)
         # text the journal/archive hold; source_sha256 the original
-        # incoming file, so re-dropping either form is idempotent
+        # incoming file, so re-dropping either form is idempotent.
+        # `jobs` takes SweepJobs or bare names (compacted folded_admits
+        # carry names only — the specs live in accepted/).
         self._admitted_digests[rec["spec_sha256"]] = rec
         if rec.get("source_sha256"):
             self._admitted_digests[rec["source_sha256"]] = rec
         self._entries.add((tenant, entry))
         self._outstanding_t.setdefault(tenant, 0)
         for j in jobs:
-            if j.name not in self._job_tenant:
-                self._job_tenant[j.name] = tenant
-                if j.name not in self._terminal:
+            name = j if isinstance(j, str) else j.name
+            if name not in self._job_tenant:
+                self._job_tenant[name] = tenant
+                if name not in self._terminal:
                     self._outstanding_t[tenant] += 1
 
     def _mark_terminal(self, name: str, status: str) -> bool:
@@ -772,6 +1012,7 @@ class DaemonService(SweepService):
         self._scan_spool(pending)
 
     def _idle(self, pending: "list[Batch]") -> bool:
+        self._maybe_compact_journal()
         if self.drain_mode or self._stop:
             return False
         now = time.monotonic()
@@ -860,6 +1101,24 @@ class DaemonService(SweepService):
             entry["events"] = record["stats"].get("events_handled")
         self.journal.append(_TERMINAL_TYPES.get(status, "job-done"), **entry)
         self._maybe_prune(record)
+        self._maybe_compact_journal()
+
+    def _maybe_compact_journal(self) -> None:
+        """Compact once the journal's record-file count crosses the
+        cadence — checked at terminal-job and idle seams, so a
+        months-long spool's journal directory stays bounded at
+        ~journal_compact_every files + two snapshots."""
+        if (
+            self.journal_compact_every > 0
+            and self.journal.tail_files >= self.journal_compact_every
+            and self.journal.tail_files != self.journal._compact_stuck_at
+        ):
+            try:
+                self.journal.compact()
+            except OSError as e:  # compaction is maintenance, never fatal
+                slog("warning", 0, "daemon",
+                     f"journal compaction failed ({e}); retrying at the "
+                     "next cadence point")
 
     def _maybe_prune(self, record: dict) -> None:
         """Checkpoint-dir retention: a finished batch's checkpoints are
@@ -959,6 +1218,8 @@ class DaemonService(SweepService):
             ),
             "journal": {
                 "records": self.journal.count,
+                "tail_files": self.journal.tail_files,
+                "compactions": self.journal.compactions,
                 "corrupt_skipped": self.journal.corrupt_skipped,
             },
             # jobs failed during THIS run's journal replay (spec no

@@ -93,7 +93,8 @@ class Manager:
         self.graph = self._load_graph()
         self.hosts = self._expand_hosts()
         self.managed_mode = self._validate_process_specs()
-        if config.general.replicas > 1:
+        self.mesh_plan = self._resolve_mesh()
+        if config.general.replicas > 1 and self.mesh_plan is None:
             # ensemble plane (docs/ensemble.md): scripted models on the
             # device engine only — managed guests are live OS processes
             # and cannot be replicated on device, and the oracle/serial
@@ -123,6 +124,61 @@ class Manager:
         for h in self.hosts:
             if h.ip < 0:
                 h.ip = self.ip.assign_auto(h.index)
+
+    def _resolve_mesh(self):
+        """Validate general.mesh at construction (construction = world
+        validation) and return the resolved MeshPlan, or None. The 2-D
+        mesh plane (docs/parallelism.md "2-D mesh") composes the
+        replica and host-shard axes: the run's replica count is
+        general.replicas when > 1 (each of the R mesh rows vmaps
+        replicas/R locally), else the grid's R."""
+        g = self.config.general
+        if not g.mesh:
+            return None
+        from shadow_tpu.config.options import parse_mesh
+        from shadow_tpu.engine.mesh import MeshPlan
+
+        rows, shards = parse_mesh(g.mesh)
+        if self.managed_mode:
+            raise ValueError(
+                "general.mesh supports scripted-model runs only; managed "
+                "guests are live OS processes and cannot be laid out on a "
+                "device mesh (docs/parallelism.md)"
+            )
+        if self.config.experimental.scheduler != "tpu":
+            raise ValueError(
+                "general.mesh requires experimental.scheduler: tpu (the "
+                "mesh plane dispatches the device engine)"
+            )
+        if g.parallelism > 1:
+            raise ValueError(
+                "general.mesh IS the sharding plane — drop "
+                "general.parallelism > 1 (the mesh's S axis replaces it)"
+            )
+        replicas = g.replicas if g.replicas > 1 else rows
+        if replicas % rows:
+            raise ValueError(
+                f"general.replicas={replicas} must be a multiple of the "
+                f"mesh's replica rows ({g.mesh}): each row carries "
+                "replicas/R vmapped replicas"
+            )
+        if len(self.hosts) % shards:
+            raise ValueError(
+                f"{len(self.hosts)} hosts must divide evenly over the "
+                f"mesh's {shards} host-shard(s) ({g.mesh})"
+            )
+        plan = MeshPlan(replicas=replicas, shards=shards, rows=rows)
+        import jax
+
+        if plan.devices_needed > len(jax.devices()):
+            # fail at construction like every other world error — left
+            # to dispatch time this first surfaces as a misleading
+            # "autotune probe failed" warning before the run dies
+            raise ValueError(
+                f"general.mesh {g.mesh} needs {plan.devices_needed} "
+                f"devices, {len(jax.devices())} visible"
+            )
+        return plan
 
     def _validate_process_specs(self) -> bool:
         """Classify the run as scripted-model or managed-executable mode and
@@ -376,6 +432,11 @@ class Manager:
             # compile. Trajectory-neutral (chunking only groups rounds),
             # so resume/checkpoints are unaffected; probe walls persist
             # in the data directory keyed by the canonicalized config.
+            # The probe runs at the shape the run will actually trace —
+            # the [R, ...] ensemble batch or the RxS mesh layout, not a
+            # single-device stand-in whose wall projection under-
+            # estimates the batched/collective compile and lets the
+            # budget walk pick a too-large rounds_per_chunk.
             import os as _os
 
             from shadow_tpu.engine.state import init_state as _init_state
@@ -383,18 +444,70 @@ class Manager:
 
             from shadow_tpu.engine.round import bootstrap as _bootstrap
 
-            def _probe_state():
-                # built lazily: a warm probe cache (or the rpc floor /
-                # zero budget) answers without ever paying this
-                # full-width init + bootstrap
-                return _bootstrap(
-                    _init_state(
-                        ecfg, model.init(),
+            probe_runner = None
+            probe_shape_key = ""
+            if self.mesh_plan is not None:
+                from shadow_tpu.engine.mesh import (
+                    init_mesh_state,
+                    run_mesh_until,
+                )
+
+                plan_ = self.mesh_plan
+                probe_shape_key = (
+                    f"mesh{plan_.rows}x{plan_.shards}r{plan_.replicas}"
+                )
+
+                def _probe_state():
+                    return init_mesh_state(
+                        ecfg, model, plan_,
+                        cfgo.general.replica_seed_stride,
                         tx_bytes_per_interval=tx_refill,
                         rx_bytes_per_interval=rx_refill,
-                    ),
-                    model, ecfg,
+                    )
+
+                def probe_runner(st, end_ns, rpc, pcfg, ptracker):
+                    run_mesh_until(
+                        st, end_ns, model, tables, pcfg, plan_,
+                        rounds_per_chunk=rpc, tracker=ptracker,
+                    )
+
+            elif cfgo.general.replicas > 1:
+                from shadow_tpu.engine.ensemble import (
+                    init_ensemble_state,
+                    run_ensemble_until,
                 )
+
+                reps = cfgo.general.replicas
+                probe_shape_key = f"r{reps}"
+
+                def _probe_state():
+                    return init_ensemble_state(
+                        ecfg, model, reps,
+                        cfgo.general.replica_seed_stride,
+                        tx_bytes_per_interval=tx_refill,
+                        rx_bytes_per_interval=rx_refill,
+                    )
+
+                def probe_runner(st, end_ns, rpc, pcfg, ptracker):
+                    run_ensemble_until(
+                        st, end_ns, model, tables, pcfg,
+                        rounds_per_chunk=rpc, tracker=ptracker,
+                    )
+
+            else:
+
+                def _probe_state():
+                    # built lazily: a warm probe cache (or the rpc floor
+                    # / zero budget) answers without ever paying this
+                    # full-width init + bootstrap
+                    return _bootstrap(
+                        _init_state(
+                            ecfg, model.init(),
+                            tx_bytes_per_interval=tx_refill,
+                            rx_bytes_per_interval=rx_refill,
+                        ),
+                        model, ecfg,
+                    )
 
             cache_path = None
             if cfgo.general.data_directory:
@@ -408,6 +521,8 @@ class Manager:
                     budget_s=cfgo.experimental.autotune_budget_s,
                     cache_path=cache_path,
                     tracker=tracker,
+                    probe_runner=probe_runner,
+                    shape_key=probe_shape_key,
                 )
             except Exception as e:  # noqa: BLE001 — the autotuner is an
                 # optimization, never a failure: a probe crash (including
@@ -441,7 +556,27 @@ class Manager:
                     )
 
         replicas = cfgo.general.replicas
-        if replicas > 1:
+        if self.mesh_plan is not None:
+            # 2-D mesh plane (docs/parallelism.md "2-D mesh"): replicas
+            # x host-shards on a Mesh(replica, hosts) grid (validated at
+            # construction). Same run() surface as EnsembleRunner, so
+            # the checkpoint/recovery plumbing below composes unchanged;
+            # the stats folds below treat the batch as `replicas` worlds.
+            from shadow_tpu.runtime.mesh import MeshRunner
+
+            replicas = self.mesh_plan.replicas
+            sched = MeshRunner(
+                model,
+                tables,
+                ecfg,
+                plan=self.mesh_plan,
+                seed_stride=cfgo.general.replica_seed_stride,
+                rounds_per_chunk=rounds_per_chunk,
+                tx_bytes_per_interval=tx_refill,
+                rx_bytes_per_interval=rx_refill,
+                watchdog_s=cfgo.experimental.chunk_watchdog_s,
+            )
+        elif replicas > 1:
             # Ensemble plane (docs/ensemble.md): R vmapped replicas in one
             # device program (validated at construction). Same run()
             # surface as TpuScheduler, so the checkpoint/recovery plumbing
@@ -481,9 +616,14 @@ class Manager:
         # and mid-run metrics divide correctly: iters_done sums per-shard
         # (or, after the ensemble flatten, per-replica) drain-loop
         # counts, each covering only H/planes lanes (utils/tracker.py)
-        num_shards = replicas if replicas > 1 else (
-            getattr(sched, "num_devices", 1) or 1
-        )
+        if self.mesh_plan is not None:
+            # R*S drain loops of H/S lanes each: reduces to the ensemble
+            # convention (R) at S=1 and the sharded one (S) at R=1
+            num_shards = self.mesh_plan.replicas * self.mesh_plan.shards
+        else:
+            num_shards = replicas if replicas > 1 else (
+                getattr(sched, "num_devices", 1) or 1
+            )
         if tracker is not None:
             tracker.num_shards = num_shards
         recorder.num_shards = max(1, num_shards)
@@ -519,6 +659,11 @@ class Manager:
                 )
 
         rep_note = f"{replicas} replicas, " if replicas > 1 else ""
+        if self.mesh_plan is not None:
+            rep_note = (
+                f"{replicas} replicas on a {self.mesh_plan.rows}x"
+                f"{self.mesh_plan.shards} mesh, "
+            )
         eng = getattr(sched, "engine", None)
         eng_note = f"engine={eng}, " if eng else ""
         slog("info", 0, "manager", f"starting: {num_hosts} hosts, {rep_note}"
@@ -626,6 +771,12 @@ class Manager:
             # autotuned run is visibly autotuned in sim-stats.json
             results.extra_stats["autotune"] = autotune_plan.as_dict()
         self._fold_chaos(results)
+        if self.mesh_plan is not None:
+            results.extra_stats["mesh"] = {
+                "replicas": self.mesh_plan.replicas,
+                "shards": self.mesh_plan.shards,
+                "rows": self.mesh_plan.rows,
+            }
         host_tensors = None
         if replicas > 1:
             # per-replica sections + the aggregate mean/stddev/CI block
@@ -683,7 +834,7 @@ class Manager:
             hs = host_tensors if host_tensors is not None else host_stats(
                 final_state
             )
-            if self.config.general.replicas > 1:
+            if self.config.general.replicas > 1 or self.mesh_plan is not None:
                 # ensemble states fetch [R, H] tensors: flatten them to
                 # the shape the host-side fold expects (exact per-replica
                 # splits live in the `ensemble` stats block)
@@ -769,10 +920,10 @@ class Manager:
             heartbeat_ns=g.heartbeat_interval_ns if g.tracker else 0,
             trace_path=g.trace_file,
             clear_line=progress.clear if progress is not None else None,
-            # per-host heartbeat lines name one host per row; an ensemble
-            # run's per-host tensors are [R, H], so heartbeats stay off
-            # there (aggregates still ride the probe; docs/ensemble.md)
-            host_heartbeats=g.tracker and g.replicas <= 1,
+            # per-host heartbeat lines name one host per row; ensemble
+            # and mesh runs' per-host tensors are [R, H], so heartbeats
+            # stay off there (aggregates still ride the probe)
+            host_heartbeats=g.tracker and g.replicas <= 1 and not g.mesh,
             counters=g.tracker,
         )
 

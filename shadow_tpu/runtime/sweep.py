@@ -108,7 +108,8 @@ class Batch:
         }
 
 
-def pack_jobs(jobs: "list[SweepJob]", capacity: int = 8) -> "list[Batch]":
+def pack_jobs(jobs: "list[SweepJob]", capacity: int = 8,
+              mesh_rows: int = 1) -> "list[Batch]":
     """The packing decision, as a pure function of the job list (unit-
     testable without devices — tests/test_sweep_pack.py).
 
@@ -119,9 +120,19 @@ def pack_jobs(jobs: "list[SweepJob]", capacity: int = 8) -> "list[Batch]":
     is replica r = base + r*stride (rng.replica_keys), so only an AP of
     seeds can ride one [R] program — capped at `capacity` replicas.
     Deterministic: equal inputs always produce the same batch list, in
-    priority-then-arrival order."""
+    priority-then-arrival order.
+
+    `mesh_rows` is the mesh-slice capacity a 2-D sweep teaches the
+    packer (SweepSpec.mesh, docs/parallelism.md "2-D mesh"): batch
+    sizes are cut at the largest multiple of the mesh's replica rows
+    that fits `capacity`, so full batches fill whole mesh rows and the
+    device grid never idles a row on an avoidably ragged batch. A
+    group's remainder (or capacity < rows) still packs — the runner
+    degrades that batch's rows (MeshPlan.for_batch)."""
     if capacity < 1:
         raise ValueError("capacity must be >= 1")
+    if mesh_rows > 1 and capacity > mesh_rows:
+        capacity -= capacity % mesh_rows
     groups: "dict[tuple, list[SweepJob]]" = {}
     for j in jobs:
         groups.setdefault((j.group_key, j.priority, j.arrival_ns), []).append(j)
@@ -206,10 +217,21 @@ class SweepService:
     def __init__(self, spec: SweepSpec, metrics_file: "str | None" = None,
                  metrics_prom: "str | None" = None, cache=None):
         self.spec = spec
+        # 2-D mesh batches (SweepSpec.mesh): (replica rows, host shards)
+        # of the grid every batch dispatches on, or None for the
+        # single-device ensemble plane
+        self.mesh = None
+        if getattr(spec, "mesh", None):
+            from shadow_tpu.config.options import parse_mesh
+
+            self.mesh = parse_mesh(spec.mesh)
         # injectable cache: the daemon passes a PersistentCompileCache
         # so executables survive restarts (runtime/compile_cache.py)
         self.cache = cache if cache is not None else CompileCache()
-        self.batches = pack_jobs(spec.jobs, spec.capacity)
+        self.batches = pack_jobs(
+            spec.jobs, spec.capacity,
+            mesh_rows=self.mesh[0] if self.mesh else 1,
+        )
         self.clock_ns = 0  # virtual clock: cumulative sim-time executed
         self.job_progress: "dict[str, dict]" = {
             j.name: {"now_ns": 0, "events": 0} for j in spec.jobs
@@ -264,6 +286,12 @@ class SweepService:
                     "experimental.scheduler: tpu (jobs batch through the "
                     "vmapped ensemble plane)"
                 )
+            if self.mesh is not None and len(mgr.hosts) % self.mesh[1]:
+                raise ValueError(
+                    f"sweep.jobs.{j.entry}: {len(mgr.hosts)} hosts must "
+                    f"divide evenly over the sweep mesh's {self.mesh[1]} "
+                    f"host-shard(s) ({self.spec.mesh})"
+                )
             self._group_mgr[j.group_key] = mgr
 
     def enqueue(self, jobs: "list[SweepJob]", tenant: "str | None" = None,
@@ -278,7 +306,10 @@ class SweepService:
         for j in jobs:
             self.job_progress.setdefault(j.name, {"now_ns": 0, "events": 0})
             self.job_series.setdefault(j.name, [])
-        batches = pack_jobs(jobs, self.spec.capacity)
+        batches = pack_jobs(
+            jobs, self.spec.capacity,
+            mesh_rows=self.mesh[0] if self.mesh else 1,
+        )
         for b in batches:
             b.index = len(self.batches)
             b.tenant = tenant
@@ -298,6 +329,7 @@ class SweepService:
             "sweep": self.spec.name,
             "jobs": len(self.spec.jobs),
             "capacity": self.spec.capacity,
+            **({"mesh": self.spec.mesh} if self.mesh else {}),
             "batches": [b.describe() for b in self.batches],
         }
 
@@ -573,6 +605,17 @@ class SweepService:
         g["replicas"] = batch.replicas
         g["replica_seed_stride"] = batch.stride
         g["data_directory"] = self._batch_dir(batch)
+        if self.mesh is not None:
+            # the EFFECTIVE grid this batch dispatches on (rows degrade
+            # for ragged/split batches — MeshPlan.for_batch), folded in
+            # so the config fingerprint pins checkpoints to the mesh
+            # shape they were written under
+            from shadow_tpu.engine.mesh import MeshPlan
+
+            plan = MeshPlan.for_batch(
+                batch.replicas, self.mesh[0], self.mesh[1]
+            )
+            g["mesh"] = f"{plan.rows}x{plan.shards}"
         return ConfigOptions.from_dict(raw)
 
     def _batch_dir(self, batch: Batch) -> str:
@@ -633,20 +676,46 @@ class SweepService:
                 series.append({"clock_ns": self.clock_ns, **point})
                 del series[:-64]
 
-        runner = EnsembleRunner(
-            world.model,
-            world.tables,
-            ecfg,
-            num_replicas=batch.replicas,
-            seed_stride=batch.stride,
-            rounds_per_chunk=cfgo.experimental.rounds_per_chunk,
-            tx_bytes_per_interval=world.tx_refill,
-            rx_bytes_per_interval=world.rx_refill,
-            compile_cache=self.cache,
-            cache_key=batch.group_key,
-            on_rows=on_rows,
-            watchdog_s=cfgo.experimental.chunk_watchdog_s,
-        )
+        if self.mesh is not None:
+            # 2-D mesh batch (docs/parallelism.md "2-D mesh"): the same
+            # [R] job batch dispatched over Mesh(replica, hosts) — the
+            # compile cache keys the executable under the mesh shape
+            # (MeshRunner._launch_for), so N same-shape mesh batches
+            # still pay one XLA compile
+            from shadow_tpu.engine.mesh import MeshPlan
+            from shadow_tpu.runtime.mesh import MeshRunner
+
+            runner = MeshRunner(
+                world.model,
+                world.tables,
+                ecfg,
+                plan=MeshPlan.for_batch(
+                    batch.replicas, self.mesh[0], self.mesh[1]
+                ),
+                seed_stride=batch.stride,
+                rounds_per_chunk=cfgo.experimental.rounds_per_chunk,
+                tx_bytes_per_interval=world.tx_refill,
+                rx_bytes_per_interval=world.rx_refill,
+                compile_cache=self.cache,
+                cache_key=batch.group_key,
+                on_rows=on_rows,
+                watchdog_s=cfgo.experimental.chunk_watchdog_s,
+            )
+        else:
+            runner = EnsembleRunner(
+                world.model,
+                world.tables,
+                ecfg,
+                num_replicas=batch.replicas,
+                seed_stride=batch.stride,
+                rounds_per_chunk=cfgo.experimental.rounds_per_chunk,
+                tx_bytes_per_interval=world.tx_refill,
+                rx_bytes_per_interval=world.rx_refill,
+                compile_cache=self.cache,
+                cache_key=batch.group_key,
+                on_rows=on_rows,
+                watchdog_s=cfgo.experimental.chunk_watchdog_s,
+            )
 
         start_state = None
         start_now = 0
@@ -940,6 +1009,7 @@ class SweepService:
         return {
             "sweep": self.spec.name,
             "output_dir": self.spec.output_dir,
+            **({"mesh": self.spec.mesh} if self.mesh else {}),
             "wall_seconds": round(wall, 4),
             "service_clock_ns": self.clock_ns,
             "jobs_total": len(self.spec.jobs),

@@ -347,3 +347,84 @@ def test_daemon_admission_control(tmp_path, shared_cache):
     assert any(
         r["type"] == "reject" and r["reason"] == "duplicate" for r in recs
     )
+
+
+def test_daemon_journal_compaction_survives_kill(tmp_path, shared_cache):
+    """Journal compaction (ROADMAP item 5 follow-on): terminal records
+    fold into a sha-digested snapshot + tail so the journal stops
+    growing one file per record — and a SIGKILL injected the instant a
+    snapshot commits (before the covered records are deleted) loses
+    nothing: restart replays snapshot + tail, ignores the stale
+    already-covered records, and finishes every admitted job with
+    standalone-identical stats."""
+    spool = tmp_path / "spool"
+    run_submit(
+        str(spool), str(_spec(tmp_path, "a.yaml", "alice", "ph", [0, 1]))
+    )
+    r = _serve_subprocess(
+        spool, "--journal-compact-every", "3",
+        "--chaos-fault", "daemon-kill:target=compact",
+        cache_dir=shared_cache,
+    )
+    assert r.returncode in (-9, 137), r.stderr[-500:]
+    jdir = spool / "journal"
+    snaps = sorted(jdir.glob("snap-*.json"))
+    assert snaps, "the kill fires only AFTER a snapshot committed"
+    snap = json.loads(snaps[-1].read_text())
+    through = snap["through_seq"]
+    # the kill landed between commit and deletion: stale covered records
+    # are still on disk — replay must ignore them, not double-apply
+    stale = [
+        p for p in jdir.glob("r*.json")
+        if int(p.name[1:9]) <= through
+    ]
+    assert stale, "deletions must not have run before the kill"
+
+    # restart on the same spool: snapshot + tail replays, jobs finish
+    assert run_serve(
+        str(spool), drain=True, cache_dir=shared_cache,
+        journal_compact_every=3,
+    ) == 0
+    m = json.loads((spool / "daemon-manifest.json").read_text())
+    assert m["jobs_failed"] == 0 and m["jobs_quarantined"] == 0
+    assert m["daemon"]["outstanding_jobs"] == 0
+    t = m["daemon"]["tenants"]["alice"]
+    assert t["admitted"] == 2 and t["done"] == 2
+    done = {
+        r["job"] for r in _journal(spool) if r["type"] == "job-done"
+    } | set(
+        j for s in jdir.glob("snap-*.json")
+        for j, st in json.loads(s.read_text())["terminal"].items()
+        if st == "done"
+    )
+    assert done == {"alice.ph-s0", "alice.ph-s1"}
+    job = _stats(spool / "jobs" / "alice.ph-s0" / "sim-stats.json")
+    assert job == _standalone(tmp_path, 0)
+
+    # growth bound: another tenant's round trip through the same spool
+    # compacts again — record files stay at ~cadence scale and the
+    # finished admission folds to digests (its spec lives in accepted/)
+    run_submit(
+        str(spool), str(_spec(tmp_path, "b.yaml", "bob", "ph", [3, 4]))
+    )
+    assert run_serve(
+        str(spool), drain=True, cache_dir=shared_cache,
+        journal_compact_every=3,
+    ) == 0
+    assert len(list(jdir.glob("r*.json"))) <= 6
+    assert len(list(jdir.glob("snap-*.json"))) <= 2  # keep-2 retention
+    newest = json.loads(
+        sorted(jdir.glob("snap-*.json"))[-1].read_text()
+    )
+    folded = {f["entry"] for f in newest["folded_admits"]}
+    assert "ph" in folded
+    assert all("spec" not in f for f in newest["folded_admits"])
+    # compaction is idempotent against the accepted/ rescan: no
+    # re-journaled (recovered=True) admissions after folding
+    assert not any(
+        r.get("recovered") for r in _journal(spool) if r["type"] == "admit"
+    )
+    m = json.loads((spool / "daemon-manifest.json").read_text())
+    assert m["daemon"]["tenants"]["bob"]["done"] == 2
+    # alice's history survived two compactions intact
+    assert m["daemon"]["tenants"]["alice"]["done"] == 2

@@ -245,6 +245,75 @@ def test_bench_history_tracks_overlay_metrics(tmp_path):
     assert "overlay.onion@384h: REGRESSION" in r.stdout
 
 
+def test_bench_history_tracks_mesh_metrics(tmp_path):
+    """ISSUE 14 satellite: detail.mesh per-grid sim_s_per_wall_s gets
+    the same best-prior regression flagging as the headline metric,
+    keyed by plane + grid + world size ("mesh2x4@128h") so mesh rows,
+    their Rx1/1xS baselines, and different world sizes each track their
+    own history."""
+
+    def _round(n, value, detail_extra):
+        (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps({
+            "n": n,
+            "parsed": {
+                "metric": "m", "value": value,
+                "detail": {
+                    "config": {"hosts": 128},
+                    "main": {"wall_s": 1.0},
+                    "attempts": [],
+                    **detail_extra,
+                },
+            },
+        }))
+
+    _round(1, 0.10, {})  # pre-mesh round: no block at all
+    _round(2, 0.12, {"mesh": {"hosts": 128, "rows": [
+        {"kind": "ensemble", "grid": "4x1", "sim_s_per_wall_s": 0.4},
+        {"kind": "sharded", "grid": "1x8", "sim_s_per_wall_s": 0.2},
+        {"kind": "mesh", "grid": "2x4", "sim_s_per_wall_s": 0.6},
+        {"kind": "mesh", "grid": "4x2", "error": "boom"},
+    ]}})
+
+    sys.path.insert(0, str(TOOLS))
+    try:
+        import bench_history as bh
+    finally:
+        sys.path.pop(0)
+
+    rounds = bh.load_rounds(str(tmp_path))
+    assert rounds[0]["mesh"] is None
+    assert rounds[1]["mesh"] == {
+        "ensemble4x1@128h": 0.4, "sharded1x8@128h": 0.2,
+        "mesh2x4@128h": 0.6,
+    }
+
+    v = bh.mesh_check(rounds)  # newest round vs (empty) history
+    assert v["regression"] is False
+    assert v["grids"]["mesh2x4@128h"]["note"] == "no prior round measured this"
+
+    # an in-flight slide on one grid flags it; a fresh grid never does;
+    # a grid that stops being published flags as null (the r05 policy)
+    v = bh.mesh_check(rounds, current={
+        "mesh2x4@128h": 0.3, "mesh4x2@128h": 0.9,
+    })
+    assert v["grids"]["mesh2x4@128h"]["regression"] is True
+    assert v["grids"]["mesh4x2@128h"]["regression"] is False
+    assert v["grids"]["ensemble4x1@128h"]["regression"] is True  # missing
+    assert v["regression"] is True
+
+    _round(3, 0.13, {"mesh": {"hosts": 128, "rows": [
+        {"kind": "mesh", "grid": "2x4", "sim_s_per_wall_s": 0.1},
+        {"kind": "ensemble", "grid": "4x1", "sim_s_per_wall_s": 0.4},
+        {"kind": "sharded", "grid": "1x8", "sim_s_per_wall_s": 0.2},
+    ]}})
+    r = subprocess.run(
+        [sys.executable, str(TOOLS / "bench_history.py"), str(tmp_path)],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 1
+    assert "mesh.mesh2x4@128h: REGRESSION" in r.stdout
+
+
 def test_shm_cleanup(tmp_path):
     import mmap
     import os

@@ -93,6 +93,24 @@ def _overlay_row(detail: dict) -> "dict | None":
     return row or None
 
 
+def _mesh_row(detail: dict) -> "dict | None":
+    """Per-grid 2-D mesh throughput a round published: detail.mesh
+    (the mesh trial, ISSUE 14) as {"<kind><grid>@Nh": sim_s_per_wall_s}
+    — e.g. "mesh2x4@128h" with its "ensemble4x1@128h" / "sharded1x8@128h"
+    baselines. Keyed by plane, grid AND world size so salvaged partial
+    rounds never compare across shapes. None when the round measured no
+    mesh row."""
+    ms = detail.get("mesh") or {}
+    hosts = ms.get("hosts", "?")
+    row = {}
+    for r in ms.get("rows", []):
+        kind, grid = r.get("kind"), r.get("grid")
+        v = r.get("sim_s_per_wall_s")
+        if kind and grid and v is not None:
+            row[f"{kind}{grid}@{hosts}h"] = v
+    return row or None
+
+
 def _metric_verdicts(rounds_key: str, keys, history, current,
                      latest_round) -> dict:
     """The shared best-prior/TOLERANCE verdict core behind service_check
@@ -168,6 +186,24 @@ def overlay_check(rounds: "list[dict]",
     return out
 
 
+def mesh_check(rounds: "list[dict]",
+               current: "dict | None" = None) -> dict:
+    """The detail.mesh trajectory verdicts — each (plane, grid, size)
+    row's sim_s_per_wall_s gets the SAME best-prior/TOLERANCE flagging
+    as the headline metric. `current` is an in-flight
+    {"<kind><grid>@Nh": rate} from bench.py; None compares the newest
+    recorded round against the rest."""
+    history, current, latest_round = _pop_latest("mesh", rounds, current)
+    keys = sorted(
+        set(current or {}) | {m for r in history for m in r["mesh"]}
+    )
+    out, verdicts = _metric_verdicts(
+        "mesh", keys, history, current, latest_round
+    )
+    out["grids"] = verdicts
+    return out
+
+
 def service_check(rounds: "list[dict]",
                   current: "dict | None" = None) -> dict:
     """The detail.service trajectory verdicts — jobs_per_hour and
@@ -212,6 +248,7 @@ def load_rounds(root: str = ".") -> "list[dict]":
             "partial": bool(main.get("partial")),
             "service": _service_row(detail),
             "overlay": _overlay_row(detail),
+            "mesh": _mesh_row(detail),
             "attempts": [
                 _attempt_row(a) for a in detail.get("attempts", [])
             ],
@@ -306,10 +343,11 @@ def main(argv=None) -> int:
     verdict = regression_check(rounds, current=args.current)
     svc = service_check(rounds)
     ovl = overlay_check(rounds)
+    msh = mesh_check(rounds)
     if args.json:
         print(json.dumps(
             {"rounds": rounds, "verdict": verdict, "service": svc,
-             "overlay": ovl}, indent=2
+             "overlay": ovl, "mesh": msh}, indent=2
         ))
     else:
         print(trajectory_table(rounds))
@@ -320,10 +358,14 @@ def main(argv=None) -> int:
         for model, v in ovl["models"].items():
             if v.get("latest") is not None or v.get("best_prior") is not None:
                 print(f"overlay.{model}: {v['note']}")
+        for grid, v in msh["grids"].items():
+            if v.get("latest") is not None or v.get("best_prior") is not None:
+                print(f"mesh.{grid}: {v['note']}")
     return 1 if (
         verdict.get("regression")
         or svc.get("regression")
         or ovl.get("regression")
+        or msh.get("regression")
     ) else 0
 
 

@@ -689,6 +689,107 @@ def profile_adaptivity(hosts: int = 0):
     return out
 
 
+def profile_mesh_collectives(hosts: int = 0, sim_s: float = 0.1):
+    """Part 8 (2-D mesh round, docs/parallelism.md "2-D mesh"): the
+    per-round cost of the host-axis collectives vs shard count.
+
+    The same single-replica phold world runs through the mesh chunk
+    path (engine/mesh.py, 1xS grids) at every shard count that divides
+    the visible devices; S=1 has no collectives at all, so the
+    per-live-round wall delta vs the S=1 row IS the window-pmin +
+    exchange-all_gather cost at that shard count (plus shard_map
+    overheads — exactly the bundle a round pays). Trajectories are
+    leaf-identical across S (tests/test_mesh.py), so rounds_live is the
+    shared denominator. Also prints each grid's compile wall — the
+    quantity the --autotune mesh-shape probe now projects (a
+    single-device probe would report the S=1 column for every grid)."""
+    import jax
+    import numpy as np
+
+    from shadow_tpu.engine import EngineConfig, init_state
+    from shadow_tpu.engine.mesh import MeshPlan, init_mesh_state, run_mesh_until
+    from shadow_tpu.engine.round import bootstrap
+    from shadow_tpu.graph import NetworkGraph, compute_routing
+    from shadow_tpu.models import PholdModel
+    from shadow_tpu.simtime import NS_PER_MS, NS_PER_SEC
+
+    ndev = jax.device_count()
+    h = hosts or (10240 if jax.default_backend() == "tpu" else 512)
+    h -= h % ndev  # every shard count below must divide evenly
+    graph = NetworkGraph.from_gml(
+        "\n".join(
+            [
+                "graph [",
+                "  directed 0",
+                *[f"  node [ id {i} ]" for i in range(4)],
+                *[
+                    f'  edge [ source {i} target {i} latency "1 ms" ]'
+                    for i in range(4)
+                ],
+                *[
+                    f'  edge [ source {i} target {j} latency "3 ms" ]'
+                    for i in range(4)
+                    for j in range(i + 1, 4)
+                ],
+                "]",
+            ]
+        )
+    )
+    tables = compute_routing(graph).with_hosts([i % 4 for i in range(h)])
+    cfg = EngineConfig(
+        num_hosts=h,
+        runahead_ns=graph.min_latency_ns(),
+        seed=13,
+        tracker=True,
+    )
+    model = PholdModel(
+        num_hosts=h, min_delay_ns=1 * NS_PER_MS, max_delay_ns=8 * NS_PER_MS
+    )
+    end = int(sim_s * NS_PER_SEC)
+    shard_counts = [s for s in (1, 2, 4, 8, 16) if s <= ndev and ndev % s == 0]
+    out = {"hosts": h, "sim_s": sim_s, "devices": ndev, "rows": []}
+    base_per_round = None
+    for s_count in shard_counts:
+        plan = MeshPlan(replicas=1, shards=s_count, rows=1)
+        row = {"shards": s_count}
+        try:
+            st0 = init_mesh_state(cfg, model, plan)
+            t0 = time.perf_counter()
+            st = run_mesh_until(
+                st0, end, model, tables, cfg, plan, rounds_per_chunk=16
+            )
+            jax.block_until_ready(st.events_handled)
+            row["compile_plus_run_s"] = round(time.perf_counter() - t0, 3)
+            st0 = init_mesh_state(cfg, model, plan)
+            t0 = time.perf_counter()
+            st = run_mesh_until(
+                st0, end, model, tables, cfg, plan, rounds_per_chunk=16
+            )
+            jax.block_until_ready(st.events_handled)
+            wall = time.perf_counter() - t0
+            rounds_live = int(np.asarray(st.tracker.rounds_live).max())
+            per_round_ms = wall / max(rounds_live, 1) * 1e3
+            row.update(
+                wall_s=round(wall, 4),
+                rounds_live=rounds_live,
+                per_round_ms=round(per_round_ms, 3),
+                compile_s=round(row["compile_plus_run_s"] - wall, 3),
+            )
+            if s_count == 1:
+                # the baseline is the collective-FREE row specifically —
+                # an errored S=1 must not silently shift it to S=2
+                base_per_round = per_round_ms
+            elif base_per_round is not None:
+                row["collective_ms_per_round"] = round(
+                    per_round_ms - base_per_round, 3
+                )
+        except Exception as e:  # noqa: BLE001 — publish the rows that ran
+            row["error"] = str(e)[:300]
+        out["rows"].append(row)
+        print(json.dumps({"mesh_collectives_row": row}), flush=True)
+    return out
+
+
 def main():
     import jax
 
@@ -706,6 +807,7 @@ def main():
     out["ensemble"] = profile_ensemble(min(reps, 3))
     out["sweep"] = profile_sweep()
     out["adaptivity"] = profile_adaptivity()
+    out["mesh_collectives"] = profile_mesh_collectives()
     print(json.dumps(out), flush=True)
 
 
