@@ -887,6 +887,111 @@ def _measure_mesh(num_hosts: int, sim_sec: float, replicas: int = 4):
     return out
 
 
+def _measure_elastic(num_hosts: int, sim_sec: float, replicas: int = 2):
+    """Elastic-mesh trial (runs in a disposable child, role=elastic;
+    docs/parallelism.md "Elastic mesh"): the wall cost of surviving one
+    device loss — the SAME R-replica phold batch run fault-free on the
+    full grid, then with a chaos `device-loss` injected mid-run, which
+    rolls back, re-plans onto the degraded grid (MeshPlan.degraded),
+    recompiles and replays leaf-exact. `reshape_replay_wall_s` =
+    faulted wall − fault-free wall: what one reshape rung costs end to
+    end (rollback + recompile + replay), the number
+    tools/bench_history.py tracks as detail.elastic (lower is
+    better)."""
+    import jax
+    import numpy as np
+
+    from shadow_tpu.engine import EngineConfig
+    from shadow_tpu.engine.mesh import MeshPlan
+    from shadow_tpu.graph import NetworkGraph, compute_routing
+    from shadow_tpu.models.phold import PholdModel
+    from shadow_tpu.runtime import chaos
+    from shadow_tpu.runtime.mesh import MeshRunner
+    from shadow_tpu.runtime.recovery import RecoveryPolicy
+    from shadow_tpu.simtime import NS_PER_MS
+
+    end = int(sim_sec * NS_PER_SEC)
+    n_nodes = 8
+    lines = ["graph [", "  directed 0"]
+    for i in range(n_nodes):
+        lines.append(f"  node [ id {i} ]")
+        lines.append(f'  edge [ source {i} target {i} latency "1 ms" ]')
+        lines.append(
+            f'  edge [ source {i} target {(i + 1) % n_nodes} latency "3 ms" ]'
+        )
+    lines.append("]")
+    graph = NetworkGraph.from_gml("\n".join(lines))
+    tables = compute_routing(graph).with_hosts(
+        [i % n_nodes for i in range(num_hosts)]
+    )
+    cfg = EngineConfig(
+        num_hosts=num_hosts, runahead_ns=graph.min_latency_ns(), seed=7
+    )
+    model = PholdModel(
+        num_hosts=num_hosts,
+        min_delay_ns=1 * NS_PER_MS,
+        max_delay_ns=8 * NS_PER_MS,
+    )
+    ndev = jax.device_count()
+    shards = max(s for s in (1, 2, 4) if s <= ndev and num_hosts % s == 0)
+    plan = MeshPlan(replicas=replicas, shards=shards, rows=1)
+    grid = f"{plan.rows}x{plan.shards}"
+    out = {
+        "hosts": num_hosts,
+        "sim_sec": sim_sec,
+        "replicas": replicas,
+        "grid": grid,
+        "devices": ndev,
+    }
+
+    # the faulted and fault-free runs go through the IDENTICAL harness
+    # (MeshRunner + the same RecoveryPolicy, which prices the retained-
+    # snapshot taps into both sides) — the first clean run warms the
+    # full-grid executable, the second is the timed baseline, so
+    # faulted − clean isolates exactly the reshape rung's cost
+    # (rollback + degraded-grid compile + replay), not snapshot or
+    # harness overhead
+    policy = RecoveryPolicy(max_recoveries=2, snapshot_interval_chunks=4)
+
+    def _clean_run():
+        runner = MeshRunner(
+            model, tables, cfg, plan=plan, rounds_per_chunk=32
+        )
+        s = runner.run(end, recovery=policy)
+        jax.block_until_ready(s.events_handled)
+        return s
+
+    _clean_run()  # warm the full-grid executable
+    t0 = time.perf_counter()
+    clean = _clean_run()
+    clean_wall = time.perf_counter() - t0
+    clean_events = int(np.asarray(clean.events_handled).sum())
+
+    runner = MeshRunner(model, tables, cfg, plan=plan, rounds_per_chunk=32)
+    fault = chaos.FaultPlan(
+        seed=0, faults=[{"kind": "device-loss", "at": 1, "target": "0"}]
+    )
+    t0 = time.perf_counter()
+    with chaos.installed(fault):
+        final = runner.run(end, recovery=policy)
+    jax.block_until_ready(final.events_handled)
+    faulted_wall = time.perf_counter() - t0
+    out.update(
+        fault_free_wall_s=round(clean_wall, 4),
+        faulted_wall_s=round(faulted_wall, 4),
+        reshape_replay_wall_s=round(max(faulted_wall - clean_wall, 0.0), 4),
+        grid_effective=f"{runner.plan.rows}x{runner.plan.shards}",
+        degradations=runner.mesh_degradations,
+        events=int(np.asarray(final.events_handled).sum()),
+        # the exactness spot check: a degraded run must publish the
+        # fault-free totals or the row is meaningless
+        leaf_exact_events=(
+            int(np.asarray(final.events_handled).sum()) == clean_events
+        ),
+    )
+    return out
+
+
 def _measure_sweep(num_hosts: int, jobs: int = 8, capacity: int = 4):
     """Sweep trial (runs in a disposable child, role=sweep): an 8-job
     phold seed sweep through the PRODUCTION SweepService
@@ -1200,6 +1305,11 @@ def main():
     if role == "sweep":
         sh = int(os.environ.get("SHADOW_TPU_BENCH_SWEEP_HOSTS", 128))
         print(json.dumps({"sweep": _measure_sweep(sh)}))
+        return
+    if role == "elastic":
+        eh = int(os.environ.get("SHADOW_TPU_BENCH_ELASTIC_HOSTS", 128))
+        es = float(os.environ.get("SHADOW_TPU_BENCH_ELASTIC_SIMSEC", 0.1))
+        print(json.dumps({"elastic": _measure_elastic(eh, es)}))
         return
     if role == "overlay":
         oh = int(os.environ.get("SHADOW_TPU_BENCH_OVERLAY_HOSTS", 96))
@@ -1585,6 +1695,51 @@ def main():
                 "error": "timeout",
             }
 
+    # ---- elastic trial (elastic-mesh round, docs/parallelism.md
+    # "Elastic mesh"): the wall cost of one device-loss reshape rung —
+    # rollback + re-plan + recompile + replay vs the fault-free run of
+    # the same batch. SHADOW_TPU_BENCH_ELASTIC=0 disables. ---------------
+    elastic = None
+    if os.environ.get("SHADOW_TPU_BENCH_ELASTIC", "1") != "0" and _time_left() > 150:
+        elh = int(
+            os.environ.get(
+                "SHADOW_TPU_BENCH_ELASTIC_HOSTS", 1024 if tpu_up else 128
+            )
+        )
+        env_extra = dict(
+            SHADOW_TPU_BENCH_ROLE="elastic",
+            SHADOW_TPU_BENCH_ELASTIC_HOSTS=elh,
+        )
+        elastic_env = (
+            _child_env(**env_extra) if tpu_up else _cpu_env(**env_extra)
+        )
+        if not tpu_up:
+            # like the mesh trial: the CPU rung needs the virtual
+            # multi-device mesh or there is nothing to degrade from
+            elastic_env["XLA_FLAGS"] = (
+                elastic_env.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=elastic_env,
+                capture_output=True,
+                text=True,
+                timeout=600 if tpu_up else min(420.0, max(_time_left(), 90.0)),
+            )
+            for ln in r.stdout.strip().splitlines():
+                try:
+                    obj = json.loads(ln)
+                except ValueError:
+                    continue
+                if "elastic" in obj:
+                    elastic = obj["elastic"]
+            if elastic is None:
+                elastic = {"error": f"rc={r.returncode}: {r.stderr[-300:]}"}
+        except subprocess.TimeoutExpired:
+            elastic = {"error": "timeout"}
+
     # ---- sweep trial (sweep-scheduler round, docs/service.md): 8-job
     # phold seed sweep through the production SweepService — jobs/hour
     # and the compile-cache hit rate (two R=4 batches, one compile).
@@ -1775,6 +1930,17 @@ def main():
             }
             if cur:
                 history["mesh"] = bh.mesh_check(rounds, current=cur)
+        if elastic and elastic.get("reshape_replay_wall_s") is not None:
+            # the reshape-replay wall row, keyed by grid AND world size
+            # (lower is better — elastic_check inverts the direction)
+            history["elastic"] = bh.elastic_check(
+                rounds,
+                current={
+                    f"reshape_replay_wall_s@{elastic.get('grid', '?')}"
+                    f"@{elastic.get('hosts', '?')}h":
+                        elastic["reshape_replay_wall_s"]
+                },
+            )
         print(json.dumps({"bench_history": history}), flush=True)
     except Exception as e:  # noqa: BLE001 — trajectory is advisory
         print(json.dumps({"bench_history": {"error": str(e)[:200]}}),
@@ -1798,6 +1964,7 @@ def main():
                     **({"overlay": overlay} if overlay else {}),
                     **({"sweep": sweep} if sweep else {}),
                     **({"service": service} if service else {}),
+                    **({"elastic": elastic} if elastic else {}),
                     **({"cpu_xla": cpu_xla} if cpu_xla else {}),
                     **({"history": history} if history else {}),
                     "attempts": [

@@ -24,6 +24,19 @@ mismatched replica count must fail HERE with a clear error, never as a
 shape mismatch deep in jax); `engine`/`pump_k` stay in (the engines are
 bit-identical by contract, but pinning them keeps a resumed run on the
 exact executable the checkpoint was written under).
+
+`general.mesh` is OUT (the elastic-mesh contract, docs/parallelism.md
+"Elastic mesh"): the grid is execution geometry, not a trajectory knob
+— every replica slice is leaf-identical to its single-device run on any
+RxS layout, so a checkpoint written on one grid must resume on any
+other (including pure ensemble / pure sharded / single-device). What
+the mesh DOES pin is the effective replica count — a bare `mesh: 2x4`
+runs R=2 replicas — so fingerprint_dict normalizes `general.replicas`
+to the effective count before dropping the grid: a resume that would
+change the number of simulated worlds still refuses loudly, while one
+that only re-lays the same worlds out does not. The grid a checkpoint
+was written under travels as layout METADATA instead
+(runtime/checkpoint.py `mesh` meta key).
 """
 
 from __future__ import annotations
@@ -75,6 +88,22 @@ def fingerprint_dict(config) -> dict:
     g = d.get("general", {})
     for k in _DISPLAY_GENERAL_KEYS:
         g.pop(k, None)
+    # the 2-D mesh grid is execution GEOMETRY (module docstring):
+    # normalize it to None — NOT pop it — after folding its one
+    # trajectory-relevant effect (a bare `mesh: RxS` runs R replicas,
+    # Manager._resolve_mesh) into general.replicas. "2x4" and
+    # "--replicas 2 --mesh 1x2" then hash as the same two simulated
+    # worlds while "--replicas 3" still refuses; and because every
+    # pre-elastic config already serialized `mesh: null`, normalizing
+    # (rather than removing) the key keeps every NON-mesh fingerprint
+    # byte-identical across the upgrade — existing checkpoints, daemon
+    # spools, and persistent compile-cache keys stay valid.
+    mesh = g.get("mesh")
+    if mesh is not None and g.get("replicas", 1) <= 1:
+        from shadow_tpu.config.options import parse_mesh
+
+        g["replicas"] = parse_mesh(mesh)[0]
+    g["mesh"] = None
     e = d.get("experimental", {})
     for k in _RECOVERY_EXPERIMENTAL_KEYS:
         e.pop(k, None)
@@ -83,6 +112,24 @@ def fingerprint_dict(config) -> dict:
     # so its checkpoints must resume under either config
     d.pop("chaos", None)
     return d
+
+
+def fingerprint_diff(saved: dict, current: dict, prefix: str = "") -> "list[str]":
+    """Dotted paths whose values differ between two fingerprint_dicts —
+    the resume-refusal UX seam (runtime/checkpoint.py): a mismatch names
+    the offending keys (`general.seed: 1 != 2`) instead of dumping two
+    opaque hashes. Lists compare wholesale (host specs); missing keys
+    print as `<absent>`."""
+    out = []
+    for k in sorted(set(saved) | set(current)):
+        path = f"{prefix}{k}"
+        a = saved.get(k, "<absent>")
+        b = current.get(k, "<absent>")
+        if isinstance(a, dict) and isinstance(b, dict):
+            out.extend(fingerprint_diff(a, b, prefix=f"{path}."))
+        elif a != b:
+            out.append(f"{path}: {a!r} != {b!r}")
+    return out
 
 
 def config_fingerprint(config, *, exclude_seed: bool = False) -> str:

@@ -47,6 +47,11 @@ FAULT_KINDS = (
     "daemon-kill",
     "spool-corrupt",
     "cache-corrupt",
+    # elastic-mesh fault (docs/robustness.md "Device loss"): simulate a
+    # device dropping out at chunk-launch ordinal `at` (`target=N` names
+    # the lost jax device id) — exercises mesh degradation: roll back,
+    # re-plan onto the surviving grid, replay leaf-exact
+    "device-loss",
 )
 
 

@@ -71,12 +71,14 @@ from shadow_tpu.engine.round import (
     PROBE_WIN_NS,
     ChunkProbe,
     RunInterrupted,
+    WatchdogExpired,
     _capacity_error,
     _fetch_probe,
     _launch_chunk0,
     _tspan,
     bootstrap,
     check_capacity,
+    device_loss_from,
     effective_engine,
     run_rounds_scan,
     state_probe,
@@ -336,6 +338,31 @@ def _drive_ensemble(
     from shadow_tpu.runtime import chaos, flightrec
 
     R = num_replicas(st)
+    # the chunk-launch seam for the `device-loss` chaos fault
+    # (docs/robustness.md "Device loss"): each dispatch consults the
+    # plan at its launch ordinal BEFORE the chunk goes out, so an
+    # injected loss lands exactly where a real device failure would
+    # first be provoked — replayable because the ordinal sequence is
+    # deterministic. No plan installed = one global None check.
+    real_launch = launch
+    launch_ord = [0]
+
+    def launch(s):
+        at = launch_ord[0]
+        launch_ord[0] += 1
+        if chaos.active() is not None:
+            # a device-loss fault's `target` names the LOST device id,
+            # and the launch site advertises the devices THIS state
+            # actually occupies — losing an idle device cannot touch
+            # the run, so target=7 never fires against a grid on 0..3
+            spec = chaos.fire(
+                "device-loss", at=at,
+                tags=tuple(str(d.id) for d in s.now.devices()),
+            )
+            if spec is not None:
+                raise chaos.injected_device_loss(at, spec)
+        return real_launch(s)
+
     # Replicas quiescent at ENTRY (a resumed checkpoint whose batch was
     # only partially done) are pre-recorded from the entry state itself:
     # their snapshot was patched to their own quiescence values
@@ -360,7 +387,23 @@ def _drive_ensemble(
                 nxt = launch(pend_st)
             launched += 1
         with _tspan(tracker, "probe_fetch", chunk=fetched):
-            rows = np.asarray(_fetch_probe(pend_probe, watchdog_s, fetched))
+            try:
+                rows = np.asarray(
+                    _fetch_probe(pend_probe, watchdog_s, fetched)
+                )
+            except (WatchdogExpired, RunInterrupted, KeyboardInterrupt):
+                raise
+            except Exception as err:
+                # real device/runtime failures surface HERE — the probe
+                # fetch is the first host<->device sync after a launch —
+                # as jaxlib XlaRuntimeErrors; translate them into the
+                # typed DeviceLossError the mesh degradation rungs act
+                # on (runtime/recovery.py). Anything else (engine bugs,
+                # donation misuse) propagates as what it is.
+                loss = device_loss_from(err, fetched)
+                if loss is None:
+                    raise
+                raise loss from err
         fetched += 1
         # the flight-recorder seam mirrors engine/round.py `_drive`:
         # aggregate and record BEFORE the capacity checks so a

@@ -154,6 +154,41 @@ class MeshPlan:
         )
         return cls(replicas=replicas, shards=shards, rows=rows_eff)
 
+    def degraded(self, devices_available: int,
+                 num_hosts: int) -> "MeshPlan | None":
+        """The next mesh-degradation rung after a device loss
+        (docs/robustness.md "Device loss"): the first SMALLER grid that
+        fits the surviving device count and still divides the host
+        axis, walked in preference order R×S/2 (halve the shard axis,
+        every replica row intact), 1×S (collapse the replica rows onto
+        one row of shards), then 1×S/2 … 1×1 (single device — the pure
+        vmapped ensemble). Each candidate resolves through for_batch so
+        the replicas-per-row constraint can never refuse a rung. None
+        when already at 1×1 with nothing below — the loss is terminal.
+
+        Sound as a *degradation* ladder for the same reason the engine
+        ladder is: the state is layout-free ([R, H, ...] regardless of
+        grid) and every grid is slice-exact to the single-device run
+        (tests/test_mesh.py), so falling a rung changes wall-clock and
+        ICI traffic, never a result leaf."""
+        cands: "list[tuple[int, int]]" = []
+        if self.shards > 1:
+            cands.append((self.rows, self.shards // 2))
+        if self.rows > 1:
+            cands.append((1, self.shards))
+        s = self.shards // 2
+        while s >= 1:
+            cands.append((1, s))
+            s //= 2
+        for rows, shards in cands:
+            if rows * shards >= self.devices_needed:
+                continue  # a rung must shed devices, not rearrange them
+            if num_hosts % shards:
+                continue
+            if rows * shards <= devices_available:
+                return MeshPlan.for_batch(self.replicas, rows, shards)
+        return None
+
 
 def mesh_engine_cfg(cfg: EngineConfig) -> EngineConfig:
     """The engine config a mesh batch actually traces: the ensemble

@@ -1094,6 +1094,85 @@ class WatchdogExpired(RuntimeError):
         self.deadline_s = deadline_s
 
 
+class DeviceLossError(RuntimeError):
+    """A device (or the runtime under it) failed mid-run: the chunk
+    launch or its probe fetch died with an XLA runtime error instead of
+    returning. Recoverable by mesh DEGRADATION (docs/robustness.md
+    "Device loss"): runtime/recovery.py rolls back to the retained clean
+    snapshot, the MeshRunner re-plans the batch onto the surviving
+    device set (MeshPlan.degraded — R×S → R×S/2 → 1×S → single device),
+    recompiles through the usual seams, and replays leaf-exact — the
+    state is layout-free, so losing devices can never change results.
+    Outside the mesh plane (nothing to degrade onto) it is terminal but
+    structured. `device_id` is the lost device's jax id when known
+    (chaos faults name it via target=N); `injected` marks the chaos
+    plane's simulated loss."""
+
+    def __init__(self, chunk: int, cause: "BaseException | None" = None,
+                 device_id: "int | None" = None):
+        detail = f": {cause}" if cause is not None else " (chaos plane)"
+        dev = f"device {device_id}" if device_id is not None else "a device"
+        super().__init__(
+            f"lost {dev} at chunk {chunk}{detail}"
+        )
+        self.chunk = chunk
+        self.device_id = device_id
+        self.injected = cause is None
+
+
+# XLA runtime failures the drivers translate into DeviceLossError: the
+# jaxlib XlaRuntimeError (surfacing device resets, DMA failures, dead
+# PJRT clients) and its public jax.errors alias. Deliberately NOT a
+# plain-RuntimeError catch — jax's "Array has been deleted" donation
+# error and engine bugs must keep propagating as what they are.
+def _device_error_types() -> tuple:
+    types = []
+    try:
+        from jaxlib.xla_extension import XlaRuntimeError
+
+        types.append(XlaRuntimeError)
+    except Exception:  # pragma: no cover — jaxlib layout changed
+        pass
+    err = getattr(getattr(jax, "errors", None), "JaxRuntimeError", None)
+    if err is not None:
+        types.append(err)
+    return tuple(types)
+
+
+_DEVICE_ERROR_TYPES = _device_error_types()
+
+# XLA status prefixes that plausibly mean a device/runtime died — the
+# ALLOWLIST the translation below keys on. Anything else (OOM,
+# argument/shape errors, precondition and deadline failures) surfaces
+# as what it is: misclassifying a deterministic error as a loss would
+# spiral the mesh down the degradation ladder replaying into the same
+# failure, and for RESOURCE_EXHAUSTED fewer devices makes it WORSE. A
+# missed real loss merely restores the pre-elastic behavior (the raw
+# error is terminal), so the conservative direction is to allowlist.
+_DEVICE_LOSS_STATUSES = (
+    "INTERNAL",
+    "UNAVAILABLE",
+    "ABORTED",
+    "CANCELLED",
+    "UNKNOWN",
+)
+
+
+def device_loss_from(err: BaseException, chunk: int) -> "DeviceLossError | None":
+    """Translate a raw dispatch/fetch exception into a DeviceLossError
+    when it is an XLA runtime failure whose status plausibly means a
+    device/runtime died (_DEVICE_LOSS_STATUSES), else None (the caller
+    re-raises the original). The one detection seam the ensemble/mesh
+    drivers share (engine/ensemble.py _drive_ensemble probe fetch)."""
+    if isinstance(err, DeviceLossError):
+        return err
+    if _DEVICE_ERROR_TYPES and isinstance(err, _DEVICE_ERROR_TYPES):
+        msg = str(err).lstrip()
+        if any(msg.startswith(p) for p in _DEVICE_LOSS_STATUSES):
+            return DeviceLossError(chunk, cause=err)
+    return None
+
+
 class EngineCompileError(RuntimeError):
     """The selected engine failed to compile/trace its chunk program.
     The engines are leaf-exact bit-identical, so this is recoverable by

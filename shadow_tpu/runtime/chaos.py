@@ -62,6 +62,14 @@ Fault kinds (the injection catalog):
                     number `at` after its atomic write — exercises the
                     cache's integrity check: a damaged entry degrades
                     to a recompile warning, never a failure.
+  ``device-loss``   raise a DeviceLossError at chunk-launch ordinal
+                    `at` (`target` = the lost jax device id, optional)
+                    — exercises the elastic-mesh degradation rungs:
+                    rollback to the retained snapshot, re-plan onto the
+                    surviving device set (MeshPlan.degraded), recompile,
+                    replay leaf-exact (docs/robustness.md "Device
+                    loss"). Terminal-but-structured outside the mesh
+                    plane.
 
 Opposite the injections sits the degradation ladder the chaos matrix
 validates (tests/test_chaos.py): the watchdog re-dispatch
@@ -318,6 +326,23 @@ def injected_capacity_error(at, spec: "FaultSpec | None" = None):
     return err
 
 
+def injected_device_loss(at, spec: "FaultSpec | None" = None):
+    """The DeviceLossError a `device-loss` fault raises at the
+    chunk-launch seam (engine/ensemble.py _drive_ensemble):
+    structurally identical to a real XLA runtime failure's translation
+    (engine/round.py device_loss_from), tagged `injected`, carrying the
+    lost device id when the fault's `target` names one."""
+    from shadow_tpu.engine.round import DeviceLossError
+
+    device_id = None
+    if spec is not None and spec.target is not None:
+        try:
+            device_id = int(spec.target)
+        except ValueError:
+            device_id = None
+    return DeviceLossError(at, device_id=device_id)
+
+
 @contextlib.contextmanager
 def compile_seam(engine: str):
     """The one compile-failure seam behind every engine-compile site —
@@ -329,6 +354,7 @@ def compile_seam(engine: str):
     act on. Shared so the two seams can never drift."""
     from shadow_tpu.engine.round import (
         CapacityError,
+        DeviceLossError,
         EngineCompileError,
         RunInterrupted,
         WatchdogExpired,
@@ -341,7 +367,7 @@ def compile_seam(engine: str):
             )
         yield
     except (CapacityError, RunInterrupted, WatchdogExpired,
-            EngineCompileError, KeyboardInterrupt):
+            EngineCompileError, DeviceLossError, KeyboardInterrupt):
         raise
     except Exception as e:
         raise EngineCompileError(engine, e) from e

@@ -13,7 +13,11 @@ leaf-exactly across plain/pump/megakernel and the sharded runner).
 On-disk format (versioned): one .npz per checkpoint holding the
 state_to_host leaves (typed PRNG keys stored as raw uint32 words) as
 ``leaf_00000..`` entries plus a ``__meta__`` JSON string with the format
-version, the config fingerprint, the sim time, and the leaf key paths.
+version, the config fingerprint (and its key-by-key fingerprint_detail),
+the sim time, the leaf key paths, and — for mesh runs — the grid the
+run dispatched on (``mesh: "RxS"``, layout METADATA only: the snapshot
+itself is layout-free, so any grid can resume it; docs/parallelism.md
+"Elastic mesh").
 Writes are atomic (tmp + os.replace), so a kill mid-write can never leave
 a truncated "latest" checkpoint. Restore validates version, fingerprint,
 and every leaf shape/dtype against a freshly built template state — a
@@ -44,7 +48,10 @@ import numpy as np
 # scheduler's packing key and the compile cache (config/fingerprint.py);
 # re-exported here because this module is where checkpoint consumers
 # historically import it from
-from shadow_tpu.config.fingerprint import config_fingerprint  # noqa: F401
+from shadow_tpu.config.fingerprint import (  # noqa: F401
+    config_fingerprint,
+    fingerprint_diff,
+)
 from shadow_tpu.engine.state import SimState, state_from_host
 from shadow_tpu.utils.shadow_log import slog
 
@@ -94,7 +101,7 @@ def save_checkpoint(path: str, host_state: SimState, meta: dict) -> str:
         outbox_capacity=int(host_state.outbox.valid.shape[-1]),
     )
     arrays = {f"leaf_{i:05d}": np.asarray(l) for i, l in enumerate(leaves)}
-    arrays["__meta__"] = np.asarray(json.dumps(full_meta))
+    arrays["__meta__"] = np.asarray(json.dumps(full_meta, default=str))
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
         np.savez(f, **arrays)
@@ -134,9 +141,56 @@ def verify_checkpoint(path: str) -> "str | None":
     return None
 
 
+def grid_label(grid: "str | None") -> str:
+    """ONE rendering of a layout-metadata grid for logs and errors
+    (None = no mesh = single device) — shared by the refusal message
+    and every resume log (runtime/manager.py, runtime/sweep.py)."""
+    return grid or "single-device"
+
+
+def reshard_note(saved_grid: "str | None", layout: "str | None") -> str:
+    """The ", resharding A -> B" log suffix when a resume changes
+    layout, empty when it does not — the elastic-resume breadcrumb,
+    defined once."""
+    if saved_grid == layout:
+        return ""
+    return f", resharding {grid_label(saved_grid)} -> {grid_label(layout)}"
+
+
+def _mismatch_message(path: str, meta: dict, fingerprint: str,
+                      detail: "dict | None", layout: "str | None") -> str:
+    """The resume-refusal message: name BOTH grids and the offending
+    trajectory keys (fingerprint_diff of the saved vs current
+    fingerprint_dict) instead of two opaque hashes. Grid-only changes
+    never reach here — the mesh is layout metadata, not part of the
+    hash — so every line printed is a genuine world difference."""
+    saved_grid = grid_label(meta.get("mesh"))
+    cur_grid = grid_label(layout)
+    msg = (
+        f"checkpoint {path} was written for a different config "
+        f"(saved on grid {saved_grid}, resuming on grid {cur_grid})"
+    )
+    saved_detail = meta.get("fingerprint_detail")
+    if saved_detail is not None and detail is not None:
+        keys = fingerprint_diff(saved_detail, detail)
+        if keys:
+            shown = "; ".join(keys[:8])
+            if len(keys) > 8:
+                shown += f"; … ({len(keys) - 8} more)"
+            return f"{msg}; differing keys: {shown}"
+    # older checkpoints (or callers passing only the hash): the two
+    # fingerprints are all there is to show
+    return (
+        f"{msg}; fingerprint {str(meta.get('fingerprint'))[:12]}… != "
+        f"{fingerprint[:12]}… — resume must use the exact world config "
+        "the checkpoint was saved from (grid layout may differ freely)"
+    )
+
+
 def load_checkpoint(
     path: str, like: SimState, fingerprint: "str | None" = None,
-    check_digest: bool = True,
+    check_digest: bool = True, detail: "dict | None" = None,
+    layout: "str | None" = None,
 ) -> "tuple[SimState, dict]":
     """Load a checkpoint back into a device SimState shaped like the
     template (a freshly built initial state for the same config).
@@ -145,7 +199,13 @@ def load_checkpoint(
     state_from_host. `check_digest=False` skips re-hashing the payload —
     for callers whose path just came from `CheckpointManager.latest_path`,
     which verified the digest moments ago (resume would otherwise read
-    and hash the full payload twice)."""
+    and hash the full payload twice). `detail` (the caller's
+    fingerprint_dict) and `layout` (the caller's mesh grid, or None)
+    only improve the mismatch error: the refusal names the offending
+    keys and both grids. A grid mismatch alone is NOT a refusal — the
+    mesh is layout metadata (docs/parallelism.md "Elastic mesh"), and
+    the resuming driver reshards the layout-free snapshot onto whatever
+    grid it has."""
     try:
         with np.load(path, allow_pickle=False) as z:
             meta = json.loads(str(z["__meta__"][()]))
@@ -156,10 +216,7 @@ def load_checkpoint(
                 )
             if fingerprint is not None and meta.get("fingerprint") != fingerprint:
                 raise CheckpointError(
-                    f"checkpoint {path} was written for a different config "
-                    f"(fingerprint {str(meta.get('fingerprint'))[:12]}… != "
-                    f"{fingerprint[:12]}…); resume must use the exact config "
-                    "the checkpoint was saved from"
+                    _mismatch_message(path, meta, fingerprint, detail, layout)
                 )
             leaves = [z[f"leaf_{i:05d}"] for i in range(meta["num_leaves"])]
     except CheckpointError:
@@ -203,11 +260,23 @@ class CheckpointManager:
         interval_ns: int,
         fingerprint: str,
         keep: int = 2,
+        layout: "str | None" = None,
+        detail: "dict | None" = None,
     ):
         self.directory = directory
         self.interval_ns = int(interval_ns)
         self.fingerprint = fingerprint
         self.keep = keep
+        # layout metadata (docs/parallelism.md "Elastic mesh"): the mesh
+        # grid ("RxS") this run dispatches on, or None for single-device
+        # / pure-ensemble runs. Recorded in the meta so post-mortems and
+        # the daemon journal can say WHICH grid wrote a checkpoint —
+        # never validated on load (the snapshot is layout-free).
+        self.layout = layout
+        # the fingerprint_dict behind `fingerprint`: recorded so a
+        # mismatched resume can name the offending keys instead of two
+        # opaque hashes (load_checkpoint _mismatch_message)
+        self.detail = detail
         self.written: "list[str]" = []
         self._next = self.interval_ns if self.interval_ns > 0 else None
         # the live engine config (set per recovery attempt by
@@ -230,6 +299,10 @@ class CheckpointManager:
             self._next = (now // self.interval_ns + 1) * self.interval_ns
         path = os.path.join(self.directory, f"ckpt-{now:020d}.npz")
         meta = {"fingerprint": self.fingerprint, "now_ns": now, "final": final}
+        if self.layout is not None:
+            meta["mesh"] = self.layout
+        if self.detail is not None:
+            meta["fingerprint_detail"] = self.detail
         if self.engine_cfg is not None:
             meta["deliver_lanes"] = self.engine_cfg.deliver_lanes
             meta["a2a_capacity"] = self.engine_cfg.a2a_capacity

@@ -564,6 +564,15 @@ class DaemonService(SweepService):
         self.replay_failed = 0
         self._rejected: "dict[str, int]" = {}  # tenant -> rejections
         self.tenant_service: "dict[str, float]" = {}  # weighted sim-ns
+        # per-tenant device-seconds SERVED (ROADMAP item 5 groundwork:
+        # resource-class quotas want device time, not job counts):
+        # wall-seconds of batch execution x the devices the batch's
+        # grid occupies, accumulated at chunk cadence. Accounting only
+        # — no enforcement yet (docs/service.md).
+        self.tenant_device_seconds: "dict[str, float]" = {}
+        self._batch_wall_anchor: "float | None" = None
+        self._anchor_tenant: str = default_tenant
+        self._anchor_devices: int = 1
         self.resume_report: "dict | None" = None
         self.pending: "list[Batch]" = []
         self._stop = False
@@ -801,22 +810,36 @@ class DaemonService(SweepService):
 
             ckpt_dir = os.path.join(self._batch_dir(b), "ckpts")
             path = CheckpointManager.latest_path(ckpt_dir)
+            saved_grid = None
             if path is not None:
-                # only resume the exact batch config the checkpoint was
-                # written for — anything else restarts from scratch
+                # only resume the exact simulated WORLD the checkpoint
+                # was written for — anything else restarts from
+                # scratch. The fingerprint no longer pins the grid
+                # (config/fingerprint.py): a checkpoint written on a
+                # since-degraded or since-changed mesh is
+                # grid-mismatched-but-valid and resumes here, resharded
+                # onto this daemon's grid at dispatch.
                 try:
                     meta = peek_checkpoint_meta(path)
+                    saved_grid = meta.get("mesh")
                     want = config_fingerprint(self._batch_config(b))
                     if meta.get("fingerprint") != want:
                         path = None
                 except Exception:  # noqa: BLE001 — unusable = scratch
                     path = None
             b.resume_ckpt = path
-            out.append({
+            entry = {
                 "key": b.dir_key,
                 "jobs": [j.name for j in b.jobs],
                 "checkpoint": path,
-            })
+            }
+            if path is not None:
+                # the elastic part of the journal's resume story: the
+                # grid the checkpoint was WRITTEN on vs the grid this
+                # daemon will resume it on
+                entry["mesh"] = saved_grid
+                entry["mesh_resume"] = self._batch_grid(b)
+            out.append(entry)
         return out
 
     def _register_admit(self, tenant, entry, rec, jobs) -> None:
@@ -1058,15 +1081,41 @@ class DaemonService(SweepService):
     def _on_batch_start(self, batch: Batch, depth: int) -> None:
         from shadow_tpu.runtime import chaos
 
+        grid = self._batch_grid(batch)
         self.journal.append(
             "batch-start", key=batch.dir_key or f"b{batch.index:03d}",
             jobs=[j.name for j in batch.jobs], tenant=batch.tenant,
             resume=batch.resume_ckpt, queue_depth=depth,
+            # the grid this dispatch runs on — with the `mesh` entries
+            # the resume records carry, the journal tells the full
+            # elastic story: which grid wrote each checkpoint, which
+            # grid each restart resumed it on
+            **({"mesh": grid} if grid else {}),
         )
+        # device-seconds accounting anchor (accounting only, no
+        # enforcement): chunk ticks accumulate wall x devices from here,
+        # and the tail past the last tick flushes at the job-terminal
+        # seam (or here, for a previous batch that split/failed without
+        # reaching one)
+        self._flush_device_seconds()
+        self._anchor_tenant = batch.tenant or self.default_tenant
+        self._anchor_devices = self._batch_devices(batch)
+        self._batch_wall_anchor = time.monotonic()
         if chaos.fire("daemon-kill", at=self._batch_ord,
                       tags=("batch-start",)) is not None:
             self._kill_self(f"batch-start {self._batch_ord}")
         self._batch_ord += 1
+
+    def _batch_devices(self, batch: Batch) -> int:
+        """Devices the batch's grid occupies (1 on the single-device
+        ensemble plane) — the device-seconds multiplier. Uses the
+        REQUESTED grid; a mid-batch degradation briefly over-counts,
+        which is the conservative direction for future quota work."""
+        grid = self._batch_grid(batch)
+        if grid is None:
+            return 1
+        rows, shards = (int(x) for x in grid.split("x"))
+        return rows * shards
 
     def _on_chunk_tick(self, batch: Batch, pending: "list[Batch]") -> None:
         from shadow_tpu.runtime import chaos
@@ -1076,6 +1125,9 @@ class DaemonService(SweepService):
             self._kill_self(f"chunk {self._chunk_ticks}")
         self._chunk_ticks += 1
         now = time.monotonic()
+        # per-tenant device-seconds at chunk cadence (so a SIGKILL
+        # loses at most one chunk's worth of accounting)
+        self._accrue_device_seconds(rearm=True)
         if now - self._last_poll_wall >= self.poll_interval_s:
             self._last_poll_wall = now
             # live arrivals mid-batch: a higher-priority admission here
@@ -1087,7 +1139,31 @@ class DaemonService(SweepService):
             self._last_prom_wall = now
             self._write_prom(pending)
 
+    def _accrue_device_seconds(self, rearm: bool) -> None:
+        """ONE definition of the device-seconds accounting step: wall
+        since the anchor x the anchored batch's device footprint,
+        credited to its tenant. `rearm` keeps the anchor running (the
+        chunk-tick cadence); False disarms it (the flush seams)."""
+        if self._batch_wall_anchor is None:
+            return
+        now = time.monotonic()
+        t = self._anchor_tenant
+        self.tenant_device_seconds[t] = (
+            self.tenant_device_seconds.get(t, 0.0)
+            + (now - self._batch_wall_anchor) * self._anchor_devices
+        )
+        self._batch_wall_anchor = now if rearm else None
+
+    def _flush_device_seconds(self) -> None:
+        """Account the tail between the last chunk tick and now against
+        the anchored batch, then disarm the anchor — called at the
+        job-terminal and next-batch-start seams so the final partial
+        chunk plus the output epilogue of every batch (and a batch that
+        failed before its first tick) is not dropped."""
+        self._accrue_device_seconds(rearm=False)
+
     def _on_job_terminal(self, name: str, record: dict) -> None:
+        self._flush_device_seconds()
         status = record.get("status")
         self._mark_terminal(name, status)
         entry = {
@@ -1158,6 +1234,12 @@ class DaemonService(SweepService):
             g[f'shadow_tpu_tenant_queue_depth{{tenant="{t}"}}'] = (
                 self._outstanding(t)
             )
+        # device-seconds served per tenant (accounting only — ROADMAP
+        # item 5 groundwork for device-time quota classes)
+        for t in sorted(self.tenant_device_seconds):
+            g[f'shadow_tpu_tenant_device_seconds{{tenant="{t}"}}'] = round(
+                self.tenant_device_seconds[t], 3
+            )
         stats = self.cache.stats()
         if "persistent" in stats:
             p = stats["persistent"]
@@ -1197,6 +1279,11 @@ class DaemonService(SweepService):
                 "weight": self.weights.get(t, 1.0),
                 "service_sim_s": round(
                     self.tenant_service.get(t, 0.0) / 1e9, 4
+                ),
+                # wall x devices actually served (the accounting half of
+                # device-time quotas; enforcement is future work)
+                "device_seconds": round(
+                    self.tenant_device_seconds.get(t, 0.0), 3
                 ),
             }
         return out

@@ -85,6 +85,7 @@ def failure_record(err: BaseException, **extra) -> dict:
         "WorkerCrashed": "worker-crash",
         "CheckpointError": "checkpoint",
         "RunInterrupted": "interrupted",
+        "DeviceLossError": "device-loss",
     }.get(type(err).__name__, type(err).__name__)
     rec: dict = {"kind": kind, "error": str(err)[:500]}
     for attr in (
@@ -93,9 +94,11 @@ def failure_record(err: BaseException, **extra) -> dict:
         "queue_hwm",
         "outbox_hwm",
         "replica",
+        "shard",
         "chunk",
         "deadline_s",
         "engine",
+        "device_id",
     ):
         # present-but-zero is information (chunk 0, replica 0, a zero
         # half of the overflow split); only an absent attribute is

@@ -676,18 +676,26 @@ class Manager:
             else:
                 resume_state = None
                 if resume_path is not None:
-                    from shadow_tpu.runtime.checkpoint import load_checkpoint
+                    from shadow_tpu.runtime.checkpoint import (
+                        load_checkpoint,
+                        reshard_note,
+                    )
 
                     # resume_path came from latest_path, which verified
                     # the sha-256 digest moments ago — skip the second
-                    # full hash
+                    # full hash. The snapshot is layout-free: a grid
+                    # mismatch between ckpt.layout and meta["mesh"] is
+                    # fine (the driver reshards at dispatch); only a
+                    # fingerprint mismatch refuses, naming the keys.
                     resume_state, meta = load_checkpoint(
                         resume_path, sched.initial_state(), ckpt.fingerprint,
-                        check_digest=False,
+                        check_digest=False, detail=ckpt.detail,
+                        layout=ckpt.layout,
                     )
                     slog("info", meta["now_ns"], "manager",
                          f"resuming from checkpoint {resume_path} "
-                         f"(sim time {fmt_time_ns(meta['now_ns'])})")
+                         f"(sim time {fmt_time_ns(meta['now_ns'])}"
+                         f"{reshard_note(meta.get('mesh'), ckpt.layout)})")
                 recovery = None
                 if cfgo.experimental.recover:
                     from shadow_tpu.runtime.recovery import RecoveryPolicy
@@ -772,11 +780,24 @@ class Manager:
             results.extra_stats["autotune"] = autotune_plan.as_dict()
         self._fold_chaos(results)
         if self.mesh_plan is not None:
+            # requested vs EFFECTIVE grid: device-loss degradation may
+            # have re-planned the batch mid-run (runtime/mesh.py) — a
+            # degraded run must be visibly degraded here too
+            eff = getattr(sched, "plan", self.mesh_plan)
             results.extra_stats["mesh"] = {
-                "replicas": self.mesh_plan.replicas,
-                "shards": self.mesh_plan.shards,
-                "rows": self.mesh_plan.rows,
+                "replicas": eff.replicas,
+                "shards": eff.shards,
+                "rows": eff.rows,
+                "requested": (
+                    f"{self.mesh_plan.rows}x{self.mesh_plan.shards}"
+                ),
+                "effective": f"{eff.rows}x{eff.shards}",
             }
+            degradations = getattr(sched, "mesh_degradations", [])
+            if degradations:
+                results.extra_stats["mesh"]["degradations"] = list(
+                    degradations
+                )
         host_tensors = None
         if replicas > 1:
             # per-replica sections + the aggregate mean/stddev/CI block
@@ -855,6 +876,7 @@ class Manager:
         config at the checkpoint's recorded buffer capacities, which may
         exceed the config values when the interrupted run had already
         regrown them. Returns (ecfg, ckpt_manager, guard, resume_path)."""
+        from shadow_tpu.config.fingerprint import fingerprint_dict
         from shadow_tpu.runtime.checkpoint import (
             CheckpointError,
             CheckpointManager,
@@ -900,8 +922,12 @@ class Manager:
                 overrides.get(k) != getattr(ecfg, k) for k in overrides
             ):
                 ecfg = dataclasses.replace(ecfg, **overrides)
+        layout = None
+        if self.mesh_plan is not None:
+            layout = f"{self.mesh_plan.rows}x{self.mesh_plan.shards}"
         ckpt = CheckpointManager(
-            g.checkpoint_dir, g.checkpoint_interval_ns, fingerprint
+            g.checkpoint_dir, g.checkpoint_interval_ns, fingerprint,
+            layout=layout, detail=fingerprint_dict(self.config),
         )
         return ecfg, ckpt, InterruptGuard(), resume_path
 

@@ -9,10 +9,22 @@ the engine fallback ladder) composes unchanged. What the mesh adds:
 
   * the state is the SAME [R, ...] init_ensemble_state stack, laid out
     over a Mesh(replica, hosts) device grid — so checkpoints are
-    byte-compatible with the ensemble plane's, and the config
-    fingerprint (which hashes general.mesh alongside general.replicas)
-    refuses a resume under a different mesh/replica shape with a clear
-    CheckpointError, never a shape mismatch deep in jax;
+    byte-compatible with the ensemble plane's AND grid-portable
+    (docs/parallelism.md "Elastic mesh"): the host snapshot is
+    layout-free, the grid travels as layout metadata only, and the
+    config fingerprint hashes the EFFECTIVE replica count instead of
+    the grid — an RxS checkpoint resumes on any R'xS' (the driver
+    reshards at dispatch), while a resume that would change the number
+    of simulated worlds still refuses with a CheckpointError naming
+    the offending keys;
+  * device loss is a recovery rung, not a terminal fault: a
+    DeviceLossError (real, from the probe fetch, or the chaos plane's
+    `device-loss` fault) rolls back to the retained snapshot,
+    _replan_device_loss degrades the grid onto the surviving device
+    set (MeshPlan.degraded: R×S → R×S/2 → 1×S → single device),
+    recompiles through the same AOT seam, and replays leaf-exact — the
+    reshape is journaled as a `recovery` record and a flight-recorder
+    event, and `mesh_degradations` carries it to sim-stats;
   * recovery regrows the WHOLE mesh batch (grow_mesh_state — the
     replica-vmapped grow, shard layout restored at the next dispatch):
     one (replica, shard) cell's CapacityError, which names both
@@ -41,6 +53,22 @@ from shadow_tpu.engine.state import EngineConfig
 # grow widens every replica's fixed-slot buffers together, and the mesh
 # layout is re-applied by the next dispatch's shard_mesh_state
 grow_mesh_state = grow_ensemble_state
+
+
+def _device_alive(device) -> bool:
+    """Can this device still round-trip one scalar? The liveness probe
+    behind the unattributed-loss path of MeshRunner._devices: a dead
+    PJRT device fails the put or the fetch, a healthy one costs
+    microseconds."""
+    import jax
+    import numpy as np
+
+    try:
+        out = jax.device_put(np.zeros((), np.int32), device)
+        jax.block_until_ready(out)
+        return True
+    except Exception:  # noqa: BLE001 — any failure means "not usable"
+        return False
 
 
 class MeshRunner:
@@ -82,6 +110,11 @@ class MeshRunner:
         self.on_rows = on_rows
         self.watchdog_s = watchdog_s
         self._mesh = None  # built lazily, reused across attempts
+        # device-loss degradation history: one record per reshape
+        # ({"grid_from", "grid_to", "devices", ...}), folded into
+        # sim-stats' mesh block by the Manager and into the sweep
+        # batch record by the service
+        self.mesh_degradations: "list[dict]" = []
 
     @property
     def num_replicas(self) -> int:
@@ -93,8 +126,65 @@ class MeshRunner:
 
     def _get_mesh(self):
         if self._mesh is None:
-            self._mesh = self.plan.build_mesh()
+            self._mesh = self.plan.build_mesh(self._devices())
         return self._mesh
+
+    def _devices(self):
+        """The surviving device set: all visible devices minus any the
+        degradation history marked lost. Injected faults name a device
+        that is still physically present, so the exclusion is what
+        makes the simulated loss real — the degraded grid genuinely
+        avoids the 'dead' device. A REAL loss often cannot name its
+        device (the XLA error rarely does), so when the history carries
+        an unattributed loss the set is additionally probed: each
+        candidate must survive a tiny put+fetch, and ones that fail are
+        excluded exactly like named ones. Probes run only after an
+        unattributed loss (the healthy path never pays them — _get_mesh
+        caches the built mesh until a replan invalidates it)."""
+        import jax
+
+        lost = {
+            d["device"] for d in self.mesh_degradations if "device" in d
+        }
+        devices = [d for d in jax.devices() if d.id not in lost]
+        if any("device" not in d for d in self.mesh_degradations):
+            devices = [d for d in devices if _device_alive(d)]
+        return devices or jax.devices()  # never degrade to zero devices
+
+    def _replan_device_loss(self, err) -> "dict | None":
+        """The recovery loop's replan hook (runtime/recovery.py
+        replan_fn): pick the next degradation rung that fits the
+        surviving device set, install it on the runner (the factory
+        reads self.plan/self._mesh at dispatch time, so the very next
+        attempt dispatches degraded), and return the reshape record.
+        None = no rung left — the loss becomes terminal."""
+        lost = getattr(err, "device_id", None)
+        record = {
+            "grid_from": f"{self.plan.rows}x{self.plan.shards}",
+        }
+        if lost is not None:
+            record["device"] = int(lost)
+            survivors = len(self._devices()) - (
+                1 if lost not in {d["device"] for d in
+                                  self.mesh_degradations if "device" in d}
+                else 0
+            )
+        else:
+            # an unattributed loss (real failures rarely name their
+            # device): probe THIS loss's survivor set now, not just the
+            # history's — several devices may have died at once, and an
+            # over-stated count would pick a rung the next dispatch
+            # cannot build (a ValueError the ladder doesn't catch)
+            survivors = sum(1 for d in self._devices() if _device_alive(d))
+        plan = self.plan.degraded(max(survivors, 1), self.cfg.num_hosts)
+        if plan is None:
+            return None
+        self.mesh_degradations.append(record)
+        self.plan = plan
+        self._mesh = None  # rebuilt lazily against the surviving set
+        record["grid_to"] = f"{plan.rows}x{plan.shards}"
+        record["devices"] = plan.devices_needed
+        return record
 
     def initial_state(self, cfg: "EngineConfig | None" = None):
         """The bootstrapped [R, ...] t=0 stack — also the template a
@@ -190,6 +280,10 @@ class MeshRunner:
                 guard=guard,
                 runner_factory=factory,
                 grow_fn=grow_mesh_state,
+                # the mesh-degradation rung: a DeviceLossError re-plans
+                # the batch onto the surviving grid and replays from the
+                # retained snapshot, leaf-exact (docs/robustness.md)
+                replan_fn=self._replan_device_loss,
             )
 
         self.engine_fallbacks: "list[dict]" = []

@@ -29,7 +29,12 @@ import dataclasses
 
 import numpy as np
 
-from shadow_tpu.engine.round import CapacityError, WatchdogExpired, run_until
+from shadow_tpu.engine.round import (
+    CapacityError,
+    DeviceLossError,
+    WatchdogExpired,
+    run_until,
+)
 from shadow_tpu.engine.state import grow_state, state_from_host, state_to_host
 from shadow_tpu.runtime.checkpoint import StateTap
 from shadow_tpu.utils.shadow_log import slog
@@ -115,6 +120,7 @@ def run_until_recovering(
     on_recovery=None,
     grow_fn=None,
     watchdog_s: float = 0.0,
+    replan_fn=None,
 ):
     """run_until with the recovery loop wrapped around it. Returns
     (final_state, recoveries) where recoveries is the list of recovery
@@ -125,7 +131,15 @@ def run_until_recovering(
     (one shared snapshot per due point). `on_recovery(record)` fires per
     recovery (bench progress lines). `grow_fn` overrides the regrow step
     (default grow_state; the ensemble runner passes the replica-vmapped
-    grow_ensemble_state so the whole [R, ...] batch widens together)."""
+    grow_ensemble_state so the whole [R, ...] batch widens together).
+    `replan_fn(err)` arms the mesh-degradation rung for DeviceLossError
+    (docs/robustness.md "Device loss"): it re-plans the runner onto the
+    surviving device set and returns a record dict (grid_from/grid_to)
+    — the next runner_factory(cfg) call dispatches on the degraded grid
+    and the replay from the retained snapshot stays leaf-exact, the
+    watchdog shape with a swapped layout. It returns None (or is None)
+    when no rung is left, which makes the loss terminal but
+    structured."""
     policy = policy or RecoveryPolicy()
     grow = grow_fn or grow_state
 
@@ -170,10 +184,19 @@ def run_until_recovering(
         try:
             final = runner_factory(cur_cfg)(cur_st, on_state=tap)
             return final, recoveries
-        except (CapacityError, WatchdogExpired) as err:
+        except (CapacityError, WatchdogExpired, DeviceLossError) as err:
             from shadow_tpu.runtime import flightrec
 
-            if len(recoveries) >= policy.max_recoveries:
+            is_loss = isinstance(err, DeviceLossError)
+            replanned = None
+            if is_loss and len(recoveries) < policy.max_recoveries:
+                # re-plan BEFORE the budget check below so a loss with
+                # no rung left (replan_fn None / ladder exhausted)
+                # takes the terminal path with its degradation history
+                replanned = replan_fn(err) if replan_fn is not None else None
+            if len(recoveries) >= policy.max_recoveries or (
+                is_loss and replanned is None
+            ):
                 # terminal: surface what the run survived before it died,
                 # so a degraded-then-failed run stays visibly degraded
                 # (sweep manifests read this off the exception), and
@@ -185,13 +208,87 @@ def run_until_recovering(
                 raise
             is_watchdog = isinstance(err, WatchdogExpired)
             if retainer is not None and retainer.host_state is not None:
-                base = state_from_host(retainer.host_state, cur_st)
+                base_host = retainer.host_state
+                try:
+                    base = state_from_host(base_host, cur_st)
+                except Exception as mat_err:  # noqa: BLE001
+                    # materializing the snapshot commits leaves to the
+                    # DEFAULT device; if a real loss took that one out,
+                    # surface a structured terminal error instead of a
+                    # raw runtime crash escaping this handler
+                    err.recoveries = list(recoveries)
+                    err.args = (
+                        f"{err.args[0]} — and the retained snapshot "
+                        "cannot be materialized (default device lost? "
+                        f"{type(mat_err).__name__}); restart this "
+                        "process on the surviving devices and resume "
+                        "from the checkpoint directory",
+                    )
+                    flightrec.post_mortem(err, recoveries=len(recoveries))
+                    raise err from mat_err
+                # the host snapshot mirrors `base`: read the rollback
+                # sim time from numpy, never through the device — a
+                # REAL device loss must not crash its own handler
+                from_ns = int(np.min(np.asarray(base_host.now)))
             else:
+                base_host = None
                 base = cur_st  # the caller's never-donated entry state
-            # ensemble states carry a [R] `now`: the rollback point is the
-            # slowest replica's window (the batch replays together)
-            from_ns = int(np.min(np.asarray(base.now)))
-            if is_watchdog:
+                # ensemble states carry a [R] `now`: the rollback point
+                # is the slowest replica's window (the batch replays
+                # together)
+                try:
+                    from_ns = int(np.min(np.asarray(base.now)))
+                except Exception as fetch_err:  # noqa: BLE001
+                    # the rollback base itself is unreadable: a real
+                    # device loss took the only copy of the
+                    # un-snapshotted state with it. No replay is
+                    # physically possible — surface a structured,
+                    # actionable error instead of a raw runtime crash
+                    # escaping this handler.
+                    err.recoveries = list(recoveries)
+                    err.args = (
+                        f"{err.args[0]} — and the rollback state is "
+                        "unreadable through the lost device "
+                        f"({type(fetch_err).__name__}); recovery needs "
+                        "a retained snapshot or --checkpoint-dir",
+                    )
+                    flightrec.post_mortem(err, recoveries=len(recoveries))
+                    raise err from fetch_err
+            if is_loss:
+                # the device is gone, not the buffers: keep cfg and
+                # shapes (the [R, H, ...] state is layout-free), replay
+                # from the retained clean snapshot — the next dispatch
+                # reshards it onto the degraded grid the replan hook
+                # just installed. The watchdog shape with a new layout.
+                new_cfg, grown = cur_cfg, base
+                record = {
+                    "kind": "device-loss",
+                    "chunk": err.chunk,
+                    "replay_from_ns": from_ns,
+                    **replanned,
+                }
+                if err.device_id is not None:
+                    record["device"] = err.device_id
+                if getattr(err, "injected", False):
+                    record["injected"] = True  # chaos plane, not real loss
+                if checkpoints is not None and record.get("grid_to"):
+                    # checkpoints written after the reshape must carry
+                    # the EFFECTIVE grid as their layout metadata — the
+                    # daemon journal reads it off the resume path
+                    checkpoints.layout = record["grid_to"]
+                slog(
+                    "warning", from_ns, "recovery",
+                    f"device loss at chunk {err.chunk}"
+                    + (f" (device {err.device_id})"
+                       if err.device_id is not None else "")
+                    + f"; degrading mesh {record.get('grid_from', '?')}"
+                    f" -> {record.get('grid_to', '?')} and replaying "
+                    f"from sim time {from_ns} ns "
+                    f"(recovery {len(recoveries) + 1}/"
+                    f"{policy.max_recoveries})",
+                )
+                recoveries.append(record)
+            elif is_watchdog:
                 # the dispatch stalled, not the buffers: abandon the
                 # in-flight chunk, keep the shapes, re-dispatch from the
                 # retained clean snapshot (docs/robustness.md watchdog)
@@ -261,5 +358,11 @@ def run_until_recovering(
                 retainer = StateRetainer(policy.snapshot_interval_chunks)
             # the replay may overflow again before reaching a fresh
             # snapshot: seed the rollback point with the regrown start so
-            # the next rung never replays stale shapes (or the whole run)
-            retainer.seed(state_to_host(grown))
+            # the next rung never replays stale shapes (or the whole run).
+            # Watchdog/device-loss rungs keep the shapes, so when the base
+            # came from a host snapshot that snapshot IS the seed — no
+            # device round-trip (and no read through a lost device).
+            if grown is base and base_host is not None:
+                retainer.seed(base_host)
+            else:
+                retainer.seed(state_to_host(grown))

@@ -89,6 +89,11 @@ class Batch:
     error: "str | None" = None
     failure: "str | None" = None  # structured kind: capacity/watchdog/...
     engine_fallbacks: "list[dict]" = dataclasses.field(default_factory=list)
+    # elastic-mesh record (docs/parallelism.md "Elastic mesh"): the grid
+    # the batch FINISHED on (device-loss degradation may have shrunk it
+    # mid-run) and the reshape history the runner journaled
+    mesh_effective: "str | None" = None
+    mesh_degradations: "list[dict]" = dataclasses.field(default_factory=list)
 
     @property
     def replicas(self) -> int:
@@ -197,6 +202,7 @@ class _Preempted(Exception):
 
 
 def _failure_kind(err: BaseException) -> str:
+    from shadow_tpu.engine.round import DeviceLossError
     from shadow_tpu.runtime.checkpoint import CheckpointError
 
     if isinstance(err, CapacityError):
@@ -207,7 +213,28 @@ def _failure_kind(err: BaseException) -> str:
         return "compile"
     if isinstance(err, CheckpointError):
         return "checkpoint"
+    if isinstance(err, DeviceLossError):
+        return "device-loss"
     return type(err).__name__
+
+
+def retry_backoff_s(base_s: float, job_name: str, attempt: int) -> float:
+    """The wall backoff before retry number `attempt` of a split single
+    job: exponential (base * 2^(attempt-1)) with seeded, BOUNDED jitter —
+    a multiplicative factor in [0.5, 1.5) drawn chaos-style from
+    ``random.Random(f"backoff:{job_name}:{attempt}")``
+    (runtime/chaos.py's site-draw idiom), so N jobs split out of one
+    failed batch fan their retries out instead of stampeding the compile
+    cache in lockstep, while any replay of the same sweep sleeps the
+    exact same schedule. Pure and wall-clock-free so the unit test pins
+    it without sleeping (tests/test_elastic.py)."""
+    import random
+
+    base = base_s * (2 ** (attempt - 1))
+    if base <= 0:
+        return 0.0
+    jitter = random.Random(f"backoff:{job_name}:{attempt}").random()
+    return base * (0.5 + jitter)
 
 
 class SweepService:
@@ -554,7 +581,9 @@ class SweepService:
         self.job_attempts[job.name] = attempts
         batch.status = "failed"
         if attempts <= self.spec.retry_max:
-            backoff = self.spec.retry_backoff_s * (2 ** (attempts - 1))
+            backoff = retry_backoff_s(
+                self.spec.retry_backoff_s, job.name, attempts
+            )
             slog(
                 "warning", self.clock_ns, "sweep",
                 f"job {job.name} failed ({kind}); retrying "
@@ -594,6 +623,19 @@ class SweepService:
             "continues",
         )
 
+    def _batch_grid(self, batch: Batch) -> "str | None":
+        """The grid this batch dispatches on — the service mesh with
+        rows degraded for ragged/split batches (MeshPlan.for_batch) —
+        or None on the single-device ensemble plane. One definition for
+        the batch config, the runner plan, the checkpoint layout
+        metadata, and the daemon's journal records."""
+        if self.mesh is None:
+            return None
+        from shadow_tpu.engine.mesh import MeshPlan
+
+        plan = MeshPlan.for_batch(batch.replicas, self.mesh[0], self.mesh[1])
+        return f"{plan.rows}x{plan.shards}"
+
     def _batch_config(self, batch: Batch) -> ConfigOptions:
         """The ensemble config a batch runs under: the first job's
         resolved raw config with the replica axis folded in. Sound
@@ -605,17 +647,14 @@ class SweepService:
         g["replicas"] = batch.replicas
         g["replica_seed_stride"] = batch.stride
         g["data_directory"] = self._batch_dir(batch)
-        if self.mesh is not None:
-            # the EFFECTIVE grid this batch dispatches on (rows degrade
-            # for ragged/split batches — MeshPlan.for_batch), folded in
-            # so the config fingerprint pins checkpoints to the mesh
-            # shape they were written under
-            from shadow_tpu.engine.mesh import MeshPlan
-
-            plan = MeshPlan.for_batch(
-                batch.replicas, self.mesh[0], self.mesh[1]
-            )
-            g["mesh"] = f"{plan.rows}x{plan.shards}"
+        grid = self._batch_grid(batch)
+        if grid is not None:
+            # the grid this batch dispatches on. Execution geometry
+            # only: the config fingerprint hashes the effective replica
+            # count, NOT the grid (config/fingerprint.py), so a
+            # checkpoint written here resumes on any grid a restarted
+            # service ends up with — the elastic-resume contract
+            g["mesh"] = grid
         return ConfigOptions.from_dict(raw)
 
     def _batch_dir(self, batch: Batch) -> str:
@@ -628,7 +667,10 @@ class SweepService:
         )
 
     def _run_batch(self, batch: Batch, pending: "list[Batch]") -> None:
-        from shadow_tpu.config.fingerprint import config_fingerprint
+        from shadow_tpu.config.fingerprint import (
+            config_fingerprint,
+            fingerprint_dict,
+        )
         from shadow_tpu.runtime.checkpoint import (
             CheckpointManager,
             load_checkpoint,
@@ -719,23 +761,32 @@ class SweepService:
 
         start_state = None
         start_now = 0
+        grid = self._batch_grid(batch)
         if batch.resume_ckpt is not None:
             # resume_ckpt came from latest_path, which verified the
-            # sha-256 digest moments ago — skip the second full hash
+            # sha-256 digest moments ago — skip the second full hash.
+            # The snapshot is layout-free: a checkpoint written on a
+            # different grid (pre-crash, pre-degradation) reshards onto
+            # this batch's grid at dispatch — elastic resume.
+            from shadow_tpu.runtime.checkpoint import reshard_note
+
             start_state, meta = load_checkpoint(
                 batch.resume_ckpt, runner.initial_state(), fingerprint,
-                check_digest=False,
+                check_digest=False, detail=fingerprint_dict(cfgo),
+                layout=grid,
             )
             start_now = int(meta["now_ns"])
             slog("info", start_now, "sweep",
-                 f"batch {batch.index} resuming from {batch.resume_ckpt}")
+                 f"batch {batch.index} resuming from {batch.resume_ckpt}"
+                 f"{reshard_note(meta.get('mesh'), grid)}")
 
         ckpt_dir = os.path.join(self._batch_dir(batch), "ckpts")
         # one-shot sweeps: interval 0, no periodic cadence — the only
         # writes are the verified final checkpoint a preemption commits.
         # Daemon mode uses the config's cadence (crash-loss bound).
         ckpt = CheckpointManager(
-            ckpt_dir, self._ckpt_interval_ns(cfgo), fingerprint
+            ckpt_dir, self._ckpt_interval_ns(cfgo), fingerprint,
+            layout=grid, detail=fingerprint_dict(cfgo),
         )
         guard = _PreemptGuard()
         recovery = None
@@ -834,11 +885,28 @@ class SweepService:
             batch.engine_fallbacks = list(
                 getattr(runner, "engine_fallbacks", [])
             )
+            batch.mesh_degradations = list(
+                getattr(runner, "mesh_degradations", [])
+            )
+            if self.mesh is not None:
+                # a degraded-THEN-failed batch must still say which grid
+                # it died on (visibly-degraded contract)
+                plan = runner.plan
+                batch.mesh_effective = f"{plan.rows}x{plan.shards}"
             raise
         batch.wall_seconds += time.perf_counter() - t0
         batch.status = "done"
         batch.recoveries = len(runner.recovery_report)
         batch.engine_fallbacks = list(getattr(runner, "engine_fallbacks", []))
+        if self.mesh is not None:
+            # the grid the batch FINISHED on: device loss mid-batch
+            # degrades the runner's plan instead of quarantining the
+            # jobs, and the manifest must say so (elastic mesh)
+            plan = runner.plan
+            batch.mesh_effective = f"{plan.rows}x{plan.shards}"
+            batch.mesh_degradations = list(
+                getattr(runner, "mesh_degradations", [])
+            )
         self._write_batch_outputs(batch, final, end, runner.recovery_report)
 
     # --- per-job outputs -------------------------------------------------
@@ -1035,6 +1103,10 @@ class SweepService:
                  **({"failure": b.failure} if b.failure else {}),
                  **({"engine_fallbacks": b.engine_fallbacks}
                     if b.engine_fallbacks else {}),
+                 **({"mesh_effective": b.mesh_effective}
+                    if b.mesh_effective else {}),
+                 **({"mesh_degradations": b.mesh_degradations}
+                    if b.mesh_degradations else {}),
                  **({"error": b.error[:300]} if b.error else {})}
                 for b in self.batches
             ],

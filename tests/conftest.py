@@ -96,6 +96,40 @@ SLOW_TESTS = {
     "test_mesh_slice_matches_single_tgen_pump",
     "test_mesh_recovery_regrows_whole_batch",
     "test_daemon_journal_compaction_survives_kill",
+    # Elastic-mesh round budget split (tests/test_elastic.py,
+    # tests/test_elastic_cli.py): the quick tier keeps the acceptance
+    # pins — the 2x4-checkpoint-resumes-anywhere CLI matrix, the
+    # device-loss CLI completion, the degraded-grid capacity naming,
+    # the terminal-outside-mesh pin, and the pure units (~60s). The
+    # engine-level leaf-exact replay pin, the regrow-on-degraded-grid
+    # pin, and the sweep-batch survival pin each pay extra mesh
+    # compiles (~20 s apiece) and run in the full tier.
+    "test_device_loss_degrades_mesh_and_replays_leaf_exact",
+    "test_whole_batch_regrow_on_grid_reached_via_degradation",
+    "test_sweep_batch_survives_device_loss",
+    "test_device_loss_terminal_outside_mesh_is_structured",
+    "test_capacity_naming_on_grid_reached_via_degradation",
+    # Elastic-round REBALANCE: the quick tier measured 1080s on this
+    # box (the 870s cap was already breached before this round's ~60s
+    # of acceptance pins — the 782s PR-14 number was a faster day).
+    # Moved to the full tier, each with quick-tier coverage of the same
+    # plane retained: the shaped pump-vs-plain tgen matrix (~122s —
+    # test_pump_unshaped_world_matches still pins pump-tgen equivalence
+    # quick), the pump-tgen tracker cross-engine cell (~80s — the phold
+    # trajectory pin, probe-lane, fold and CLI tracker tests stay
+    # quick), the onion example ensemble rung (~62s — the registry
+    # [onion] smoke and the single-run example stay), and the
+    # netstack-noop equivalence (~30s — bootstrap-period shaping and
+    # the TCP suites keep quick netstack coverage).
+    "test_pump_bit_identical_tgen",
+    "test_tracker_counters_cross_engine_pump_tgen",
+    "test_onion_example_replicas_aggregate",
+    "test_netstack_unlimited_is_noop",
+    # ~103s: the forced-CPU bench harness subprocess canary — the
+    # biggest single quick-tier item after the rebalance and a harness
+    # smoke rather than a correctness pin; the capped rerun still
+    # landed only ~30s under the 870s wall, so it funds the margin
+    "test_bench_cpu_rung_publishes_non_null",
     "test_streams_cycle",
     "test_streams_deterministic",
     "test_system_curl_run_twice_strace_identical",

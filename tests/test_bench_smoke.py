@@ -52,6 +52,7 @@ def test_bench_cpu_rung_publishes_non_null(tmp_path):
         SHADOW_TPU_BENCH_SWEEP="0",
         SHADOW_TPU_BENCH_OVERLAY="0",
         SHADOW_TPU_BENCH_MESH="0",
+        SHADOW_TPU_BENCH_ELASTIC="0",
         SHADOW_TPU_AUTOTUNE_CACHE=str(cache),
     )
     r = subprocess.run(

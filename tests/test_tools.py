@@ -340,3 +340,64 @@ def test_shm_cleanup(tmp_path):
         assert other.exists()  # not ours
     finally:
         mm.close()
+
+
+def test_bench_history_tracks_elastic_reshape_wall(tmp_path):
+    """ISSUE 15 satellite: detail.elastic's reshape-replay WALL row gets
+    best-prior flagging with the direction inverted (lower is better) —
+    a round whose reshape rung got slower past tolerance is a
+    regression, a faster one never is, and a round that stops
+    publishing the row flags as null."""
+
+    def _round(n, value, detail_extra):
+        (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps({
+            "n": n,
+            "parsed": {
+                "metric": "m", "value": value,
+                "detail": {
+                    "config": {"hosts": 128},
+                    "main": {"wall_s": 1.0},
+                    "attempts": [],
+                    **detail_extra,
+                },
+            },
+        }))
+
+    _round(1, 0.10, {})  # pre-elastic round: no block at all
+    _round(2, 0.12, {"elastic": {
+        "hosts": 128, "grid": "1x4", "reshape_replay_wall_s": 8.0,
+    }})
+
+    sys.path.insert(0, str(TOOLS))
+    try:
+        import bench_history as bh
+    finally:
+        sys.path.pop(0)
+
+    rounds = bh.load_rounds(str(tmp_path))
+    assert rounds[0]["elastic"] is None
+    assert rounds[1]["elastic"] == {
+        "reshape_replay_wall_s@1x4@128h": 8.0
+    }
+
+    v = bh.elastic_check(rounds)  # newest round vs (empty) history
+    assert v["regression"] is False
+
+    key = "reshape_replay_wall_s@1x4@128h"
+    # faster reshape (lower wall): fine; slower past tolerance: flagged
+    v = bh.elastic_check(rounds, current={key: 4.0})
+    assert v["rows"][key]["regression"] is False
+    v = bh.elastic_check(rounds, current={key: 12.0})
+    assert v["rows"][key]["regression"] is True
+    assert "REGRESSION" in v["rows"][key]["note"]
+
+    # a recorded slower round trips the CLI exit code, naming the row
+    _round(3, 0.13, {"elastic": {
+        "hosts": 128, "grid": "1x4", "reshape_replay_wall_s": 20.0,
+    }})
+    r = subprocess.run(
+        [sys.executable, str(TOOLS / "bench_history.py"), str(tmp_path)],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 1
+    assert f"elastic.{key}: REGRESSION" in r.stdout

@@ -111,21 +111,41 @@ def _mesh_row(detail: dict) -> "dict | None":
     return row or None
 
 
+def _elastic_row(detail: dict) -> "dict | None":
+    """The elastic-mesh reshape row a round published: detail.elastic
+    (the elastic trial, ISSUE 15) as {"reshape_replay_wall_s@<grid>@Nh":
+    seconds} — the wall cost of one device-loss rung (rollback +
+    re-plan + recompile + replay to the point of loss). LOWER is
+    better, so elastic_check inverts the comparison direction. Keyed by
+    grid and world size so rows never compare across shapes."""
+    el = detail.get("elastic") or {}
+    hosts = el.get("hosts", "?")
+    grid = el.get("grid", "?")
+    v = el.get("reshape_replay_wall_s")
+    if v is None:
+        return None
+    return {f"reshape_replay_wall_s@{grid}@{hosts}h": v}
+
+
 def _metric_verdicts(rounds_key: str, keys, history, current,
-                     latest_round) -> dict:
-    """The shared best-prior/TOLERANCE verdict core behind service_check
-    and overlay_check (and regression_check's policy): for each key,
-    compare `current[key]` against the best prior round's value under
-    `rounds_key`, flagging a slide past TOLERANCE — and flagging a NULL
-    latest when a prior round did measure it (the r05 policy: a metric
-    that stops being published must announce itself)."""
+                     latest_round, lower_is_better: bool = False) -> dict:
+    """The shared best-prior/TOLERANCE verdict core behind
+    service_check, overlay_check, and elastic_check (and
+    regression_check's policy): for each key, compare `current[key]`
+    against the best prior round's value under `rounds_key`, flagging a
+    slide past TOLERANCE — and flagging a NULL latest when a prior
+    round did measure it (the r05 policy: a metric that stops being
+    published must announce itself). `lower_is_better` inverts the
+    direction for wall/cost metrics: best prior is the minimum and a
+    slide is the value GROWING past tolerance."""
     out = {"latest_round": latest_round, "regression": False}
     verdicts = {}
+    pick = min if lower_is_better else max
     for key in keys:
         cur = (current or {}).get(key)
         prior = [r for r in history if r[rounds_key].get(key) is not None]
         best = (
-            max(prior, key=lambda r: r[rounds_key][key]) if prior else None
+            pick(prior, key=lambda r: r[rounds_key][key]) if prior else None
         )
         v = {
             "latest": cur,
@@ -144,7 +164,9 @@ def _metric_verdicts(rounds_key: str, keys, history, current,
         else:
             delta = (cur - v["best_prior"]) / max(v["best_prior"], 1e-9)
             v["delta_pct"] = round(delta * 100, 1)
-            v["regression"] = delta < -TOLERANCE
+            v["regression"] = (
+                delta > TOLERANCE if lower_is_better else delta < -TOLERANCE
+            )
             v["note"] = (
                 f"{'REGRESSION' if v['regression'] else 'ok'}: "
                 f"{cur:.4g} vs best {v['best_prior']:.4g} "
@@ -204,6 +226,26 @@ def mesh_check(rounds: "list[dict]",
     return out
 
 
+def elastic_check(rounds: "list[dict]",
+                  current: "dict | None" = None) -> dict:
+    """The detail.elastic trajectory verdicts — the reshape-replay WALL
+    per (grid, size) row, the SAME best-prior/TOLERANCE core as every
+    other detail metric but with the direction inverted (a wall metric:
+    lower is better). `current` is an in-flight
+    {"reshape_replay_wall_s@<grid>@Nh": seconds} from bench.py; None
+    compares the newest recorded round against the rest."""
+    history, current, latest_round = _pop_latest("elastic", rounds, current)
+    keys = sorted(
+        set(current or {}) | {m for r in history for m in r["elastic"]}
+    )
+    out, verdicts = _metric_verdicts(
+        "elastic", keys, history, current, latest_round,
+        lower_is_better=True,
+    )
+    out["rows"] = verdicts
+    return out
+
+
 def service_check(rounds: "list[dict]",
                   current: "dict | None" = None) -> dict:
     """The detail.service trajectory verdicts — jobs_per_hour and
@@ -249,6 +291,7 @@ def load_rounds(root: str = ".") -> "list[dict]":
             "service": _service_row(detail),
             "overlay": _overlay_row(detail),
             "mesh": _mesh_row(detail),
+            "elastic": _elastic_row(detail),
             "attempts": [
                 _attempt_row(a) for a in detail.get("attempts", [])
             ],
@@ -344,10 +387,11 @@ def main(argv=None) -> int:
     svc = service_check(rounds)
     ovl = overlay_check(rounds)
     msh = mesh_check(rounds)
+    ela = elastic_check(rounds)
     if args.json:
         print(json.dumps(
             {"rounds": rounds, "verdict": verdict, "service": svc,
-             "overlay": ovl, "mesh": msh}, indent=2
+             "overlay": ovl, "mesh": msh, "elastic": ela}, indent=2
         ))
     else:
         print(trajectory_table(rounds))
@@ -361,11 +405,15 @@ def main(argv=None) -> int:
         for grid, v in msh["grids"].items():
             if v.get("latest") is not None or v.get("best_prior") is not None:
                 print(f"mesh.{grid}: {v['note']}")
+        for row, v in ela["rows"].items():
+            if v.get("latest") is not None or v.get("best_prior") is not None:
+                print(f"elastic.{row}: {v['note']}")
     return 1 if (
         verdict.get("regression")
         or svc.get("regression")
         or ovl.get("regression")
         or msh.get("regression")
+        or ela.get("regression")
     ) else 0
 
 
