@@ -992,6 +992,210 @@ def _measure_elastic(num_hosts: int, sim_sec: float, replicas: int = 2):
     return out
 
 
+def _event_slot_bytes(ob) -> int:
+    """Wire bytes per exchanged event slot: the six per-slot arrays the
+    exchange actually moves (valid/dst/time/tie/aux + the data columns).
+    Shared by the bench exchange trial and tools/profile_kernels.py part
+    9, so the published bytes/host numbers always price the same wire
+    format the flush ships."""
+    import numpy as np
+
+    total = 0
+    for a in (ob.valid, ob.dst, ob.time, ob.tie, ob.aux, ob.data):
+        per_slot = a.dtype.itemsize
+        for d in a.shape[2:]:
+            per_slot *= d
+        total += per_slot
+    return int(np.asarray(total))
+
+
+def _measure_exchange(num_hosts: int, sim_sec: float, reps: int = 10):
+    """Exchange trial (runs in a disposable child, role=exchange;
+    docs/parallelism.md "Segment exchange"): the dense-vs-segment
+    comparison row for the event-exchange v2 rewrite.
+
+    Two measurements on the same phold world:
+
+      * flush-only wall: a busy staged outbox (a few handler iterations
+        with the round-boundary flush withheld), then the jitted flush
+        itself timed per exchange mode — the per-round exchange cost,
+        isolated from the rest of the round;
+      * sharded end-to-end: the same world through ShardedRunner per
+        mode, publishing per-live-round wall plus the ANALYTIC
+        bytes/host each mode's collective moves per round — all_to_all
+        buckets at the static heuristic capacity vs the segment ring at
+        the MEASURED high-water capacity (auto_a2a_capacity fed by the
+        probe's exch_hwm lane, the calibration loop this trial also
+        demonstrates).
+
+    Every row prints as it lands ({"exchange_row": ...}) so a timeout
+    keeps the rows already measured; tools/bench_history.py tracks the
+    flush walls and bytes/host as lower-is-better detail.exchange
+    metrics."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from shadow_tpu.engine import EngineConfig, ShardedRunner, init_state
+    from shadow_tpu.engine.round import (
+        _flush_outbox_traffic,
+        bootstrap,
+        handle_one_iteration,
+        run_until,
+    )
+    from shadow_tpu.engine.sharded import AXIS, auto_a2a_capacity
+    from shadow_tpu.graph import NetworkGraph, compute_routing
+    from shadow_tpu.models.phold import PholdModel
+    from shadow_tpu.simtime import NS_PER_MS
+
+    end = int(sim_sec * NS_PER_SEC)
+    n_nodes = 8
+    lines = ["graph [", "  directed 0"]
+    for i in range(n_nodes):
+        lines.append(f"  node [ id {i} ]")
+        lines.append(f'  edge [ source {i} target {i} latency "1 ms" ]')
+        lines.append(
+            f'  edge [ source {i} target {(i + 1) % n_nodes} latency "3 ms" ]'
+        )
+    lines.append("]")
+    graph = NetworkGraph.from_gml("\n".join(lines))
+    tables = compute_routing(graph).with_hosts(
+        [i % n_nodes for i in range(num_hosts)]
+    )
+    cfg = EngineConfig(
+        num_hosts=num_hosts,
+        runahead_ns=graph.min_latency_ns(),
+        seed=7,
+        tracker=True,
+    )
+    model = PholdModel(
+        num_hosts=num_hosts,
+        min_delay_ns=1 * NS_PER_MS,
+        max_delay_ns=8 * NS_PER_MS,
+    )
+    out = {"hosts": num_hosts, "sim_sec": sim_sec, "rows": []}
+
+    # ---- flush-only microbench: stage a busy outbox (handler
+    # iterations, flush withheld), then time the jitted flush per mode
+    st0 = bootstrap(init_state(cfg, model.init()), model, cfg)
+    we = jnp.asarray(end, jnp.int64)
+
+    @jax.jit
+    def _stage(st):
+        def body(s, _):
+            return handle_one_iteration(s, we, model, tables, cfg), None
+
+        return jax.lax.scan(body, st, None, length=4)[0]
+
+    busy = _stage(st0)
+    jax.block_until_ready(busy.events_handled)
+    staged = int(np.asarray(busy.outbox.fill).sum())
+    out["staged_events"] = staged
+    flush_ms = {}
+    for mode in ("dense", "segment"):
+        mcfg = dataclasses.replace(cfg, exchange=mode)
+        f = jax.jit(lambda s, c=mcfg: _flush_outbox_traffic(s, None, c))
+        jax.block_until_ready(f(busy).events_handled)  # compile
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            s = f(busy)
+            jax.block_until_ready(s.events_handled)
+            ts.append(time.perf_counter() - t0)
+        flush_ms[mode] = round(min(ts) * 1e3, 3)
+        row = {"kind": "flush", "mode": mode, "staged_events": staged,
+               "flush_ms": flush_ms[mode]}
+        out["rows"].append(row)
+        print(json.dumps({"exchange_row": row}), flush=True)
+
+    # ---- sharded end-to-end: per-live-round wall + analytic bytes/host
+    ndev = jax.device_count()
+    slot_bytes = _event_slot_bytes(st0.outbox)
+    out["slot_bytes"] = slot_bytes
+    measured_hwm = None
+    if ndev > 1 and num_hosts % ndev == 0:
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()), (AXIS,))
+        h_local = num_hosts // ndev
+        for mode in ("dense", "segment"):
+            row = {"kind": "sharded", "mode": mode, "devices": ndev}
+            try:
+                mcfg = dataclasses.replace(cfg, exchange=mode)
+                runner = ShardedRunner(
+                    mesh, model, tables, mcfg, rounds_per_chunk=32,
+                    measured_exchange_hwm=measured_hwm,
+                )
+
+                def _fresh():
+                    return bootstrap(
+                        init_state(cfg, model.init()), model, cfg
+                    )
+
+                s = runner.run_until(_fresh(), end)
+                jax.block_until_ready(s.events_handled)
+                t0 = time.perf_counter()
+                s = runner.run_until(_fresh(), end)
+                jax.block_until_ready(s.events_handled)
+                wall = time.perf_counter() - t0
+                rounds_live = int(np.asarray(s.tracker.rounds_live).max())
+                hwm = int(np.asarray(s.tracker.exch_hwm).max())
+                cap = auto_a2a_capacity(
+                    mcfg, ndev, measured_hwm=measured_hwm
+                )
+                row.update(
+                    wall_s=round(wall, 4),
+                    rounds_live=rounds_live,
+                    per_round_ms=round(wall / max(rounds_live, 1) * 1e3, 3),
+                    exch_hwm=hwm,
+                    bucket_capacity=cap,
+                    overflow=int(np.asarray(s.queue.overflow).sum())
+                    + int(np.asarray(s.outbox.overflow).sum()),
+                    # collective receive bytes per round, per host: each
+                    # device receives (d-1) buckets of `cap` slots
+                    bytes_per_host_per_round=round(
+                        (ndev - 1) * cap * slot_bytes / h_local, 1
+                    ),
+                )
+                if mode == "dense":
+                    # calibration: the dense run's measured per-round
+                    # traffic high-water sizes the segment ring buckets
+                    # (auto_a2a_capacity measured mode, the satellite-3
+                    # loop) — provably sufficient on this trajectory
+                    measured_hwm = hwm
+            except Exception as e:  # noqa: BLE001 — one failed mode must
+                # not kill the flush rows already measured
+                row["error"] = str(e)[:300]
+            out["rows"].append(row)
+            print(json.dumps({"exchange_row": row}), flush=True)
+
+    sharded = {
+        r["mode"]: r for r in out["rows"]
+        if r["kind"] == "sharded" and "per_round_ms" in r
+    }
+    summary = {}
+    for mode in ("dense", "segment"):
+        if mode in flush_ms:
+            summary[f"flush_ms.{mode}@{num_hosts}h"] = flush_ms[mode]
+        if mode in sharded:
+            summary[f"bytes_per_host.{mode}@{num_hosts}h"] = sharded[mode][
+                "bytes_per_host_per_round"
+            ]
+    if "dense" in flush_ms and "segment" in flush_ms and flush_ms["segment"]:
+        summary["flush_speedup_dense_over_segment"] = round(
+            flush_ms["dense"] / flush_ms["segment"], 2
+        )
+    if "dense" in sharded and "segment" in sharded:
+        db = sharded["dense"]["bytes_per_host_per_round"]
+        sb = sharded["segment"]["bytes_per_host_per_round"]
+        if sb:
+            summary["bytes_reduction_dense_over_segment"] = round(db / sb, 2)
+    out["summary"] = summary
+    return out
+
+
 def _measure_sweep(num_hosts: int, jobs: int = 8, capacity: int = 4):
     """Sweep trial (runs in a disposable child, role=sweep): an 8-job
     phold seed sweep through the PRODUCTION SweepService
@@ -1319,6 +1523,11 @@ def main():
     if role == "service":
         sh = int(os.environ.get("SHADOW_TPU_BENCH_SERVICE_HOSTS", 128))
         print(json.dumps({"service": _measure_service(sh)}))
+        return
+    if role == "exchange":
+        xh = int(os.environ.get("SHADOW_TPU_BENCH_EXCHANGE_HOSTS", 256))
+        xs = float(os.environ.get("SHADOW_TPU_BENCH_EXCHANGE_SIMSEC", 0.1))
+        print(json.dumps({"exchange": _measure_exchange(xh, xs)}))
         return
 
     # ---- orchestrator -------------------------------------------------
@@ -1859,6 +2068,65 @@ def main():
                     rows.append(obj["overlay_row"])
             overlay = {"rows": rows, "partial": True, "error": "timeout"}
 
+    # ---- exchange trial (event-exchange v2 round, docs/parallelism.md
+    # "Segment exchange"): the dense-vs-segment comparison row — flush
+    # wall on a busy outbox per mode, plus sharded per-round wall and
+    # collective bytes/host (ring at measured capacity vs dense
+    # buckets). SHADOW_TPU_BENCH_EXCHANGE=0 disables. --------------------
+    exchange = None
+    if os.environ.get("SHADOW_TPU_BENCH_EXCHANGE", "1") != "0" and _time_left() > 120:
+        xh = int(
+            os.environ.get(
+                "SHADOW_TPU_BENCH_EXCHANGE_HOSTS", 1024 if tpu_up else 256
+            )
+        )
+        env_extra = dict(
+            SHADOW_TPU_BENCH_ROLE="exchange",
+            SHADOW_TPU_BENCH_EXCHANGE_HOSTS=xh,
+        )
+        exch_env = _child_env(**env_extra) if tpu_up else _cpu_env(**env_extra)
+        if not tpu_up:
+            # like the mesh trial: the CPU rung measures the sharded
+            # exchange rows on the virtual 8-device mesh — 1 visible
+            # device would publish only the flush-only rows
+            exch_env["XLA_FLAGS"] = (
+                exch_env.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        rows = []
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=exch_env,
+                capture_output=True,
+                text=True,
+                timeout=500 if tpu_up else min(400.0, max(_time_left(), 90.0)),
+            )
+            for ln in r.stdout.strip().splitlines():
+                try:
+                    obj = json.loads(ln)
+                except ValueError:
+                    continue
+                if "exchange" in obj:
+                    exchange = obj["exchange"]
+                elif "exchange_row" in obj:
+                    rows.append(obj["exchange_row"])
+            if exchange is None and rows:
+                exchange = {"hosts": xh, "rows": rows, "partial": True}
+            if exchange is None:
+                exchange = {"error": f"rc={r.returncode}: {r.stderr[-300:]}"}
+        except subprocess.TimeoutExpired as e:
+            out_s = e.stdout.decode(errors="replace") if isinstance(e.stdout, bytes) else (e.stdout or "")
+            for ln in out_s.strip().splitlines():
+                try:
+                    obj = json.loads(ln)
+                except ValueError:
+                    continue
+                if "exchange_row" in obj:
+                    rows.append(obj["exchange_row"])
+            exchange = {"hosts": xh, "rows": rows, "partial": True,
+                        "error": "timeout"}
+
     # optional: the old JAX-on-CPU measurement, for the record only
     cpu_xla = None
     if os.environ.get("SHADOW_TPU_BENCH_CPU_XLA") == "1":
@@ -1930,6 +2198,15 @@ def main():
             }
             if cur:
                 history["mesh"] = bh.mesh_check(rounds, current=cur)
+        if exchange and exchange.get("summary"):
+            # the dense-vs-segment exchange rows: flush wall and
+            # bytes/host per mode, both lower-is-better wall/wire costs
+            cur = {
+                k: v for k, v in exchange["summary"].items()
+                if k.startswith(("flush_ms.", "bytes_per_host."))
+            }
+            if cur:
+                history["exchange"] = bh.exchange_check(rounds, current=cur)
         if elastic and elastic.get("reshape_replay_wall_s") is not None:
             # the reshape-replay wall row, keyed by grid AND world size
             # (lower is better — elastic_check inverts the direction)
@@ -1962,6 +2239,7 @@ def main():
                     **({"ensemble": ensemble} if ensemble else {}),
                     **({"mesh": mesh_trial} if mesh_trial else {}),
                     **({"overlay": overlay} if overlay else {}),
+                    **({"exchange": exchange} if exchange else {}),
                     **({"sweep": sweep} if sweep else {}),
                     **({"service": service} if service else {}),
                     **({"elastic": elastic} if elastic else {}),

@@ -34,8 +34,12 @@ Event kinds handled in-kernel are exactly the pump classes (P1 ingress
 defer/drop, P2 receiver data completion, P3 sender cumulative ACK +
 send-engine flush); everything else (handshakes, FIN/RST, recovery,
 timer fires, model triggers) is deferred to the full XLA handler in the
-same round iteration, and the round-boundary exchange stays on the
-existing host-exchange path (equeue.push_many_sorted / shard all_to_all).
+same round iteration, and the round-boundary exchange stays OUTSIDE the
+kernel on the flush_outbox path — the dense grid landing
+(equeue.push_many_sorted / shard all_to_all) or the sort-based segment
+exchange (equeue.push_many_segment / ppermute ring) per cfg.exchange;
+the kernel's per-host outbox staging is identical either way, which is
+what keeps the carry host-tileable (no global pool leaf ever enters it).
 See docs/megakernel.md for the VMEM tile layout and measured costs.
 """
 

@@ -41,11 +41,13 @@ by the same init_ensemble_state stack.
 
 One mesh-specific wrinkle: the destination-bucketed all_to_all exchange
 is not batchable under the replica vmap (jax has no batching rule for
-lax.all_to_all), so mesh configs resolve `exchange` to "all_gather" —
-trajectory-neutral by the exchange-mode contract (delivery order is
-key-driven; engine/round.py flush_outbox), at the cost of more ICI
-traffic per round. Refining the mesh exchange back to bucketed
-all_to_all is future work alongside ROADMAP item 1's segment exchange.
+lax.all_to_all), so dense mesh configs resolve `exchange` to
+"all_gather" — trajectory-neutral by the exchange-mode contract
+(delivery order is key-driven; engine/round.py flush_outbox), at the
+cost of more ICI traffic per round. exchange="segment" lifts the pin:
+its bucketed collective is a ppermute ring (engine/round.py
+_ring_exchange), and ppermute batches under vmap, so segment mesh runs
+move only per-peer buckets over ICI like the 1-D sharded plane does.
 """
 
 from __future__ import annotations
@@ -193,12 +195,16 @@ class MeshPlan:
 def mesh_engine_cfg(cfg: EngineConfig) -> EngineConfig:
     """The engine config a mesh batch actually traces: the ensemble
     resolution (done-mask armed, megakernel -> pump under the replica
-    vmap) plus the exchange pinned to all_gather — lax.all_to_all has no
-    vmap batching rule, and the two exchange modes are trajectory-
+    vmap) plus the exchange resolution. Dense modes pin to all_gather —
+    lax.all_to_all has no vmap batching rule — while "segment" passes
+    through unpinned: its bucketed collective is a ppermute ring
+    (engine/round.py _ring_exchange) and ppermute DOES batch under the
+    replica vmap, giving the mesh plane a destination-bucketed exchange
+    with no all_gather blowup. The exchange modes are trajectory-
     identical by contract (flush_outbox: delivery order is key-driven),
-    so the pin can never change a slice."""
+    so neither resolution can change a slice."""
     cfg = ensemble_engine_cfg(cfg)
-    if cfg.exchange != "all_gather":
+    if cfg.exchange not in ("all_gather", "segment"):
         cfg = dataclasses.replace(cfg, exchange="all_gather")
     return cfg
 

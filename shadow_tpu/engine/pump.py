@@ -45,6 +45,13 @@ clamped to the next round); a step that would emit a *local* event (flush
 continuation, timer maintenance) is rejected and left to the full
 handler, so nothing the pump produces can sort before a later pump step.
 
+The carry landing (pump_carry_finish) only ever touches the host's OWN
+row — defer-FIFO leftovers re-enter via conflict-free self-lane pushes
+and packet emissions re-enter the per-host outbox — so the pump is
+exchange-mode agnostic: the round-boundary cross-host landing happens
+entirely in flush_outbox afterwards (dense grid or sort-based segment
+exchange per cfg.exchange), identically for every engine.
+
 Models opt in by exposing `pump_spec` (see TcpPumpSpec); the spec's
 `block` hook vetoes steps where the embedding model itself would act on
 the new state (e.g. tgen's request-complete -> respond trigger).

@@ -479,18 +479,25 @@ def flush_outbox(
     """Round-boundary exchange: deliver staged packets into destination queues.
 
     Sharded, this is the cross-chip step (the analogue of the locked
-    cross-host EventQueue push, worker.rs:619-629), with two modes:
+    cross-host EventQueue push, worker.rs:619-629), with three modes:
 
-      * all_to_all (default): bucket outbox entries by destination shard,
-        exchange only each peer's bucket over ICI — per-shard traffic is
-        O(devices x bucket) instead of O(devices x whole outbox). Bucket
-        capacity is static (XLA shapes); overflow is counted and fails
-        loudly via check_capacity, like every other fixed-slot resource.
+      * all_to_all (default; "dense" is an alias): bucket outbox entries
+        by destination shard, exchange only each peer's bucket over ICI
+        — per-shard traffic is O(devices x bucket) instead of
+        O(devices x whole outbox). Bucket capacity is static (XLA
+        shapes); overflow is counted and fails loudly via
+        check_capacity, like every other fixed-slot resource.
       * all_gather: every shard receives every shard's whole outbox and
         filters its own rows (simple, never overflows, more traffic).
+      * segment: sort-based segment exchange (_flush_segment) — compact
+        the staged events into a flat dst-sorted pool, move per-peer
+        buckets over a ppermute ring (vmap-batchable, so the mesh plane
+        uses it unpinned), land via equeue.push_many_segment with
+        capacity checked once per round from pool/row occupancy.
 
-    Either way the destination pops by the (time, tie) key, so delivery
-    slot order — which differs between the modes — cannot affect results.
+    In every mode the destination pops by the (time, tie) key, so
+    delivery slot order — which differs between the modes — cannot
+    affect results.
     """
     # Empty rounds skip the exchange sorts entirely (lax.cond on a scalar
     # any-reduce). Sharded: the predicate is made mesh-uniform with a
@@ -514,6 +521,8 @@ def flush_outbox(
 def _flush_outbox_traffic(
     st: SimState, axis_name: Optional[str], cfg: "EngineConfig | None" = None
 ) -> SimState:
+    if cfg is not None and getattr(cfg, "exchange", "") == "segment":
+        return _flush_segment(st, axis_name, cfg)
     ob = st.outbox
     h_local, o_cap = ob.valid.shape
     m = h_local * o_cap
@@ -529,7 +538,7 @@ def _flush_outbox_traffic(
     if axis_name is not None:
         mode = getattr(cfg, "exchange", "all_to_all") if cfg is not None else "all_gather"
         base = jax.lax.axis_index(axis_name) * h_local
-        if mode == "all_to_all":
+        if mode in ("all_to_all", "dense"):
             d = _axis_size(axis_name)
             cap = getattr(cfg, "a2a_capacity", 0) or 0
             if cap <= 0:
@@ -600,6 +609,168 @@ def _flush_outbox_traffic(
         kind=jnp.full(valid.shape, KIND_PACKET, jnp.int32),
         data=data,
         aux=aux,
+    )
+
+    fresh = ob.replace(
+        valid=jnp.zeros_like(ob.valid),
+        time=jnp.full_like(ob.time, TIME_MAX),
+        fill=jnp.zeros_like(ob.fill),
+    )
+    if overflow_extra is not None:
+        fresh = fresh.replace(overflow=fresh.overflow.at[0].add(overflow_extra))
+    return st.replace(queue=queue, outbox=fresh)
+
+
+def _ring_exchange(arrs: tuple, axis_name: str, d: int) -> tuple:
+    """Bucketed ring collective for the segment exchange: every array in
+    `arrs` is a [d, cap, ...] per-peer bucket stack; shard i's bucket
+    for peer p moves to p over d-1 ppermute steps (step k sends bucket
+    (i+k)%d to peer (i+k)%d). Returns [d, cap, ...] arrays of received
+    buckets, own bucket first — reception order is static, and delivery
+    order is key-driven anyway.
+
+    Unlike lax.all_to_all, ppermute HAS a vmap batching rule, which is
+    what lets the 2-D mesh plane run this bucketed exchange under its
+    replica vmap instead of pinning to all_gather (engine/mesh.py).
+    Bytes over ICI: (d-1) x cap per array vs all_gather's (d-1) x m —
+    the lane-factor saving when cap (the measured per-round traffic)
+    is below the dense outbox width m."""
+    idx = jax.lax.axis_index(axis_name)
+    received = [
+        tuple(
+            jax.lax.dynamic_index_in_dim(a, idx, 0, keepdims=False)
+            for a in arrs
+        )
+    ]
+    for k in range(1, d):
+        perm = [(i, (i + k) % d) for i in range(d)]
+        send = tuple(
+            jax.lax.dynamic_index_in_dim(a, (idx + k) % d, 0, keepdims=False)
+            for a in arrs
+        )
+        received.append(
+            tuple(jax.lax.ppermute(s, axis_name, perm) for s in send)
+        )
+    return tuple(
+        jnp.stack([r[j] for r in received]) for j in range(len(arrs))
+    )
+
+
+def _flush_segment(
+    st: SimState, axis_name: Optional[str], cfg: "EngineConfig"
+) -> SimState:
+    """Segment-exchange flush (exchange="segment", event-exchange v2):
+
+      1. POOL — one stable (dst, time, tie) multi-operand sort compacts
+         the round's staged events into the first slots of a flat
+         buffer; the leading pool_capacity entries (0 = the whole
+         flattened outbox, never truncates) ARE the time-sorted compact
+         pool (count + ragged offsets implicit in the sorted keys).
+         Events beyond the pool overflow loudly (outbox lane).
+      2. EXCHANGE (sharded/mesh) — the pool is already grouped by
+         destination shard (global dst sort), so per-peer buckets fall
+         out of the same rank arithmetic as the dense all_to_all; the
+         buckets move over a ppermute ring (_ring_exchange), which —
+         unlike lax.all_to_all — batches under the mesh plane's replica
+         vmap. Bucket capacity follows a2a_capacity (<=0 = whole pool,
+         never overflows).
+      3. LAND — equeue.push_many_segment: one destination sort + a
+         free-slot gather + M-sized scatters, with capacity checked
+         once per row from pool/row occupancy instead of per lane.
+
+    Trajectory/stat-leaf bit-exact vs the dense path by the pop-order
+    contract (delivery slot order is key-driven); queue arrays are
+    slot-permuted only."""
+    ob = st.outbox
+    h_local, o_cap = ob.valid.shape
+    m = h_local * o_cap
+
+    def flat(x):
+        return x.reshape((m,) + x.shape[2:])
+
+    valid, dst, time, tie = flat(ob.valid), flat(ob.dst), flat(ob.time), flat(ob.tie)
+    data, aux = flat(ob.data), flat(ob.aux)
+
+    # 1. pool compaction: valids first, grouped by destination, time-
+    # sorted within each destination segment
+    big = jnp.int32(1 << 30)
+    key = jnp.where(valid, dst, big)
+    _, time_p, tie_p, aux_p, valid_p, dst_p, *data_cols = jax.lax.sort(
+        (key, time, tie, aux, valid, dst)
+        + tuple(data[:, i] for i in range(data.shape[1])),
+        num_keys=3,
+        is_stable=True,
+    )
+    e_max = min(getattr(cfg, "pool_capacity", 0) or m, m)
+    n_valid = jnp.sum(valid, dtype=jnp.int32)
+    pool_drop = (
+        jnp.maximum(n_valid - e_max, 0).astype(jnp.int32)
+        if e_max < m
+        else None
+    )
+    valid_p = valid_p[:e_max]
+    dst_p, time_p, tie_p, aux_p = (
+        dst_p[:e_max], time_p[:e_max], tie_p[:e_max], aux_p[:e_max],
+    )
+    data_p = jnp.stack([c[:e_max] for c in data_cols], axis=-1)
+    overflow_extra = pool_drop
+
+    base = 0
+    if axis_name is not None:
+        d = _axis_size(axis_name)
+        base = jax.lax.axis_index(axis_name) * h_local
+        cap = getattr(cfg, "a2a_capacity", 0)
+        cap = e_max if cap <= 0 else min(cap, e_max)
+        # per-peer buckets: the pool is dst-sorted, so destination-shard
+        # segments are contiguous; same rank/bucketize pattern as the
+        # dense all_to_all branch
+        pos = jnp.arange(e_max)
+        shard_of = jnp.where(valid_p, dst_p // h_local, d).astype(jnp.int32)
+        seg_start = jnp.concatenate(
+            [jnp.ones((1,), bool), shard_of[1:] != shard_of[:-1]]
+        )
+        rank = (pos - jax.lax.cummax(jnp.where(seg_start, pos, -1))).astype(
+            jnp.int32
+        )
+        fits = valid_p & (rank < cap)
+        sdst = jnp.where(fits, shard_of, d)
+        sslot = jnp.where(fits, rank, cap)
+        ring_over = jnp.sum(valid_p & ~fits).astype(jnp.int32)
+        overflow_extra = (
+            ring_over if overflow_extra is None else overflow_extra + ring_over
+        )
+
+        def bucketize(x, fill):
+            buf = jnp.full((d, cap) + x.shape[1:], fill, x.dtype)
+            return buf.at[sdst, sslot].set(x, mode="drop")
+
+        valid_p, dst_p, time_p, tie_p, aux_p, data_p = (
+            b.reshape((d * cap,) + b.shape[2:])
+            for b in _ring_exchange(
+                (
+                    bucketize(valid_p, False),
+                    bucketize(dst_p, 0),
+                    bucketize(time_p, TIME_MAX),
+                    bucketize(tie_p, 0),
+                    bucketize(aux_p, 0),
+                    bucketize(data_p, 0),
+                ),
+                axis_name,
+                d,
+            )
+        )
+
+    local_dst = dst_p - base
+    mine = valid_p & (local_dst >= 0) & (local_dst < h_local)
+    queue = equeue.push_many_segment(
+        q=st.queue,
+        dst=local_dst,
+        valid=mine,
+        time=time_p,
+        tie=tie_p,
+        kind=jnp.full(valid_p.shape, KIND_PACKET, jnp.int32),
+        data=data_p,
+        aux=aux_p,
     )
 
     fresh = ob.replace(
@@ -727,6 +898,14 @@ def run_round(
             tracker=st.tracker.replace(
                 outbox_hwm=jnp.maximum(st.tracker.outbox_hwm, st.outbox.fill),
                 queue_hwm=jnp.maximum(st.tracker.queue_hwm, st.queue.count),
+                # per-round exchange traffic high-water (row 0, like
+                # iters_done): sum of staged events right before the
+                # flush — the measured figure that sizes a2a/segment
+                # ring buckets (sharded.auto_a2a_capacity) and the pool
+                # occupancy CapacityError reports
+                exch_hwm=st.tracker.exch_hwm.at[0].max(
+                    jnp.sum(st.outbox.fill).astype(jnp.int32)
+                ),
             )
         )
     st = flush_outbox(st, axis_name, cfg)
@@ -883,16 +1062,18 @@ def _peek_next_time(st: SimState) -> jax.Array:
 
 @jax.jit
 def _peek_capacity(st: SimState) -> jax.Array:
-    """[4] i64: queue overflow, outbox overflow, queue hwm, outbox hwm —
-    the split check_capacity reports so a blowup names the saturated
-    counter without a rerun. With state_probe's overflow lanes, the
-    only two places that define what counts as a dropped slot."""
+    """[5] i64: queue overflow, outbox overflow, queue hwm, outbox hwm,
+    exchange hwm — the split check_capacity reports so a blowup names
+    the saturated counter without a rerun. With state_probe's overflow
+    lanes, the only two places that define what counts as a dropped
+    slot."""
     return jnp.stack(
         [
             jnp.sum(st.queue.overflow).astype(jnp.int64),
             jnp.sum(st.outbox.overflow).astype(jnp.int64),
             jnp.max(st.tracker.queue_hwm).astype(jnp.int64),
             jnp.max(st.tracker.outbox_hwm).astype(jnp.int64),
+            jnp.max(st.tracker.exch_hwm).astype(jnp.int64),
         ]
     )
 
@@ -939,7 +1120,12 @@ PROBE_ROUNDS_IDLE = 18
 PROBE_ITERS = 19
 PROBE_LANES_LIVE = 20
 PROBE_WIN_NS = 21
-PROBE_LANES = 22
+# exchange traffic high-water: most events any shard flushed in one
+# round (tracker plane, pmax'd sharded) — feeds measured a2a/segment
+# bucket sizing (sharded.auto_a2a_capacity) and the pool-occupancy
+# figure in CapacityError
+PROBE_EXCH_HWM = 22
+PROBE_LANES = 23
 
 
 def state_probe(st: SimState, axis_name: Optional[str] = None) -> jax.Array:
@@ -972,6 +1158,7 @@ def state_probe(st: SimState, axis_name: Optional[str] = None) -> jax.Array:
         st.now,
         jnp.max(tr.queue_hwm).astype(jnp.int64),
         jnp.max(tr.outbox_hwm).astype(jnp.int64),
+        jnp.max(tr.exch_hwm).astype(jnp.int64),
     ]
     # replicated scalars (win_ns_sum is mesh-uniform: pmin'd window math)
     rounds = [tr.rounds_live, tr.rounds_idle, st.win_ns_sum]
@@ -980,12 +1167,12 @@ def state_probe(st: SimState, axis_name: Optional[str] = None) -> jax.Array:
         sums = [jax.lax.psum(x, axis_name) for x in sums]
         maxes = [jax.lax.pmax(x, axis_name) for x in maxes]
         rounds = [jax.lax.pmax(x, axis_name) for x in rounds]
-    now, qh, oh = maxes
+    now, qh, oh, xh = maxes
     (ov, ev, pk, qov, oov, evl, evt, dl, dc, du, bc, bd, rx, it, ll) = sums
     rl, ri, wn = rounds
     return jnp.stack(
         [nt, ov, now, ev, pk, qov, oov, evl, evt, dl, dc, du, bc, bd, rx,
-         qh, oh, rl, ri, it, ll, wn]
+         qh, oh, rl, ri, it, ll, wn, xh]
     ).astype(jnp.int64)
 
 
@@ -1018,6 +1205,9 @@ class ChunkProbe:
     iters: int
     lanes_live: int
     win_ns_sum: int
+    # most events any shard flushed in one round (tracker plane; 0 when
+    # cfg.tracker is off) — the measured per-round exchange traffic
+    exch_hwm: int
 
     @property
     def ev_packet(self) -> int:
@@ -1059,6 +1249,10 @@ class CapacityError(RuntimeError):
     outbox_overflow: int = 0
     queue_hwm: int = 0
     outbox_hwm: int = 0
+    # exchange-pool occupancy high-water (most events flushed in one
+    # round, PROBE_EXCH_HWM; 0 without cfg.tracker) — the figure that
+    # says whether a segment pool / a2a bucket was sized too small
+    exchange_hwm: int = 0
     shard_detail: "str | None" = None
     # ensemble runs (engine/ensemble.py): index of the replica whose
     # probe row carried the overflow (None for single-world runs)
@@ -1216,10 +1410,11 @@ def check_capacity(st: SimState) -> None:
     simulation has silently dropped events and no longer matches the
     determinism contract (the tensor-shaped analogue of the reference's
     unbounded queues never dropping)."""
-    qov, oov, qh, oh = (int(x) for x in _peek_capacity(st))
+    qov, oov, qh, oh, xh = (int(x) for x in _peek_capacity(st))
     if qov or oov:
         raise _capacity_error(
-            qov + oov, queue_ov=qov, outbox_ov=oov, queue_hwm=qh, outbox_hwm=oh
+            qov + oov, queue_ov=qov, outbox_ov=oov, queue_hwm=qh,
+            outbox_hwm=oh, exch_hwm=xh,
         )
 
 
@@ -1248,6 +1443,7 @@ def host_stats(st: SimState) -> dict:
             "outbox_hwm": st.tracker.outbox_hwm,
             "rounds_live": st.tracker.rounds_live,
             "rounds_idle": st.tracker.rounds_idle,
+            "exch_hwm": st.tracker.exch_hwm,
             "iters_done": st.iters_done,
             "lanes_live": st.lanes_live,
             "win_ns_sum": st.win_ns_sum,
@@ -1274,11 +1470,14 @@ def _capacity_error(
     outbox_ov: "int | None" = None,
     queue_hwm: "int | None" = None,
     outbox_hwm: "int | None" = None,
+    exch_hwm: "int | None" = None,
 ) -> CapacityError:
     """The split (when known — it rides the probe's dedicated lanes, so
     every driver has it) names WHICH fixed-slot counter saturated; the
     high-water marks (tracker plane, nonzero only with cfg.tracker) say
-    how close to the rim the other one ran."""
+    how close to the rim the other one ran, and the exchange high-water
+    (PROBE_EXCH_HWM) reports the pool occupancy an exchange-side drop
+    was up against."""
     if queue_ov is None:
         which = "queue.overflow/outbox.overflow"
     else:
@@ -1293,19 +1492,54 @@ def _capacity_error(
         )
         if queue_hwm or outbox_hwm:
             which += f"; high-water queue={queue_hwm}, outbox={outbox_hwm}"
+        if exch_hwm:
+            which += f"; exchange pool occupancy hwm={exch_hwm} events/round"
         which += "]"
     err = CapacityError(
         f"event capacity exhausted: {dropped} events/packets dropped "
         f"({which}); increase queue_capacity/"
         f"outbox_capacity — or, for sharded all_to_all runs with "
         f"pair-skewed destinations, set a2a_capacity=-1 (whole-outbox "
-        f"buckets, never overflow)"
+        f"buckets, never overflow); segment-exchange runs "
+        f"(exchange='segment') raise the pool with pool_capacity "
+        f"(0 = whole outbox, never truncates)"
     )
     err.queue_overflow = int(queue_ov or 0)
     err.outbox_overflow = int(outbox_ov or 0)
     err.queue_hwm = int(queue_hwm or 0)
     err.outbox_hwm = int(outbox_hwm or 0)
+    err.exchange_hwm = int(exch_hwm or 0)
     return err
+
+
+def capacity_topk(st: SimState, k: int = 5) -> str:
+    """Failure-path diagnostic: the top-k destination hosts by landed
+    events (queue occupancy / overflow / high-water), one bulk fetch of
+    the [H] counters — the local-rows analogue of the sharded driver's
+    `_capacity_detail` probe-lane breakdown, naming WHERE the landing
+    side saturated. Wired as `_drive`'s capacity_detail for
+    single-device runs and appended to the sharded per-shard rows."""
+    import numpy as np
+
+    cnt, ov, hwm, hid = (
+        np.asarray(a)
+        for a in jax.device_get(
+            (st.queue.count, st.queue.overflow, st.tracker.queue_hwm, st.host_id)
+        )
+    )
+    score = ov.astype(np.int64) * 1_000_000 + np.maximum(
+        hwm.astype(np.int64), cnt.astype(np.int64)
+    )
+    order = np.argsort(-score, kind="stable")[:k]
+    rows = [
+        f"host {int(hid[i])} (count={int(cnt[i])}, overflow={int(ov[i])}, "
+        f"hwm={int(hwm[i])})"
+        for i in order
+        if score[i] > 0
+    ]
+    if not rows:
+        return ""
+    return "top destination hosts by landed events: " + "; ".join(rows)
 
 
 def _tspan(tracker, name, **args):
@@ -1456,6 +1690,7 @@ def _drive(launch, st, end_time, max_chunks, on_chunk, pipeline, desc,
                 outbox_ov=probe.outbox_overflow,
                 queue_hwm=probe.queue_hwm,
                 outbox_hwm=probe.outbox_hwm,
+                exch_hwm=probe.exch_hwm,
             )
             if capacity_detail is not None:
                 try:
@@ -1595,6 +1830,7 @@ def run_until(
         launch, st, end_time, max_chunks, on_chunk, pipeline,
         desc=f"{max_chunks}x{rounds_per_chunk} rounds",
         tracker=tracker, on_state=on_state,
+        capacity_detail=capacity_topk,
         watchdog_s=watchdog_s, engine=effective_engine(cfg),
     )
 

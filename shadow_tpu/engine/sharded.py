@@ -56,19 +56,39 @@ from shadow_tpu.graph.routing import RoutingTables
 AXIS = "hosts"
 
 
-def auto_a2a_capacity(cfg: "EngineConfig", num_devices: int, safety: int = 4) -> int:
-    """Size the per-peer all_to_all bucket from the topology of the
-    exchange rather than the never-overflow default (= the whole local
-    outbox). With destinations spread over the mesh, each peer sees about
-    1/num_devices of a shard's outbox; `safety` covers skew. Overflow is
-    counted on device and fails loudly via check_capacity, so a too-small
-    bucket is an error, never silent corruption (the exchange seam the
-    reference locks a mutex for, worker.rs:619-629).
+def auto_a2a_capacity(
+    cfg: "EngineConfig",
+    num_devices: int,
+    safety: int = 4,
+    measured_hwm: "int | None" = None,
+) -> int:
+    """Size the per-peer exchange bucket (all_to_all buckets; the
+    segment mode's ring buckets) rather than the never-overflow default
+    (= the whole local outbox / pool). Overflow is counted on device and
+    fails loudly via check_capacity, so a too-small bucket is an error,
+    never silent corruption (the exchange seam the reference locks a
+    mutex for, worker.rs:619-629).
 
+    With `measured_hwm` — the per-round per-shard exchange high-water
+    from a prior run's probe (ChunkProbe.exch_hwm, accumulated under
+    cfg.tracker) — the bucket derives from traffic actually observed:
+    any peer receives at most what one source shard flushed in a round,
+    so hwm-sized buckets provably never overflow on the measured
+    trajectory; a 25% margin covers workload drift between the
+    measuring and the measured run. This replaces the static safety
+    multiplier, which over-allocates on sparse worlds by construction
+    (it scales with the outbox you configured, not the traffic you
+    send).
+
+    Without a measurement, the topology heuristic remains: each peer
+    sees about 1/num_devices of a shard's outbox, `safety` covers skew.
     Returns a capacity strictly below the local outbox size once
     num_devices > safety — that gap is the ICI traffic saving.
     """
     local_m = max(1, (cfg.num_hosts // num_devices) * cfg.outbox_capacity)
+    if measured_hwm is not None and measured_hwm > 0:
+        margin = -(-int(measured_hwm) // 4)  # ceil(25%)
+        return min(local_m, max(1, int(measured_hwm) + margin))
     return min(local_m, max(1, -(-safety * local_m // num_devices)))
 
 
@@ -96,6 +116,7 @@ class ShardedRunner:
         tables: RoutingTables,
         cfg: EngineConfig,
         rounds_per_chunk: int = 64,
+        measured_exchange_hwm: "int | None" = None,
     ):
         if cfg.num_hosts % mesh.shape[AXIS] != 0:
             raise ValueError(
@@ -103,17 +124,26 @@ class ShardedRunner:
                 f"{mesh.shape[AXIS]} devices on axis {AXIS!r}"
             )
         validate_runahead(cfg, tables)
-        if cfg.exchange == "all_to_all" and cfg.a2a_capacity == 0:
-            # ordinary sharded runs get the topology-derived bucket size by
-            # default (round-3 verdict Weak #3: the whole-outbox fallback
-            # saves no ICI traffic); overflow still fails loudly via
-            # check_capacity, so skew beyond the safety factor is an
+        if (
+            cfg.exchange in ("all_to_all", "dense", "segment")
+            and cfg.a2a_capacity == 0
+        ):
+            # a2a_capacity == 0 asks for the auto bucket: measured from
+            # per-round traffic when the caller supplies a prior run's
+            # probe high-water (ChunkProbe.exch_hwm), else the topology
+            # heuristic (round-3 verdict Weak #3: the whole-outbox
+            # fallback saves no ICI traffic). Overflow still fails
+            # loudly via check_capacity, so an undersized bucket is an
             # error telling the user to set a2a_capacity=-1 (whole
-            # outbox, never overflows), never silent loss.
+            # outbox/pool, never overflows), never silent loss.
             import dataclasses
 
             cfg = dataclasses.replace(
-                cfg, a2a_capacity=auto_a2a_capacity(cfg, mesh.shape[AXIS])
+                cfg,
+                a2a_capacity=auto_a2a_capacity(
+                    cfg, mesh.shape[AXIS],
+                    measured_hwm=measured_exchange_hwm,
+                ),
             )
         self.mesh = mesh
         self.model = model
@@ -188,7 +218,15 @@ class ShardedRunner:
                         f"outbox_hwm={int(ohw[i].max())}"
                     )
                 rows.append(row)
-        return "per-shard overflow: " + "; ".join(rows) if rows else ""
+        detail = "per-shard overflow: " + "; ".join(rows) if rows else ""
+        # the landing-side view: which destination hosts the dropped
+        # events were piling onto (engine/round.py capacity_topk)
+        from shadow_tpu.engine.round import capacity_topk
+
+        topk = capacity_topk(st)
+        if topk:
+            detail = f"{detail}\n{topk}" if detail else topk
+        return detail
 
     def run_until(
         self,

@@ -62,10 +62,28 @@ class EngineConfig:
     # the round-end clamp DOES move delivery times, so window width is
     # semantics-bearing there and stays fixed.
     adaptive_window: bool = True
-    # Sharded round-boundary exchange (the cross-chip seam, the analogue of
-    # worker.rs:619-629): "all_to_all" buckets outbox entries by destination
-    # shard and exchanges only each peer's bucket over ICI; "all_gather"
-    # replicates every shard's whole outbox (more traffic, never overflows).
+    # Round-boundary exchange mode (the cross-chip seam, the analogue of
+    # worker.rs:619-629). Two landing families, trajectory-identical by
+    # contract (delivery slot order is key-driven; engine/round.py
+    # flush_outbox):
+    #   dense  — route packets into a dest-major [H, deliver_lanes] grid
+    #            via three multi-operand sorts (equeue.push_many_sorted)
+    #            and merge it with fused per-lane selects. "all_to_all"
+    #            (default) buckets outbox entries by destination shard
+    #            and exchanges only each peer's bucket over ICI;
+    #            "all_gather" replicates every shard's whole outbox
+    #            (more traffic, never overflows); "dense" is an explicit
+    #            alias for "all_to_all".
+    #   "segment" — sort-based segment exchange (event-exchange v2):
+    #            compact the round's in-flight events into a flat
+    #            dst-sorted pool (pool_capacity), move shard buckets
+    #            over a ppermute ring (batchable under the mesh plane's
+    #            replica vmap, unlike lax.all_to_all), and land with one
+    #            M-sized free-slot scatter + segment offsets
+    #            (equeue.push_many_segment) — cost scales with the
+    #            traffic actually in flight, not the [H, lanes] grid,
+    #            and capacity is checked once per round (pool/row
+    #            occupancy) instead of per lane.
     exchange: str = "all_to_all"
     # per-peer bucket capacity for all_to_all:
     #  -1  (default) = the whole local outbox: never overflows. PDES
@@ -78,7 +96,19 @@ class EngineConfig:
     #        factor fails loudly via check_capacity. Direct flush_outbox
     #        callers treat 0 like -1;
     #  >0  = explicit bucket size.
+    # Under exchange="segment" the same knob sizes the per-peer ring
+    # buckets (-1/0-direct = the whole pool: never overflows; 0 under
+    # ShardedRunner = auto, measured when an exchange high-water is
+    # supplied — see auto_a2a_capacity).
     a2a_capacity: int = -1
+    # Segment-exchange pool size (exchange="segment" only): the flat
+    # [E_max] dst-sorted buffer the round's in-flight events compact
+    # into before the collective/landing. 0 (default) = the whole
+    # flattened outbox (num_hosts_local * outbox_capacity — never
+    # truncates); >0 = explicit, smaller pools cut sort width and
+    # ring-bucket bytes, and events beyond the pool are counted loudly
+    # into outbox overflow (check_capacity names this knob).
+    pool_capacity: int = 0
     # Round-boundary delivery grid width: the exchange routes packets into
     # a dest-major [H, deliver_lanes] grid via three multi-operand sorts
     # (equeue.push_many_sorted) and merges it densely — zero scatters.
@@ -154,6 +184,13 @@ class EngineConfig:
                 f"unknown engine {self.engine!r} "
                 "(expected 'auto', 'plain', 'pump', or 'megakernel')"
             )
+        if self.exchange not in ("all_to_all", "all_gather", "dense", "segment"):
+            raise ValueError(
+                f"unknown exchange {self.exchange!r} (expected 'all_to_all', "
+                "'all_gather', 'dense', or 'segment')"
+            )
+        if self.pool_capacity < 0:
+            raise ValueError("pool_capacity must be >= 0 (0 = whole outbox)")
         if self.engine == "pump" and self.pump_k <= 0:
             raise ValueError("engine='pump' requires pump_k > 0")
         if self.megakernel_tile < 0 or (
@@ -183,8 +220,13 @@ def trace_static_cfg(cfg: EngineConfig) -> EngineConfig:
     it to 0 here means two worlds differing ONLY in seed hash to the
     same jit cache key and reuse one compiled chunk executable, which is
     what lets a sweep of N seeds pay one XLA compile
-    (runtime/compile_cache.py; docs/service.md)."""
-    return dataclasses.replace(cfg, seed=0)
+    (runtime/compile_cache.py; docs/service.md).
+
+    "dense" is a pure alias of "all_to_all" (same trace), so it
+    canonicalizes too — the alias exists so configs/tests can name the
+    dense landing family explicitly against "segment"."""
+    exchange = "all_to_all" if cfg.exchange == "dense" else cfg.exchange
+    return dataclasses.replace(cfg, seed=0, exchange=exchange)
 
 
 @flax.struct.dataclass
@@ -252,6 +294,13 @@ class TrackerState:
     outbox_hwm: jax.Array  # [H] i32 outbox fill high-water mark
     rounds_live: jax.Array  # scalar i64 rounds that ran a drain loop
     rounds_idle: jax.Array  # scalar i64 rounds skipped by the idle branch
+    # Exchange high-water: the most events this shard flushed in any
+    # single round (sum of outbox.fill at flush time), accumulated on
+    # row 0 like SimState.iters_done so the leaf stays host-led under
+    # sharding. This is the measured per-round traffic that sizes
+    # all_to_all / segment-ring buckets (sharded.auto_a2a_capacity) and
+    # the pool-occupancy figure CapacityError reports.
+    exch_hwm: jax.Array  # [H] i32
 
 
 def _empty_tracker(h: int) -> TrackerState:
@@ -265,6 +314,7 @@ def _empty_tracker(h: int) -> TrackerState:
         outbox_hwm=jnp.zeros((h,), jnp.int32),
         rounds_live=jnp.asarray(0, jnp.int64),
         rounds_idle=jnp.asarray(0, jnp.int64),
+        exch_hwm=jnp.zeros((h,), jnp.int32),
     )
 
 

@@ -306,6 +306,7 @@ class CheckpointManager:
         if self.engine_cfg is not None:
             meta["deliver_lanes"] = self.engine_cfg.deliver_lanes
             meta["a2a_capacity"] = self.engine_cfg.a2a_capacity
+            meta["pool_capacity"] = self.engine_cfg.pool_capacity
         t0 = time.perf_counter()
         save_checkpoint(path, host_state, meta)
         # flight recorder: checkpoint walls are part of the metrics

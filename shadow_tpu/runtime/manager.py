@@ -915,7 +915,7 @@ class Manager:
             qc, oc = meta.get("queue_capacity"), meta.get("outbox_capacity")
             if qc and oc:
                 overrides.update(queue_capacity=qc, outbox_capacity=oc)
-            for knob in ("deliver_lanes", "a2a_capacity"):
+            for knob in ("deliver_lanes", "a2a_capacity", "pool_capacity"):
                 if knob in meta:
                     overrides[knob] = meta[knob]
             if any(

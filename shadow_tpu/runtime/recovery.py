@@ -98,6 +98,11 @@ def grown_cfg(cfg, err: CapacityError, growth: int):
             # lane; an explicit bucket size must grow too or the replay
             # would deterministically hit the identical bucket overflow
             changes["a2a_capacity"] = cfg.a2a_capacity * growth
+        if getattr(cfg, "pool_capacity", 0) > 0:
+            # segment-exchange pool truncation counts into the outbox
+            # lane too; same argument as a2a_capacity (pool_capacity=0
+            # is the whole outbox and never truncates, nothing to grow)
+            changes["pool_capacity"] = cfg.pool_capacity * growth
     return dataclasses.replace(cfg, **changes)
 
 

@@ -148,6 +148,15 @@ SLOW_TESTS = {
     "test_adaptive_matches_fixed_tgen_engines",
     "test_adaptive_matches_fixed_sharded",
     "test_adaptive_matches_fixed_ensemble_slices",
+    # Event-exchange v2 (tests/test_exchange.py): the quick tier keeps
+    # one dense-vs-segment phold smoke per engine plus the pure
+    # pool/ergonomics pins (~50s); the full 6-model x 3-engine matrix
+    # (an XLA compile per cell), the ensemble/mesh slice cells, and the
+    # segment chaos-recovery pin run in the full tier
+    "test_segment_matches_dense_matrix",
+    "test_ensemble_segment_slices_exact",
+    "test_mesh_segment_slices_match_single_dense",
+    "test_segment_chaos_capacity_recovers_leaf_exact",
     # ~25 s; the quick tier already runs the real checkpoint machinery
     # with adaptive windows on by default (tests/test_robustness.py)
     "test_adaptive_checkpoint_roundtrip_leaf_exact",

@@ -104,6 +104,7 @@ def _canon(st):
             rounds_idle=st.tracker.rounds_idle * 0,
             queue_hwm=st.tracker.queue_hwm * 0,
             outbox_hwm=st.tracker.outbox_hwm * 0,
+            exch_hwm=st.tracker.exch_hwm * 0,
         ),
     )
 

@@ -119,11 +119,12 @@ def _setup_bulk(num_hosts, seed=17, exchange="all_to_all"):
     return cfg, model, tables, st
 
 
-@pytest.mark.parametrize("exchange", ["all_to_all", "all_gather"])
+@pytest.mark.parametrize("exchange", ["all_to_all", "all_gather", "segment"])
 def test_sharded_bulk_tcp_1k_hosts_matches_single(exchange):
     """1024-host bulk-TCP (full simulated stack) sharded over 8 devices
-    with the destination-bucketed all-to-all exchange must equal the
-    single-device run bit for bit."""
+    with the destination-bucketed all-to-all exchange — or the sort-based
+    segment exchange's ppermute ring — must equal the single-device run
+    bit for bit."""
     assert jax.device_count() == 8
     cfg, model, tables, st0 = _setup_bulk(num_hosts=1024, exchange=exchange)
     end = 40 * NS_PER_MS

@@ -401,3 +401,133 @@ def test_bench_history_tracks_elastic_reshape_wall(tmp_path):
     )
     assert r.returncode == 1
     assert f"elastic.{key}: REGRESSION" in r.stdout
+
+
+def test_bench_history_tracks_exchange_metrics(tmp_path):
+    """Event-exchange v2 satellite: detail.exchange's dense-vs-segment
+    flush wall and bytes/host rows get best-prior flagging with the
+    direction inverted (both are costs) — a slower flush or fatter wire
+    row past tolerance is a regression, and a round that stops
+    publishing a row flags as null."""
+
+    def _round(n, value, detail_extra):
+        (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps({
+            "n": n,
+            "parsed": {
+                "metric": "m", "value": value,
+                "detail": {
+                    "config": {"hosts": 128},
+                    "main": {"wall_s": 1.0},
+                    "attempts": [],
+                    **detail_extra,
+                },
+            },
+        }))
+
+    _round(1, 0.10, {})  # pre-exchange round: no block at all
+    _round(2, 0.12, {"exchange": {"hosts": 256, "summary": {
+        "flush_ms.dense@256h": 37.8,
+        "flush_ms.segment@256h": 9.8,
+        "bytes_per_host.dense@256h": 3192.0,
+        "bytes_per_host.segment@256h": 174.6,
+        "flush_speedup_dense_over_segment": 3.88,  # ratio: not tracked
+    }}})
+
+    sys.path.insert(0, str(TOOLS))
+    try:
+        import bench_history as bh
+    finally:
+        sys.path.pop(0)
+
+    rounds = bh.load_rounds(str(tmp_path))
+    assert rounds[0]["exchange"] is None
+    assert rounds[1]["exchange"] == {
+        "flush_ms.dense@256h": 37.8,
+        "flush_ms.segment@256h": 9.8,
+        "bytes_per_host.dense@256h": 3192.0,
+        "bytes_per_host.segment@256h": 174.6,
+    }
+
+    v = bh.exchange_check(rounds)  # newest round vs (empty) history
+    assert v["regression"] is False
+
+    key = "flush_ms.segment@256h"
+    v = bh.exchange_check(rounds, current={key: 5.0})  # faster: fine
+    assert v["rows"][key]["regression"] is False
+    v = bh.exchange_check(rounds, current={key: 20.0})  # slower: flagged
+    assert v["rows"][key]["regression"] is True
+    assert "REGRESSION" in v["rows"][key]["note"]
+
+    # a recorded slower round trips the CLI exit code, naming the row
+    _round(3, 0.13, {"exchange": {"hosts": 256, "summary": {
+        "flush_ms.dense@256h": 38.0,
+        "flush_ms.segment@256h": 30.0,
+        "bytes_per_host.dense@256h": 3192.0,
+        "bytes_per_host.segment@256h": 174.6,
+    }}})
+    r = subprocess.run(
+        [sys.executable, str(TOOLS / "bench_history.py"), str(tmp_path)],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 1
+    assert f"exchange.{key}: REGRESSION" in r.stdout
+
+
+def test_tier1_budget_check(tmp_path):
+    """Event-exchange v2 satellite: the quick tier runs under a hard
+    870s wall (ROADMAP.md tier-1 verify); tools/check_tier1_budget.py
+    turns the conftest SLOW_TESTS rebalance discipline into an
+    executable check over the tee'd pytest log."""
+    sys.path.insert(0, str(TOOLS))
+    try:
+        import check_tier1_budget as ct
+    finally:
+        sys.path.pop(0)
+
+    budget = json.loads((TOOLS / "tier1_budget.json").read_text())
+    assert budget["wall_cap_s"] == 870  # the ROADMAP verify cap
+
+    # summary-line parsing: short and hour-clock forms, last line wins
+    log = (
+        "........ [100%]\n"
+        "= 12 passed in 42.50s =\n"
+        "= 228 passed, 1 failed, 96 deselected in 612.34s (0:10:12) =\n"
+    )
+    assert ct.parse_wall_seconds(log) == 612.34
+    assert ct.parse_wall_seconds("no summary here\n") is None
+
+    # verdicts: ok / within-margin / over-cap / killed-before-summary
+    b = {"wall_cap_s": 870, "warn_margin_s": 30}
+    assert ct.verdict(600.0, b)[0] == 0
+    assert "headroom" in ct.verdict(600.0, b)[1]
+    code, msg = ct.verdict(855.0, b)
+    assert code == 1 and "at risk" in msg
+    code, msg = ct.verdict(900.0, b)
+    assert code == 1 and "EXCEEDED" in msg
+    code, msg = ct.verdict(None, b)
+    assert code == 2 and "SLOW_TESTS" in msg
+
+    # CLI end to end (against a budget COPY — the repo file is the real
+    # record): a passing log exits 0 and records the measurement
+    bfile = tmp_path / "tier1_budget.json"
+    bfile.write_text(json.dumps(budget))
+    good = tmp_path / "t1.log"
+    good.write_text("= 230 passed, 1 failed in 700.00s (0:11:40) =\n")
+    r = subprocess.run(
+        [sys.executable, str(TOOLS / "check_tier1_budget.py"),
+         "--budget", str(bfile), str(good)],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "tier-1 budget ok" in r.stdout
+    assert json.loads(bfile.read_text())["measured_s"] == 700.0
+
+    bad = tmp_path / "t1_over.log"
+    bad.write_text("= 230 passed in 901.00s (0:15:01) =\n")
+    r = subprocess.run(
+        [sys.executable, str(TOOLS / "check_tier1_budget.py"),
+         "--budget", str(bfile), str(bad)],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 1
+    assert "EXCEEDED" in r.stdout

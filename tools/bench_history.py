@@ -127,6 +127,24 @@ def _elastic_row(detail: dict) -> "dict | None":
     return {f"reshape_replay_wall_s@{grid}@{hosts}h": v}
 
 
+def _exchange_row(detail: dict) -> "dict | None":
+    """The dense-vs-segment exchange rows a round published:
+    detail.exchange (the exchange trial, event-exchange v2 round) as
+    {"flush_ms.<mode>@Nh" / "bytes_per_host.<mode>@Nh": value}. Both
+    are wall/wire costs, so exchange_check inverts the comparison
+    direction (lower is better). Keyed by mode AND world size so
+    salvaged partial rounds never compare across shapes. None when the
+    round measured no exchange row."""
+    ex = detail.get("exchange") or {}
+    row = {
+        k: v
+        for k, v in (ex.get("summary") or {}).items()
+        if k.startswith(("flush_ms.", "bytes_per_host."))
+        and v is not None
+    }
+    return row or None
+
+
 def _metric_verdicts(rounds_key: str, keys, history, current,
                      latest_round, lower_is_better: bool = False) -> dict:
     """The shared best-prior/TOLERANCE verdict core behind
@@ -246,6 +264,26 @@ def elastic_check(rounds: "list[dict]",
     return out
 
 
+def exchange_check(rounds: "list[dict]",
+                   current: "dict | None" = None) -> dict:
+    """The detail.exchange trajectory verdicts — flush wall and
+    collective bytes/host per exchange mode, the SAME best-prior/
+    TOLERANCE core as every other detail metric with the direction
+    inverted (wall and wire costs: lower is better). `current` is an
+    in-flight {"flush_ms.<mode>@Nh": ms, ...} from bench.py; None
+    compares the newest recorded round against the rest."""
+    history, current, latest_round = _pop_latest("exchange", rounds, current)
+    keys = sorted(
+        set(current or {}) | {m for r in history for m in r["exchange"]}
+    )
+    out, verdicts = _metric_verdicts(
+        "exchange", keys, history, current, latest_round,
+        lower_is_better=True,
+    )
+    out["rows"] = verdicts
+    return out
+
+
 def service_check(rounds: "list[dict]",
                   current: "dict | None" = None) -> dict:
     """The detail.service trajectory verdicts — jobs_per_hour and
@@ -292,6 +330,7 @@ def load_rounds(root: str = ".") -> "list[dict]":
             "overlay": _overlay_row(detail),
             "mesh": _mesh_row(detail),
             "elastic": _elastic_row(detail),
+            "exchange": _exchange_row(detail),
             "attempts": [
                 _attempt_row(a) for a in detail.get("attempts", [])
             ],
@@ -388,10 +427,12 @@ def main(argv=None) -> int:
     ovl = overlay_check(rounds)
     msh = mesh_check(rounds)
     ela = elastic_check(rounds)
+    exc = exchange_check(rounds)
     if args.json:
         print(json.dumps(
             {"rounds": rounds, "verdict": verdict, "service": svc,
-             "overlay": ovl, "mesh": msh, "elastic": ela}, indent=2
+             "overlay": ovl, "mesh": msh, "elastic": ela,
+             "exchange": exc}, indent=2
         ))
     else:
         print(trajectory_table(rounds))
@@ -408,12 +449,16 @@ def main(argv=None) -> int:
         for row, v in ela["rows"].items():
             if v.get("latest") is not None or v.get("best_prior") is not None:
                 print(f"elastic.{row}: {v['note']}")
+        for row, v in exc["rows"].items():
+            if v.get("latest") is not None or v.get("best_prior") is not None:
+                print(f"exchange.{row}: {v['note']}")
     return 1 if (
         verdict.get("regression")
         or svc.get("regression")
         or ovl.get("regression")
         or msh.get("regression")
         or ela.get("regression")
+        or exc.get("regression")
     ) else 0
 
 

@@ -1,0 +1,113 @@
+"""Tier-1 wall-budget check: fail when the quick tier exceeds its cap.
+
+The quick tier (``pytest -m 'not slow'``) runs under a hard 870-second
+wall (ROADMAP.md "Tier-1 verify"; the driver kills the run past it), so
+every PR that adds quick tests must prove the tier still fits.  The
+conftest SLOW_TESTS rebalance comments record the history of breaches;
+this tool turns the check into a command:
+
+    python tools/check_tier1_budget.py /tmp/_t1.log
+
+It parses the wall-clock seconds from the pytest summary line of a
+COMPLETED quick-tier run log (the ``tee`` target of the verify recipe),
+compares against the cap in tools/tier1_budget.json, and exits non-zero
+with a one-line verdict when the tier is over budget — or within
+``warn_margin_s`` of it, because a tier that "fits" with 3s to spare on
+one box is a breach on a slower day (the PR-15 rebalance found exactly
+that).  On success it rewrites the budget file's ``measured_s`` so the
+repo carries the latest measurement.
+
+No dependencies beyond the standard library: the check must run in the
+barest CI shell, before any environment is built.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+from pathlib import Path
+
+BUDGET_FILE = Path(__file__).with_name("tier1_budget.json")
+
+# the pytest-8 summary line: "== 228 passed, 1 failed, 96 deselected,
+# 3 warnings in 612.34s (0:10:12) ==" (the parenthesized clock only
+# appears past 60s; both forms parse)
+_SUMMARY_RE = re.compile(
+    r"in\s+(?P<secs>\d+(?:\.\d+)?)s(?:\s+\(\d+:\d{2}:\d{2}\))?\s*=*\s*$"
+)
+
+
+def parse_wall_seconds(log_text: str) -> "float | None":
+    """Wall seconds from the LAST pytest summary line in the log, or
+    None when the log holds no completed run (e.g. the driver's timeout
+    killed it — which is itself a budget verdict, handled in main)."""
+    wall = None
+    for line in log_text.splitlines():
+        m = _SUMMARY_RE.search(line)
+        if m and ("passed" in line or "failed" in line or "error" in line):
+            wall = float(m.group("secs"))
+    return wall
+
+
+def load_budget(path: Path = BUDGET_FILE) -> dict:
+    return json.loads(path.read_text())
+
+
+def verdict(wall_s: "float | None", budget: dict) -> "tuple[int, str]":
+    """(exit code, one-line message) for a measured quick-tier wall."""
+    cap = float(budget["wall_cap_s"])
+    margin = float(budget.get("warn_margin_s", 0))
+    if wall_s is None:
+        return 2, (
+            f"tier-1 budget: no completed pytest summary in the log — "
+            f"the run was likely killed at the {cap:.0f}s cap; rebalance "
+            f"tests/conftest.py SLOW_TESTS before shipping"
+        )
+    if wall_s > cap:
+        return 1, (
+            f"tier-1 budget EXCEEDED: quick tier took {wall_s:.1f}s against "
+            f"the {cap:.0f}s cap; move tests into tests/conftest.py "
+            f"SLOW_TESTS (keep a quick pin per plane) and re-measure"
+        )
+    if wall_s > cap - margin:
+        return 1, (
+            f"tier-1 budget at risk: {wall_s:.1f}s is within the "
+            f"{margin:.0f}s safety margin of the {cap:.0f}s cap "
+            f"({cap - wall_s:.1f}s headroom); rebalance now, not after "
+            f"the next breach"
+        )
+    return 0, (
+        f"tier-1 budget ok: {wall_s:.1f}s of the {cap:.0f}s cap "
+        f"({cap - wall_s:.1f}s headroom)"
+    )
+
+
+def main(argv: "list[str]") -> int:
+    budget_file = BUDGET_FILE
+    if len(argv) == 3 and argv[0] == "--budget":
+        budget_file = Path(argv[1])
+        argv = argv[2:]
+    if len(argv) != 1 or argv[0] in ("-h", "--help"):
+        print(__doc__.strip().splitlines()[0])
+        print(
+            "usage: python tools/check_tier1_budget.py "
+            "[--budget tier1_budget.json] <quick-tier pytest log>"
+        )
+        return 2
+    log_path = Path(argv[0])
+    if not log_path.exists():
+        print(f"tier-1 budget: log file {log_path} not found")
+        return 2
+    budget = load_budget(budget_file)
+    wall = parse_wall_seconds(log_path.read_text(errors="replace"))
+    code, msg = verdict(wall, budget)
+    print(msg)
+    if code == 0:
+        budget["measured_s"] = wall
+        budget_file.write_text(json.dumps(budget, indent=2) + "\n")
+    return code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -49,6 +49,13 @@ live-host compaction exploits), and the same run again under
 active-lane compaction. The iteration-reduction factor printed here is
 the published acceptance number for the adaptive-window round.
 
+Part 9 (event-exchange v2 round, docs/parallelism.md "Segment
+exchange"): per-phase cost of the round-boundary exchange — pool sort /
+collective exchange / queue landing / capacity check — dense lane grid
+vs sort-based segment exchange on the same busy staged outbox, plus
+sharded per-round collective deltas and analytic bytes/host (dense
+heuristic buckets vs the segment ring at measured exch_hwm capacity).
+
   python tools/profile_kernels.py [reps] [engine_hosts]
 
 Env knobs: SHADOW_TPU_PROFILE_WIDTHS (comma list, part 1),
@@ -790,6 +797,259 @@ def profile_mesh_collectives(hosts: int = 0, sim_s: float = 0.1):
     return out
 
 
+def profile_exchange(hosts: int = 0, reps: int = 10):
+    """Part 9 (event-exchange v2 round, docs/parallelism.md "Segment
+    exchange"): per-phase cost of the round-boundary exchange — pool
+    sort / collective exchange / queue landing / capacity check — for
+    the dense lane grid vs the sort-based segment exchange.
+
+    Single-device, the phases are timed as separately-jitted stages on
+    the SAME busy staged outbox (a few handler iterations with the
+    flush withheld):
+
+      * sort — the segment pool compaction (one stable (dst, time, tie)
+        multi-operand sort over the flattened outbox). The dense path
+        has no standalone pre-sort: its three [H, lanes]-grid sorts live
+        inside the landing, which is exactly the cost the segment
+        layout removes.
+      * landing — equeue.push_many_sorted (dense grid) vs
+        equeue.push_many_segment (ragged segments) on the staged pool.
+      * capacity-check — the driver's per-chunk _peek_capacity fetch
+        ([5] scalars; mode-independent — segment just feeds the
+        exchange-hwm lane the pool occupancy the per-round check uses).
+      * full — the whole _flush_outbox_traffic per mode, the number the
+        bench exchange trial publishes.
+
+    Sharded (every visible device), the collective phase is isolated
+    mesh-collectives-style: the per-live-round wall of the sharded run
+    minus the single-device run of the same mode ≈ collective +
+    shard_map overhead per round (trajectories are leaf-identical, so
+    rounds_live is a shared denominator). Bytes/host per round are
+    analytic from the static bucket shapes (dense heuristic buckets vs
+    the segment ring at the measured exch_hwm capacity)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench import _event_slot_bytes
+    from shadow_tpu import equeue
+    from shadow_tpu.engine import EngineConfig, ShardedRunner, init_state
+    from shadow_tpu.engine.round import (
+        _flush_outbox_traffic,
+        _peek_capacity,
+        bootstrap,
+        handle_one_iteration,
+    )
+    from shadow_tpu.engine.sharded import AXIS, auto_a2a_capacity
+    from shadow_tpu.events import KIND_PACKET
+    from shadow_tpu.graph import NetworkGraph, compute_routing
+    from shadow_tpu.models import PholdModel
+    from shadow_tpu.simtime import NS_PER_MS, NS_PER_SEC
+
+    ndev = jax.device_count()
+    h = hosts or (10240 if jax.default_backend() == "tpu" else 512)
+    h -= h % max(ndev, 1)
+    graph = NetworkGraph.from_gml(
+        "\n".join(
+            [
+                "graph [",
+                "  directed 0",
+                *[f"  node [ id {i} ]" for i in range(4)],
+                *[
+                    f'  edge [ source {i} target {i} latency "1 ms" ]'
+                    for i in range(4)
+                ],
+                *[
+                    f'  edge [ source {i} target {j} latency "3 ms" ]'
+                    for i in range(4)
+                    for j in range(i + 1, 4)
+                ],
+                "]",
+            ]
+        )
+    )
+    tables = compute_routing(graph).with_hosts([i % 4 for i in range(h)])
+    cfg = EngineConfig(
+        num_hosts=h, runahead_ns=graph.min_latency_ns(), seed=13, tracker=True
+    )
+    model = PholdModel(
+        num_hosts=h, min_delay_ns=1 * NS_PER_MS, max_delay_ns=8 * NS_PER_MS
+    )
+    st0 = bootstrap(init_state(cfg, model.init()), model, cfg)
+    we = jnp.asarray(10**15, jnp.int64)
+
+    @jax.jit
+    def _stage(st):
+        def body(s, _):
+            return handle_one_iteration(s, we, model, tables, cfg), None
+
+        return jax.lax.scan(body, st, None, length=4)[0]
+
+    busy = _stage(st0)
+    jax.block_until_ready(busy.events_handled)
+    staged = int(np.asarray(busy.outbox.fill).sum())
+
+    def _timed(f, *args):
+        jax.block_until_ready(jax.tree.leaves(f(*args))[0])  # compile
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            o = f(*args)
+            jax.block_until_ready(jax.tree.leaves(o)[0])
+            ts.append(time.perf_counter() - t0)
+        return round(min(ts) * 1e3, 3)
+
+    # --- single-device phase stages -----------------------------------
+    ob = busy.outbox
+    h_local, o_cap = ob.valid.shape
+    m = h_local * o_cap
+
+    @jax.jit
+    def _pool_sort(ob):
+        def flat(x):
+            return x.reshape((m,) + x.shape[2:])
+
+        valid, dst = flat(ob.valid), flat(ob.dst)
+        t, tie, aux, data = flat(ob.time), flat(ob.tie), flat(ob.aux), flat(ob.data)
+        key = jnp.where(valid, dst, jnp.int32(1 << 30))
+        return jax.lax.sort(
+            (key, t, tie, aux, valid, dst)
+            + tuple(data[:, i] for i in range(data.shape[1])),
+            num_keys=3,
+            is_stable=True,
+        )
+
+    pooled = _pool_sort(ob)
+    _, time_p, tie_p, aux_p, valid_p, dst_p, *data_cols = pooled
+    data_p = jnp.stack(data_cols, axis=-1)
+
+    @jax.jit
+    def _land_segment(q, dst, valid, t, tie, data, aux):
+        return equeue.push_many_segment(
+            q=q, dst=dst, valid=valid, time=t, tie=tie,
+            kind=jnp.full(valid.shape, KIND_PACKET, jnp.int32),
+            data=data, aux=aux,
+        )
+
+    @jax.jit
+    def _land_dense(q, ob):
+        def flat(x):
+            return x.reshape((m,) + x.shape[2:])
+
+        lanes = cfg.deliver_lanes if cfg.deliver_lanes > 0 else q.capacity
+        return equeue.push_many_sorted(
+            deliver_lanes=lanes, q=q, dst=flat(ob.dst), valid=flat(ob.valid),
+            time=flat(ob.time), tie=flat(ob.tie),
+            kind=jnp.full((m,), KIND_PACKET, jnp.int32),
+            data=flat(ob.data), aux=flat(ob.aux),
+        )
+
+    peek = jax.jit(_peek_capacity)
+
+    def _check(st):
+        return np.asarray(peek(st))
+
+    phases = {
+        "capacity_check_ms": _timed(_check, busy),
+        "segment": {
+            "sort_ms": _timed(_pool_sort, ob),
+            "landing_ms": _timed(
+                _land_segment, busy.queue, dst_p, valid_p, time_p, tie_p,
+                data_p, aux_p,
+            ),
+            "full_flush_ms": _timed(
+                jax.jit(
+                    lambda s: _flush_outbox_traffic(
+                        s, None, dataclasses.replace(cfg, exchange="segment")
+                    )
+                ),
+                busy,
+            ),
+        },
+        "dense": {
+            # the dense grid's three sorts are inside the landing — the
+            # per-phase split the segment layout makes possible is the
+            # point of the comparison
+            "sort_ms": None,
+            "landing_ms": _timed(_land_dense, busy.queue, ob),
+            "full_flush_ms": _timed(
+                jax.jit(
+                    lambda s: _flush_outbox_traffic(
+                        s, None, dataclasses.replace(cfg, exchange="dense")
+                    )
+                ),
+                busy,
+            ),
+        },
+    }
+    out = {
+        "hosts": h,
+        "staged_events": staged,
+        "slot_bytes": _event_slot_bytes(ob),
+        "phases": phases,
+    }
+    print(json.dumps({"exchange_phases": phases}), flush=True)
+
+    # --- sharded: collective phase by per-round delta vs single -------
+    if ndev > 1 and h % ndev == 0:
+        from jax.sharding import Mesh
+
+        from shadow_tpu.engine.round import run_until
+
+        end = int(0.05 * NS_PER_SEC)
+        slot_bytes = out["slot_bytes"]
+        rows = []
+        measured_hwm = None
+        for mode in ("dense", "segment"):
+            row = {"mode": mode, "devices": ndev}
+            try:
+                mcfg = dataclasses.replace(cfg, exchange=mode)
+                single = run_until(
+                    st0, end, model, tables, mcfg, rounds_per_chunk=16
+                )
+                t0 = time.perf_counter()
+                single = run_until(
+                    st0, end, model, tables, mcfg, rounds_per_chunk=16
+                )
+                jax.block_until_ready(single.events_handled)
+                single_wall = time.perf_counter() - t0
+                runner = ShardedRunner(
+                    Mesh(np.array(jax.devices()), (AXIS,)), model, tables,
+                    mcfg, rounds_per_chunk=16,
+                    measured_exchange_hwm=measured_hwm,
+                )
+                s = runner.run_until(st0, end)
+                jax.block_until_ready(s.events_handled)
+                t0 = time.perf_counter()
+                s = runner.run_until(st0, end)
+                jax.block_until_ready(s.events_handled)
+                wall = time.perf_counter() - t0
+                rl = int(np.asarray(s.tracker.rounds_live).max())
+                hwm = int(np.asarray(s.tracker.exch_hwm).max())
+                cap = auto_a2a_capacity(mcfg, ndev, measured_hwm=measured_hwm)
+                row.update(
+                    per_round_ms=round(wall / max(rl, 1) * 1e3, 3),
+                    exchange_ms_per_round=round(
+                        (wall - single_wall) / max(rl, 1) * 1e3, 3
+                    ),
+                    exch_hwm=hwm,
+                    bucket_capacity=cap,
+                    bytes_per_host_per_round=round(
+                        (ndev - 1) * cap * slot_bytes / (h // ndev), 1
+                    ),
+                )
+                if mode == "dense":
+                    measured_hwm = hwm
+            except Exception as e:  # noqa: BLE001 — publish the rows that ran
+                row["error"] = str(e)[:300]
+            rows.append(row)
+            print(json.dumps({"exchange_sharded_row": row}), flush=True)
+        out["sharded"] = {"devices": ndev, "rows": rows}
+    return out
+
+
 def main():
     import jax
 
@@ -808,6 +1068,7 @@ def main():
     out["sweep"] = profile_sweep()
     out["adaptivity"] = profile_adaptivity()
     out["mesh_collectives"] = profile_mesh_collectives()
+    out["exchange"] = profile_exchange()
     print(json.dumps(out), flush=True)
 
 
