@@ -387,6 +387,31 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
     jax.block_until_ready(st.events_handled)
     wall = time.perf_counter() - t0
     probe = last_probe[0]
+
+    # memory observatory: price the measured state (post any regrow) so
+    # BENCH_r* trials carry bytes/host next to rate — a perf win that
+    # doubled the footprint is visible in the same record. Best-effort.
+    memory: dict = {}
+    try:
+        from shadow_tpu.runtime import memtrack
+
+        rep = memtrack.price_state(st, cfg)
+        memory = {
+            "total_bytes": rep["total_bytes"],
+            "bytes_per_host": rep["bytes_per_host"],
+            "dominant": rep["dominant"]["name"],
+        }
+        if autotune_plan is not None and autotune_plan.peak_hbm_bytes:
+            memory["peak_hbm_bytes"] = autotune_plan.peak_hbm_bytes
+        peaks = [
+            s["device_peak_bytes"]
+            for s in recorder.samples
+            if "device_peak_bytes" in s
+        ]
+        if peaks:
+            memory["device_peak_bytes"] = max(peaks)
+    except Exception:  # noqa: BLE001 — pricing must never fail a trial
+        memory = {}
     return {
         "backend": jax.default_backend(),
         "rate": sim_sec / wall,
@@ -440,6 +465,7 @@ def _measure(num_hosts: int, sim_sec: float, rounds_per_chunk: int = 256):
             if autotune_plan is not None
             else {}
         ),
+        **({"memory": memory} if memory else {}),
         **({"engine": engine_choice} if engine_choice is not None else {}),
     }
 
@@ -2207,6 +2233,15 @@ def main():
             }
             if cur:
                 history["exchange"] = bh.exchange_check(rounds, current=cur)
+        mem = main_res.get("memory") or {}
+        if mem.get("bytes_per_host") is not None:
+            # priced bytes/host (and compiled peak) per world size: a
+            # memory cost, so memory_check inverts the direction — a
+            # perf round that doubles the footprint must announce itself
+            cur = {f"bytes_per_host@{used[0]}h": mem["bytes_per_host"]}
+            if mem.get("peak_hbm_bytes") is not None:
+                cur[f"peak_hbm_bytes@{used[0]}h"] = mem["peak_hbm_bytes"]
+            history["memory"] = bh.memory_check(rounds, current=cur)
         if elastic and elastic.get("reshape_replay_wall_s") is not None:
             # the reshape-replay wall row, keyed by grid AND world size
             # (lower is better — elastic_check inverts the direction)

@@ -330,6 +330,32 @@ def main(argv: "list[str] | None" = None) -> int:
         "--tenant", metavar="NAME",
         help="set/override job.tenant in the submitted spec",
     )
+    mem_p = sub.add_parser(
+        "mem",
+        help="price a config's device memory WITHOUT compiling or "
+        "allocating it: a bytes/host table grouped by subsystem, the "
+        "dominant grid, and a max-hosts projection for an HBM budget "
+        "(docs/observability.md 'Memory observatory')",
+    )
+    mem_p.add_argument("config", help="path to the config YAML")
+    mem_p.add_argument(
+        "--hbm-gb", type=float, default=None, metavar="GB",
+        help="project how many hosts of this world fit a per-device "
+        "HBM budget of GB gibibytes",
+    )
+    mem_p.add_argument(
+        "--replicas", type=int, default=None, metavar="R",
+        help="price the [R]-batched ensemble state instead of the "
+        "single-world state",
+    )
+    mem_p.add_argument(
+        "--mesh", metavar="SPEC",
+        help="price the RxS mesh-sharded state (e.g. '2x4')",
+    )
+    mem_p.add_argument(
+        "--json", action="store_true",
+        help="emit the raw pricing report as JSON instead of the table",
+    )
     metrics_p = sub.add_parser(
         "metrics",
         help="summarize a recorded metrics series: a --metrics-file "
@@ -439,6 +465,20 @@ def main(argv: "list[str] | None" = None) -> int:
 
         try:
             return run_submit(args.spool, args.spec, tenant=args.tenant)
+        except CliUserError as e:
+            print(f"shadow-tpu: error: {e}", file=sys.stderr)
+            return 1
+    if args.command == "mem":
+        from shadow_tpu.runtime.cli_run import CliUserError, run_mem
+
+        try:
+            return run_mem(
+                args.config,
+                hbm_gb=args.hbm_gb,
+                replicas=args.replicas,
+                mesh=args.mesh,
+                json_out=args.json,
+            )
         except CliUserError as e:
             print(f"shadow-tpu: error: {e}", file=sys.stderr)
             return 1

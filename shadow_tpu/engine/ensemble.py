@@ -416,11 +416,15 @@ def _drive_ensemble(
         if injected is not None:
             raise chaos.injected_capacity_error(fetched - 1, injected)
         if int(rows[:, PROBE_OVERFLOW].sum()):
+            from shadow_tpu.engine.round import attach_capacity_bytes
+
+            live = nxt[0] if nxt is not None else pend_st
             if capacity_error is not None:
-                raise capacity_error(
-                    rows, nxt[0] if nxt is not None else pend_st
-                )
-            raise _replica_capacity_error(rows)
+                err = capacity_error(rows, live)
+            else:
+                err = _replica_capacity_error(rows)
+            attach_capacity_bytes(err, live)
+            raise err
         if on_rows is not None:
             on_rows(rows)
         if on_chunk is not None:

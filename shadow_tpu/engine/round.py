@@ -1249,6 +1249,11 @@ class CapacityError(RuntimeError):
     outbox_overflow: int = 0
     queue_hwm: int = 0
     outbox_hwm: int = 0
+    # memory observatory: priced bytes of the saturated buffer(s) now and
+    # after the x2 regrow rollback-and-regrow would apply (0 when no live
+    # state was available to price at raise time)
+    bytes_current: int = 0
+    bytes_regrown: int = 0
     # exchange-pool occupancy high-water (most events flushed in one
     # round, PROBE_EXCH_HWM; 0 without cfg.tracker) — the figure that
     # says whether a segment pool / a2a bucket was sized too small
@@ -1412,10 +1417,12 @@ def check_capacity(st: SimState) -> None:
     unbounded queues never dropping)."""
     qov, oov, qh, oh, xh = (int(x) for x in _peek_capacity(st))
     if qov or oov:
-        raise _capacity_error(
+        err = _capacity_error(
             qov + oov, queue_ov=qov, outbox_ov=oov, queue_hwm=qh,
             outbox_hwm=oh, exch_hwm=xh,
         )
+        attach_capacity_bytes(err, st)
+        raise err
 
 
 def host_stats(st: SimState) -> dict:
@@ -1510,6 +1517,38 @@ def _capacity_error(
     err.outbox_hwm = int(outbox_hwm or 0)
     err.exchange_hwm = int(exch_hwm or 0)
     return err
+
+
+def attach_capacity_bytes(err: CapacityError, st) -> None:
+    """Memory observatory satellite: price the saturated buffer(s) now
+    and after the x2 regrow recovery would apply, from the live state's
+    shapes (metadata only — no device sync), and render the figures next
+    to the high-water marks. Best-effort: diagnostics never mask the
+    error. Works on single, ensemble [R, ...] and mesh states alike —
+    buffer_nbytes keys the capacity axis off the per-host counter rank."""
+    from shadow_tpu.engine.state import buffer_nbytes, fmt_bytes
+
+    try:
+        cur = grown = 0
+        for sub, counts, saturated in (
+            (st.queue, st.queue.count, err.queue_overflow),
+            (st.outbox, st.outbox.fill, err.outbox_overflow),
+        ):
+            if not saturated:
+                continue
+            base = len(counts.shape)
+            cur += buffer_nbytes(sub, base)
+            grown += buffer_nbytes(sub, base, scale=2.0)
+        if not cur:
+            return
+        err.bytes_current = int(cur)
+        err.bytes_regrown = int(grown)
+        err.args = (
+            f"{err.args[0]}\n  saturated buffer bytes: {fmt_bytes(cur)} now, "
+            f"{fmt_bytes(grown)} after the x2 regrow",
+        ) + err.args[1:]
+    except Exception:  # noqa: BLE001 — diagnostics must not mask the error
+        pass
 
 
 def capacity_topk(st: SimState, k: int = 5) -> str:
@@ -1691,6 +1730,12 @@ def _drive(launch, st, end_time, max_chunks, on_chunk, pipeline, desc,
                 queue_hwm=probe.queue_hwm,
                 outbox_hwm=probe.outbox_hwm,
                 exch_hwm=probe.exch_hwm,
+            )
+            # price the saturated buffers from the live state (the
+            # pipelined in-flight chunk's output when pend_st was
+            # donated into it) — shape metadata only, no device sync
+            attach_capacity_bytes(
+                err, nxt[0] if nxt is not None else pend_st
             )
             if capacity_detail is not None:
                 try:

@@ -470,6 +470,53 @@ def state_from_host(host_st: SimState, like: SimState) -> SimState:
     return jax.tree.map(rewrap, host_st, like)
 
 
+def leaf_nbytes(leaf) -> int:
+    """Device bytes of one pytree leaf: concrete arrays, numpy host
+    snapshots, and jax.eval_shape ShapeDtypeStructs all price identically
+    (the memory observatory's `shadow-tpu mem` prices abstract shapes so
+    it never has to allocate). Typed PRNG key leaves are priced as their
+    raw key words — the buffer that actually sits in HBM."""
+    if _is_key_leaf(leaf):
+        kd = jax.eval_shape(jax.random.key_data, leaf)
+        return int(np.prod(kd.shape, dtype=np.int64)) * kd.dtype.itemsize
+    nb = getattr(leaf, "nbytes", None)
+    if nb is not None:
+        return int(nb)
+    return int(np.prod(leaf.shape, dtype=np.int64)) * np.dtype(leaf.dtype).itemsize
+
+
+def tree_nbytes(tree) -> int:
+    """Sum of leaf_nbytes over a pytree — the exact device footprint of a
+    SimState (or any sub-tree of one)."""
+    return sum(leaf_nbytes(leaf) for leaf in jax.tree.leaves(tree))
+
+
+def buffer_nbytes(sub, base_ndim: int, scale: float = 1.0) -> int:
+    """Priced bytes of a capacity-indexed buffer sub-tree (queue/outbox).
+    Leaves with more axes than `base_ndim` (the rank of the per-host
+    counters, e.g. queue.count) carry the capacity axis and scale
+    linearly with it, so scale=new/old projects a regrow WITHOUT
+    allocating — the headroom check rollback-and-regrow runs before
+    doubling a saturated buffer."""
+    total = 0
+    for leaf in jax.tree.leaves(sub):
+        b = leaf_nbytes(leaf)
+        if scale != 1.0 and len(leaf.shape) > base_ndim:
+            b = int(b * scale)
+        total += b
+    return int(total)
+
+
+def fmt_bytes(n: "int | float") -> str:
+    """Human-readable bytes for error messages and the mem table."""
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.0f} {unit}" if unit == "B" else f"{n:.2f} {unit}"
+        n /= 1024
+    return f"{n:.2f} GiB"
+
+
 def grow_state(
     st: SimState,
     queue_capacity: "int | None" = None,

@@ -61,6 +61,10 @@ class AutotunePlan:
     pump_k: "int | None"  # None = keep the caller's value
     source: str
     backend: str = ""
+    # XLA-reported peak HBM of the probe chunk's executable (memory
+    # observatory): best-effort — None where the backend doesn't report
+    # a memory analysis or the probe was skipped
+    peak_hbm_bytes: "int | None" = None
 
     def as_dict(self) -> dict:
         return {k: v for k, v in dataclasses.asdict(self).items() if v is not None}
@@ -170,6 +174,7 @@ def plan_rounds_per_chunk(
     key = _cache_key(cfg, probe_rpc, backend, shape_key)
     cache = _load_cache(cache_path)
     probe_wall = cache.get(key, {}).get("probe_wall_s")
+    peak_hbm = cache.get(key, {}).get("peak_hbm_bytes")
     source = "cache" if probe_wall is not None else "probe"
     if probe_wall is None:
         import contextlib
@@ -203,9 +208,36 @@ def plan_rounds_per_chunk(
                     rounds_per_chunk=probe_rpc, tracker=tracker,
                 )
         probe_wall = time.perf_counter() - t0
+        if probe_runner is None:
+            # memory observatory: the plain probe chunk is already
+            # compiled in the process jit cache, so AOT-lowering it again
+            # is a cheap second tiny compile that gives us the one thing
+            # run_until can't: the executable handle whose
+            # memory_analysis() projects peak HBM. Best-effort — the
+            # autotuner's budget walk never depends on it.
+            try:
+                import jax.numpy as jnp
+
+                from shadow_tpu.engine.round import _run_chunk
+                from shadow_tpu.runtime import memtrack
+
+                exe = (
+                    jax.jit(_run_chunk, static_argnums=(2, 3, 5))
+                    .lower(
+                        probe_st, jnp.asarray(probe_end_ns, jnp.int64),
+                        probe_rpc, model, tables, probe_cfg,
+                    )
+                    .compile()
+                )
+                mem = memtrack.compiled_memory(exe)
+                if mem and mem.get("peak_bytes"):
+                    peak_hbm = int(mem["peak_bytes"])
+            except Exception:  # noqa: BLE001 — telemetry, never a failure
+                peak_hbm = None
         flightrec.record_event(
             "autotune_probe", wall_s=round(probe_wall, 4), rpc=probe_rpc,
             backend=backend, **({"shape": shape_key} if shape_key else {}),
+            **({"peak_hbm_bytes": peak_hbm} if peak_hbm else {}),
         )
         cache[key] = {
             "probe_wall_s": round(probe_wall, 4),
@@ -213,6 +245,8 @@ def plan_rounds_per_chunk(
             "backend": backend,
             "saved_at": int(time.time()),
         }
+        if peak_hbm:
+            cache[key]["peak_hbm_bytes"] = peak_hbm
         _save_cache(cache_path, cache)
 
     chosen, projected = requested, None
@@ -227,6 +261,7 @@ def plan_rounds_per_chunk(
         probe_wall_s=round(probe_wall, 4),
         projected_compile_s=round(projected, 4) if projected is not None else None,
         pump_k=None, source=source, backend=backend,
+        peak_hbm_bytes=int(peak_hbm) if peak_hbm else None,
     )
 
 

@@ -159,6 +159,82 @@ def run_from_config(
     return 0 if results.packets_unroutable == 0 else 1
 
 
+def run_mem(
+    path: str,
+    hbm_gb: "float | None" = None,
+    replicas: "int | None" = None,
+    mesh: "str | None" = None,
+    json_out: bool = False,
+) -> int:
+    """`shadow-tpu mem` implementation (memory observatory, static
+    layer): price the config's device state WITHOUT compiling or
+    allocating it. The state is built under jax.eval_shape, so a
+    10M-host world prices in milliseconds on a laptop — the table is
+    exact for the grids the run would allocate (runtime/memtrack.py)."""
+    try:
+        config = load_config_file(path)
+    except (ValueError, OSError, yaml.YAMLError) as e:
+        raise CliUserError(f"invalid config: {e}") from e
+    if replicas is not None:
+        if replicas < 1:
+            raise CliUserError("--replicas must be >= 1")
+        config.general.replicas = replicas
+    if mesh is not None:
+        from shadow_tpu.config.options import canonical_mesh
+
+        try:
+            config.general.mesh = canonical_mesh(mesh)
+        except ValueError as e:
+            raise CliUserError(f"invalid --mesh: {e}") from e
+    set_level(config.general.log_level)
+    try:
+        manager = Manager(config)
+        world = manager.build_world()
+    except (ValueError, OSError) as e:
+        raise CliUserError(str(e)) from e
+    import jax
+
+    from shadow_tpu.runtime import memtrack
+
+    ecfg = world.ecfg
+    model, tx, rx = world.model, world.tx_refill, world.rx_refill
+    if getattr(manager, "mesh_plan", None) is not None:
+        from shadow_tpu.engine.mesh import init_mesh_state
+
+        plan = manager.mesh_plan
+        st = jax.eval_shape(
+            lambda: init_mesh_state(
+                ecfg, model, plan, config.general.replica_seed_stride,
+                tx_bytes_per_interval=tx, rx_bytes_per_interval=rx,
+            )
+        )
+    elif config.general.replicas > 1:
+        from shadow_tpu.engine.ensemble import init_ensemble_state
+
+        st = jax.eval_shape(
+            lambda: init_ensemble_state(
+                ecfg, model, config.general.replicas,
+                config.general.replica_seed_stride,
+                tx_bytes_per_interval=tx, rx_bytes_per_interval=rx,
+            )
+        )
+    else:
+        from shadow_tpu.engine.state import init_state
+
+        st = jax.eval_shape(
+            lambda: init_state(
+                ecfg, model.init(),
+                tx_bytes_per_interval=tx, rx_bytes_per_interval=rx,
+            )
+        )
+    report = memtrack.price_state(st, ecfg)
+    if json_out:
+        print(json.dumps(report, indent=2))
+    else:
+        print(memtrack.render_report(report, hbm_gb=hbm_gb))
+    return 0
+
+
 def run_sweep(
     spec_path: str,
     output_dir: "str | None" = None,

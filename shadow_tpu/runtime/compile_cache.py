@@ -79,6 +79,10 @@ class CompileCache:
         self.misses = 0
         self.compile_seconds = 0.0
         self.compile_walls: "list[float]" = []
+        # memory observatory: XLA-reported peak HBM per compiled entry
+        # (memory_analysis is best-effort — backends that don't report it
+        # simply leave this list shorter than compile_walls)
+        self.compile_peaks: "list[int]" = []
 
     def _full_key(self, key, st, static_cfg) -> tuple:
         return (key, static_cfg, state_signature(st))
@@ -111,9 +115,17 @@ class CompileCache:
         self.compile_seconds += wall
         self.compile_walls.append(round(wall, 4))
         self._entries[fk] = exe
-        # compile telemetry: a miss's XLA wall is a first-class event in
-        # the metrics stream (runtime/flightrec.py)
-        flightrec.record_event("compile_cache", hit=False, wall_s=round(wall, 4))
+        # compile telemetry: a miss's XLA wall — and, where the backend
+        # reports it, the executable's peak HBM (runtime/memtrack.py) —
+        # is a first-class event in the metrics stream
+        ev = {"hit": False, "wall_s": round(wall, 4)}
+        from shadow_tpu.runtime import memtrack
+
+        mem = memtrack.compiled_memory(exe)
+        if mem and mem.get("peak_bytes"):
+            self.compile_peaks.append(int(mem["peak_bytes"]))
+            ev["peak_hbm_bytes"] = int(mem["peak_bytes"])
+        flightrec.record_event("compile_cache", **ev)
         self._persist(fk, exe)
         return exe
 
@@ -133,13 +145,17 @@ class CompileCache:
         return self.hits / total if total else 0.0
 
     def stats(self) -> dict:
-        return {
+        out = {
             "compiles": self.misses,
             "hits": self.hits,
             "hit_rate": round(self.hit_rate(), 4),
             "compile_seconds": round(self.compile_seconds, 4),
             "compile_walls": self.compile_walls,
         }
+        if self.compile_peaks:
+            out["peak_hbm_bytes"] = max(self.compile_peaks)
+            out["compile_peaks"] = self.compile_peaks
+        return out
 
 
 class PersistentCompileCache(CompileCache):

@@ -70,6 +70,8 @@ SUMMARY_FIELDS = (
     "drops",
     "queue_hwm",
     "outbox_hwm",
+    "device_bytes_in_use",
+    "device_peak_bytes",
 )
 
 
@@ -99,6 +101,8 @@ def failure_record(err: BaseException, **extra) -> dict:
         "deadline_s",
         "engine",
         "device_id",
+        "bytes_current",
+        "bytes_regrown",
     ):
         # present-but-zero is information (chunk 0, replica 0, a zero
         # half of the overflow split); only an absent attribute is
@@ -175,12 +179,46 @@ class FlightRecorder:
         self._stream = None
         self._next_flush_ns = 0
         self._next_prom_ns = 0
+        # memory observatory: lazily resolved device list for
+        # device.memory_stats() sampling. None = not yet probed; [] =
+        # backend reports nothing (CPU), sampling disabled after one try.
+        self._mem_devices: "list | None" = None
         if metrics_path:
             d = os.path.dirname(os.path.abspath(metrics_path))
             os.makedirs(d, exist_ok=True)
             self._stream = open(metrics_path, "w")
 
     # --- the per-chunk sample ------------------------------------------
+
+    def _device_memory_sample(self) -> "dict | None":
+        """Fold device.memory_stats() into the chunk sample: bytes in use
+        summed across local devices, peak maxed per device. A pure host
+        call — no device sync rides on it, so the zero-added-fetches pin
+        the metrics stream guarantees holds by construction. Backends
+        that report nothing (CPU returns None) disable sampling after the
+        first probe so steady-state chunks pay nothing."""
+        if self._mem_devices is None:
+            try:
+                import jax
+
+                devs = list(jax.local_devices())
+                first = devs[0].memory_stats() if devs else None
+                self._mem_devices = devs if first else []
+            except Exception:  # noqa: BLE001 — telemetry is optional
+                self._mem_devices = []
+        if not self._mem_devices:
+            return None
+        try:
+            in_use = peak = 0
+            for dev in self._mem_devices:
+                stats = dev.memory_stats() or {}
+                in_use += int(stats.get("bytes_in_use", 0))
+                peak = max(peak, int(stats.get("peak_bytes_in_use", 0)))
+            return {"device_bytes_in_use": in_use,
+                    "device_peak_bytes": peak}
+        except Exception:  # noqa: BLE001
+            self._mem_devices = []
+            return None
 
     def observe(self, probe, chunk: "int | None" = None) -> dict:
         """Fold one fetched ChunkProbe into the ring: per-chunk deltas of
@@ -223,6 +261,9 @@ class FlightRecorder:
             sample["occupancy"] = (
                 round(dl / (di * lanes), 4) if di and lanes else 0.0
             )
+        mem = self._device_memory_sample()
+        if mem:
+            sample.update(mem)
         self._prev = p
         self.chunks += 1
         self.samples.append(sample)
@@ -396,6 +437,13 @@ class FlightRecorder:
             gauges["shadow_tpu_occupancy"] = round(
                 p.occupancy(self.num_hosts, self.num_shards), 4
             )
+        # device memory telemetry (absent on backends without
+        # memory_stats — CPU — so the gauge family only appears where it
+        # means something)
+        last = self.samples[-1] if self.samples else None
+        if last and "device_bytes_in_use" in last:
+            gauges["shadow_tpu_device_bytes_in_use"] = last["device_bytes_in_use"]
+            gauges["shadow_tpu_device_peak_bytes"] = last["device_peak_bytes"]
         if extra_gauges:
             gauges.update(extra_gauges)
         # a gauge key may carry prometheus labels (e.g.

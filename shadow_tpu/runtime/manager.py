@@ -815,6 +815,19 @@ class Manager:
                 seed_stride=cfgo.general.replica_seed_stride,
                 host_tensors=host_tensors,
             )
+        if not isinstance(sched, CpuRefScheduler):
+            # memory observatory: the final state prices the run's device
+            # footprint (post any rollback-and-regrow doubles), plus live
+            # device stats where the backend reports them. Best-effort —
+            # sim-stats must never fail over telemetry.
+            try:
+                from shadow_tpu.runtime import memtrack
+
+                results.extra_stats["memory"] = memtrack.memory_section(
+                    final, ecfg
+                )
+            except Exception:  # noqa: BLE001
+                pass
         if recorder.metrics_path or recorder.prom_path:
             # a metrics-streamed run names its outputs in sim-stats so
             # the artifacts are discoverable from the run record

@@ -315,6 +315,38 @@ def run_until_recovering(
                 recoveries.append(record)
             else:
                 new_cfg = grown_cfg(cur_cfg, err, policy.growth)
+                # memory observatory: price the regrown state BEFORE
+                # allocating it — the one moment rollback-and-regrow can
+                # still warn that the double it is about to apply will
+                # not fit the device. Best-effort: pricing works on host
+                # snapshots and device states alike, and never blocks
+                # the recovery itself.
+                headroom: dict = {}
+                mem_note = ""
+                try:
+                    from shadow_tpu.engine.state import fmt_bytes, tree_nbytes
+                    from shadow_tpu.runtime import memtrack
+
+                    headroom["bytes_current"] = tree_nbytes(base)
+                    headroom["bytes_regrown"] = memtrack.price_regrow(
+                        base,
+                        queue_capacity=new_cfg.queue_capacity,
+                        outbox_capacity=new_cfg.outbox_capacity,
+                    )
+                    mem_note = (
+                        f"; state {fmt_bytes(headroom['bytes_current'])}"
+                        f" -> {fmt_bytes(headroom['bytes_regrown'])}"
+                    )
+                    dm = memtrack.device_memory()
+                    limit = (dm or {}).get("bytes_limit")
+                    if limit and headroom["bytes_regrown"] > limit:
+                        headroom["would_exceed_hbm"] = True
+                        mem_note += (
+                            f" WOULD EXCEED the {fmt_bytes(limit)} "
+                            "device limit"
+                        )
+                except Exception:  # noqa: BLE001 — pricing is telemetry
+                    headroom, mem_note = {}, ""
                 grown = grow(
                     base,
                     queue_capacity=new_cfg.queue_capacity,
@@ -327,6 +359,7 @@ def run_until_recovering(
                     "queue_capacity": new_cfg.queue_capacity,
                     "outbox_capacity": new_cfg.outbox_capacity,
                     "replay_from_ns": from_ns,
+                    **headroom,
                 }
                 if getattr(err, "injected", False):
                     record["injected"] = True  # chaos plane, not real load
@@ -343,7 +376,7 @@ def run_until_recovering(
                     f"outbox_ov={record['outbox_overflow']}); rolling back to "
                     f"sim time {from_ns} ns and regrowing to "
                     f"queue_capacity={new_cfg.queue_capacity}, "
-                    f"outbox_capacity={new_cfg.outbox_capacity} "
+                    f"outbox_capacity={new_cfg.outbox_capacity}{mem_note} "
                     f"(recovery {len(recoveries)}/{policy.max_recoveries})",
                 )
             if tracker is not None and hasattr(tracker, "record_recovery"):

@@ -95,6 +95,9 @@ def _stats(path) -> dict:
     occupancy legitimately differ; every trajectory fact must not)."""
     s = json.loads(pathlib.Path(path).read_text())
     s.pop("wall_seconds")
+    # the memory section prices the run's OWN device footprint (sharded
+    # single state vs ensemble batch row): execution shape, not trajectory
+    s.pop("memory", None)
     if "tracker" in s:
         s["tracker"].pop("phases", None)
         for k in ("iters", "lanes_live", "occupancy"):

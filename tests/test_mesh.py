@@ -349,6 +349,9 @@ sweep:
     def _stats(path):
         s = json.loads(pathlib.Path(path).read_text())
         s.pop("wall_seconds")
+        # memory prices the run's own plane (batch row vs standalone
+        # shard): execution shape, not trajectory
+        s.pop("memory", None)
         if "tracker" in s:
             s["tracker"].pop("phases", None)
             for k in ("iters", "lanes_live", "occupancy"):

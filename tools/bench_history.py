@@ -145,6 +145,23 @@ def _exchange_row(detail: dict) -> "dict | None":
     return row or None
 
 
+def _memory_row(detail: dict) -> "dict | None":
+    """The device-memory rows the main trial published: detail.main.memory
+    (the memory observatory round) as {"bytes_per_host@Nh": bytes} plus
+    the compiled peak when the backend reported one. Memory is a cost, so
+    memory_check inverts the comparison direction (lower is better).
+    Keyed by world size so salvaged partial rounds never compare across
+    shapes. None when the round priced nothing."""
+    mem = (detail.get("main") or {}).get("memory") or {}
+    hosts = (detail.get("config") or {}).get("hosts", "?")
+    row = {}
+    if mem.get("bytes_per_host") is not None:
+        row[f"bytes_per_host@{hosts}h"] = mem["bytes_per_host"]
+    if mem.get("peak_hbm_bytes") is not None:
+        row[f"peak_hbm_bytes@{hosts}h"] = mem["peak_hbm_bytes"]
+    return row or None
+
+
 def _metric_verdicts(rounds_key: str, keys, history, current,
                      latest_round, lower_is_better: bool = False) -> dict:
     """The shared best-prior/TOLERANCE verdict core behind
@@ -284,6 +301,27 @@ def exchange_check(rounds: "list[dict]",
     return out
 
 
+def memory_check(rounds: "list[dict]",
+                 current: "dict | None" = None) -> dict:
+    """The detail.main.memory trajectory verdicts — priced bytes/host
+    (and compiled peak HBM) per world size, the SAME best-prior/
+    TOLERANCE core as every other detail metric with the direction
+    inverted (memory is a cost: a perf round that quietly doubles the
+    footprint must announce itself). `current` is an in-flight
+    {"bytes_per_host@Nh": bytes, ...} from bench.py; None compares the
+    newest recorded round against the rest."""
+    history, current, latest_round = _pop_latest("memory", rounds, current)
+    keys = sorted(
+        set(current or {}) | {m for r in history for m in r["memory"]}
+    )
+    out, verdicts = _metric_verdicts(
+        "memory", keys, history, current, latest_round,
+        lower_is_better=True,
+    )
+    out["rows"] = verdicts
+    return out
+
+
 def service_check(rounds: "list[dict]",
                   current: "dict | None" = None) -> dict:
     """The detail.service trajectory verdicts — jobs_per_hour and
@@ -331,6 +369,7 @@ def load_rounds(root: str = ".") -> "list[dict]":
             "mesh": _mesh_row(detail),
             "elastic": _elastic_row(detail),
             "exchange": _exchange_row(detail),
+            "memory": _memory_row(detail),
             "attempts": [
                 _attempt_row(a) for a in detail.get("attempts", [])
             ],
@@ -428,11 +467,12 @@ def main(argv=None) -> int:
     msh = mesh_check(rounds)
     ela = elastic_check(rounds)
     exc = exchange_check(rounds)
+    mem = memory_check(rounds)
     if args.json:
         print(json.dumps(
             {"rounds": rounds, "verdict": verdict, "service": svc,
              "overlay": ovl, "mesh": msh, "elastic": ela,
-             "exchange": exc}, indent=2
+             "exchange": exc, "memory": mem}, indent=2
         ))
     else:
         print(trajectory_table(rounds))
@@ -452,6 +492,9 @@ def main(argv=None) -> int:
         for row, v in exc["rows"].items():
             if v.get("latest") is not None or v.get("best_prior") is not None:
                 print(f"exchange.{row}: {v['note']}")
+        for row, v in mem["rows"].items():
+            if v.get("latest") is not None or v.get("best_prior") is not None:
+                print(f"memory.{row}: {v['note']}")
     return 1 if (
         verdict.get("regression")
         or svc.get("regression")
@@ -459,6 +502,7 @@ def main(argv=None) -> int:
         or msh.get("regression")
         or ela.get("regression")
         or exc.get("regression")
+        or mem.get("regression")
     ) else 0
 
 

@@ -1050,6 +1050,59 @@ def profile_exchange(hosts: int = 0, reps: int = 10):
     return out
 
 
+def profile_memory(sizes=(256, 1024, 4096)):
+    """Part 10 (memory observatory round): the three memory layers side
+    by side per world size — the STATIC priced state (runtime/memtrack.py,
+    exact leaf bytes), the COMPILED peak XLA reports for one chunk
+    executable (arguments + outputs + temps − donation aliases), and the
+    MEASURED device bytes_in_use where the backend exposes memory_stats
+    (TPU/GPU; CPU reports none and says so). Also publishes the
+    per-subsystem breakdown and checks the dominant grid is the queue's
+    [H, C] event rows — the scaling story docs/observability.md tells."""
+    import jax
+    import jax.numpy as jnp
+
+    from bench import _build
+    from shadow_tpu.engine.round import _run_chunk
+    from shadow_tpu.runtime import memtrack
+
+    rows = []
+    for hosts in sizes:
+        cfg, model, tables, st0 = _build(hosts)
+        report = memtrack.price_state(st0, cfg)
+        row = {
+            "hosts": hosts,
+            "static_bytes": report["total_bytes"],
+            "bytes_per_host": report["bytes_per_host"],
+            "groups": {
+                name: g["bytes"] for name, g in report["groups"].items()
+            },
+            "dominant": report["dominant"]["name"],
+            "dominant_is_queue": report["dominant"]["name"].startswith(
+                "queue."
+            ),
+        }
+        try:
+            exe = (
+                jax.jit(_run_chunk, static_argnums=(2, 3, 5))
+                .lower(
+                    st0, jnp.asarray(10**15, jnp.int64), 8, model, tables,
+                    cfg,
+                )
+                .compile()
+            )
+            cm = memtrack.compiled_memory(exe)
+            if cm:
+                row["compiled"] = cm
+        except Exception as e:  # noqa: BLE001 — memory analysis is best-effort
+            row["compiled"] = {"error": str(e)[:200]}
+        dm = memtrack.device_memory()
+        row["device"] = dm if dm else "backend reports no memory_stats"
+        rows.append(row)
+        print(json.dumps({"memory_row": row}), flush=True)
+    return {"rows": rows}
+
+
 def main():
     import jax
 
@@ -1069,6 +1122,7 @@ def main():
     out["adaptivity"] = profile_adaptivity()
     out["mesh_collectives"] = profile_mesh_collectives()
     out["exchange"] = profile_exchange()
+    out["memory"] = profile_memory()
     print(json.dumps(out), flush=True)
 
 
