@@ -1296,12 +1296,25 @@ def _measure_service(num_hosts: int, jobs_per_tenant: int = 3):
     `restart.compiles` must be 0 when the persistent compile cache
     holds (the crash-recovery economics), and jobs/hour + cache hit
     rate are the published detail.service SLO numbers
-    (tools/bench_history.py tracks both across rounds)."""
+    (tools/bench_history.py tracks both across rounds). A final
+    HTTP+fleet rung (ISSUE 20) drains three more specs through TWO
+    serve subprocesses on the same spool — one serving the HTTP front
+    door, one spec POSTed over it — publishing fleet-wide admission
+    latency percentiles (`admit_latency_p99_s`, tracked lower-is-better
+    by service_check), double-claim/lost counts (both must be 0), and
+    `zero_recompile_second_daemon` off the shared persistent cache."""
+    import re as _re
+    import subprocess
     import tempfile
+    import urllib.request
 
     import yaml
 
-    from shadow_tpu.runtime.daemon import DaemonService, submit_spec
+    from shadow_tpu.runtime.daemon import (
+        DaemonService,
+        _percentiles,
+        submit_spec,
+    )
 
     base = {
         "general": {"stop_time": "100 ms", "heartbeat_interval": None},
@@ -1351,10 +1364,108 @@ def _measure_service(num_hosts: int, jobs_per_tenant: int = 3):
         t0 = time.perf_counter()
         m2 = DaemonService(spool, capacity=jobs_per_tenant, drain=True).run()
         wall2 = time.perf_counter() - t0
+
+        # ---- HTTP + fleet rung: two daemons, one spool, one front
+        # door; every world is already in the shared persistent cache,
+        # so the whole rung must pay zero XLA compiles
+        _spool_specs(d, spool, "fleet", tenants)
+        t0 = time.perf_counter()
+        procs = []
+        for i in range(2):
+            args = [sys.executable, "-m", "shadow_tpu.cli", "serve",
+                    spool, "--drain", "--poll-interval", "0.2",
+                    "--capacity", str(jobs_per_tenant),
+                    "--daemon-id", f"bench-{i}"]
+            if i == 0:
+                args += ["--http", "127.0.0.1:0"]
+            procs.append(subprocess.Popen(
+                args, env=_cpu_env(), cwd=os.path.dirname(
+                    os.path.abspath(__file__)),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True,
+            ))
+        # one spec through the network door while the fleet drains
+        http_posted = False
+        addr_file = os.path.join(spool, "http-address")
+        deadline = time.time() + 60
+        while time.time() < deadline and not os.path.exists(addr_file):
+            time.sleep(0.1)
+        if os.path.exists(addr_file):
+            with open(addr_file) as f:
+                addr = f.read().strip()
+            body = yaml.safe_dump({
+                "job": {"tenant": "t1", "name": "hot",
+                        "seeds": list(range(jobs_per_tenant)),
+                        "config": base}
+            })
+            try:
+                req = urllib.request.Request(
+                    f"http://{addr}/v1/jobs", data=body.encode(),
+                    method="POST",
+                )
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    http_posted = resp.status == 202
+            except OSError:
+                pass
+        fleet_outs = [p.communicate(timeout=900)[0] for p in procs]
+        wall3 = time.perf_counter() - t0
+        fleet_rcs = [p.returncode for p in procs]
+        # per-daemon XLA compiles off the run_serve summary line
+        fleet_compiles = [
+            int(m.group(1)) if m else None
+            for m in (
+                _re.search(r"compile cache: (\d+) compile", out)
+                for out in fleet_outs
+            )
+        ]
+        # fleet-wide exactly-once + admission latency off the journal
+        # (the manifest file is last-writer-wins between the daemons)
+        admits, done = [], {}
+        for fn in sorted(os.listdir(os.path.join(spool, "journal"))):
+            if not (fn.startswith("r") and fn.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(spool, "journal", fn)) as f:
+                    rec = json.load(f)
+            except ValueError:
+                continue
+            if rec.get("type") == "admit":
+                admits.append(rec)
+            elif rec.get("type") == "job-done":
+                done[rec["job"]] = done.get(rec["job"], 0) + 1
+        admitted = {j for r in admits for j in r.get("jobs", [])}
+        latencies = [
+            r["admit_latency_s"] for r in admits
+            if r.get("admit_latency_s") is not None
+        ]
+        lat = _percentiles(latencies)
+        fleet_jobs = len(tenants) * jobs_per_tenant + (
+            jobs_per_tenant if http_posted else 0
+        )
+
     total_jobs = m1["jobs_done"] + m2["jobs_done"]
     total_wall = wall1 + wall2
     cache2 = m2["compile_cache"]
     return {
+        "admit_latency_p50_s": lat.get("p50"),
+        "admit_latency_p90_s": lat.get("p90"),
+        "admit_latency_p99_s": lat.get("p99"),
+        "fleet": {
+            "daemons": 2,
+            "jobs": fleet_jobs,
+            "wall_s": round(wall3, 2),
+            "jobs_per_hour": (
+                round(fleet_jobs / wall3 * 3600, 1) if wall3 > 0 else None
+            ),
+            "http_posted": http_posted,
+            "exit_codes": fleet_rcs,
+            "compiles": fleet_compiles,
+            "zero_recompile_second_daemon": fleet_compiles[1] == 0,
+            "double_claimed_jobs": sum(
+                1 for n in done.values() if n > 1
+            ),
+            "lost_jobs": len(admitted - set(done)),
+        },
         "hosts": num_hosts,
         "tenants": len(tenants),
         "jobs": total_jobs,
@@ -2200,6 +2311,9 @@ def main():
                 current={
                     "jobs_per_hour": service.get("jobs_per_hour"),
                     "cache_hit_rate": service.get("cache_hit_rate"),
+                    "admit_latency_p99_s": service.get(
+                        "admit_latency_p99_s"
+                    ),
                 },
             )
         if overlay and overlay.get("rows"):

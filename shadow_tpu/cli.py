@@ -204,8 +204,10 @@ def main(argv: "list[str] | None" = None) -> int:
         help="run the durable simulation daemon on a spool directory: "
         "live job arrivals (specs dropped into SPOOL/incoming/), a "
         "crash-safe write-ahead journal (SIGKILL loses zero admitted "
-        "jobs), per-tenant quotas + weighted fair-share, and a "
-        "disk-persistent compile cache (docs/service.md 'Daemon mode')",
+        "jobs), per-tenant quotas + weighted fair-share, a "
+        "disk-persistent compile cache, an optional HTTP front door "
+        "(--http), and fleet operation — N daemons, one spool, "
+        "lease-based claims (docs/service.md 'Daemon mode')",
     )
     serve_p.add_argument(
         "spool", help="spool directory (created if missing; all durable "
@@ -252,6 +254,39 @@ def main(argv: "list[str] | None" = None) -> int:
         "--quota", action="append", metavar="TENANT=N",
         help="override the outstanding-jobs quota for one tenant "
         "(repeatable)",
+    )
+    serve_p.add_argument(
+        "--quota-class", action="append", metavar="T=device_seconds:N[,queue:M]",
+        help="enforced per-tenant budget class: N device-seconds per "
+        "--quota-window; new jobs from an over-budget tenant are "
+        "refused (journaled reject + Retry-After), a RUNNING batch is "
+        "checkpointed and parked at the next chunk boundary; queue:M "
+        "overrides the outstanding-jobs quota (repeatable; "
+        "docs/service.md 'Quota classes')",
+    )
+    serve_p.add_argument(
+        "--quota-window", type=float, default=3600.0, metavar="SECONDS",
+        help="quota-class accounting window: budgets refill when it "
+        "rolls (default 3600)",
+    )
+    serve_p.add_argument(
+        "--http", metavar="HOST:PORT",
+        help="serve the HTTP front door on this address (port 0 binds "
+        "an ephemeral port, published in SPOOL/http-address): POST "
+        "/v1/jobs, GET /v1/jobs/{id}[/results|/events], GET /v1/metrics "
+        "(docs/service.md 'HTTP front door')",
+    )
+    serve_p.add_argument(
+        "--lease-s", type=float, default=30.0, metavar="SECONDS",
+        help="batch-claim lease duration for fleet operation (N serve "
+        "processes, one spool): leases renew at chunk ticks and a dead "
+        "daemon's claims are reclaimed by survivors once expired "
+        "(default 30; docs/service.md 'Running a fleet')",
+    )
+    serve_p.add_argument(
+        "--daemon-id", metavar="ID",
+        help="this daemon's fleet identity in claims/leases and the "
+        "manifest (default HOSTNAME.PID)",
     )
     serve_p.add_argument(
         "--weight", action="append", metavar="TENANT=W",
@@ -329,6 +364,23 @@ def main(argv: "list[str] | None" = None) -> int:
     submit_p.add_argument(
         "--tenant", metavar="NAME",
         help="set/override job.tenant in the submitted spec",
+    )
+    submit_p.add_argument(
+        "--wait", action="store_true",
+        help="after spooling, poll until every submitted job is "
+        "terminal; exit 0 iff all done (1 = failed/quarantined/"
+        "rejected, 2 = --timeout expired)",
+    )
+    submit_p.add_argument(
+        "--timeout", type=float, metavar="SECONDS",
+        help="give up on --wait after this long (exit 2; default: "
+        "wait forever)",
+    )
+    submit_p.add_argument(
+        "--http", metavar="URL",
+        help="with --wait, poll the daemon's HTTP status endpoint "
+        "(e.g. http://127.0.0.1:8080) instead of reading the journal — "
+        "works from hosts that cannot see the spool filesystem",
     )
     mem_p = sub.add_parser(
         "mem",
@@ -444,7 +496,12 @@ def main(argv: "list[str] | None" = None) -> int:
                 max_queue=args.max_queue,
                 default_quota=args.default_quota,
                 quotas=args.quota,
+                quota_classes=args.quota_class,
+                quota_window=args.quota_window,
                 weights=args.weight,
+                http=args.http,
+                lease_s=args.lease_s,
+                daemon_id=args.daemon_id,
                 keep_batch_dirs=args.keep_batch_dirs,
                 cache_dir=args.cache_dir,
                 no_cache_persist=args.no_cache_persist,
@@ -464,7 +521,14 @@ def main(argv: "list[str] | None" = None) -> int:
         from shadow_tpu.runtime.cli_run import CliUserError, run_submit
 
         try:
-            return run_submit(args.spool, args.spec, tenant=args.tenant)
+            return run_submit(
+                args.spool,
+                args.spec,
+                tenant=args.tenant,
+                wait=args.wait,
+                timeout=args.timeout,
+                http=args.http,
+            )
         except CliUserError as e:
             print(f"shadow-tpu: error: {e}", file=sys.stderr)
             return 1

@@ -52,6 +52,13 @@ FAULT_KINDS = (
     # the lost jax device id) — exercises mesh degradation: roll back,
     # re-plan onto the surviving grid, replay leaf-exact
     "device-loss",
+    # front-door faults (runtime/httpapi.py, runtime/daemon.py;
+    # docs/service.md "HTTP front door"): drop an HTTP request with a
+    # structured 503 at request ordinal `at`; rewrite a daemon's own
+    # batch claim to a foreign owner at lease-renewal ordinal `at` — the
+    # daemon must detect the loss, park the batch, and reclaim later
+    "http-drop",
+    "lease-steal",
 )
 
 

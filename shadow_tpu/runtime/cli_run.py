@@ -8,6 +8,7 @@ propagates with its traceback.
 from __future__ import annotations
 
 import json
+import os
 import sys
 
 import yaml
@@ -301,7 +302,12 @@ def run_serve(
     max_queue: int = 256,
     default_quota: int = 64,
     quotas: "list[str] | None" = None,
+    quota_classes: "list[str] | None" = None,
+    quota_window: float = 3600.0,
     weights: "list[str] | None" = None,
+    http: "str | None" = None,
+    lease_s: float = 30.0,
+    daemon_id: "str | None" = None,
     keep_batch_dirs: int = 8,
     cache_dir: "str | None" = None,
     no_cache_persist: bool = False,
@@ -321,7 +327,7 @@ def run_serve(
     import contextlib
 
     from shadow_tpu.runtime import chaos
-    from shadow_tpu.runtime.daemon import DaemonService
+    from shadow_tpu.runtime.daemon import DaemonService, parse_quota_class
 
     if capacity < 1:
         raise CliUserError("--capacity must be >= 1")
@@ -329,6 +335,24 @@ def run_serve(
         raise CliUserError("--retry-max must be >= 0")
     if max_queue < 1 or default_quota < 1:
         raise CliUserError("--max-queue and --default-quota must be >= 1")
+    if quota_window <= 0:
+        raise CliUserError("--quota-window must be > 0")
+    if lease_s <= 0:
+        raise CliUserError("--lease-s must be > 0")
+    qclasses = {}
+    for arg in quota_classes or []:
+        try:
+            t, cls = parse_quota_class(arg)
+        except ValueError as e:
+            raise CliUserError(f"invalid --quota-class {arg!r}: {e}") from e
+        qclasses[t] = cls
+    if http is not None:
+        from shadow_tpu.runtime.httpapi import parse_http_addr
+
+        try:
+            parse_http_addr(http)
+        except ValueError as e:
+            raise CliUserError(str(e)) from e
     faults = []
     for arg in chaos_faults or []:
         from shadow_tpu.runtime.chaos import parse_fault_arg
@@ -353,7 +377,12 @@ def run_serve(
             retry_max=retry_max,
             default_quota=default_quota,
             quotas=_parse_kv_list(quotas, int, "--quota"),
+            quota_classes=qclasses or None,
+            quota_window_s=quota_window,
             weights=_parse_kv_list(weights, float, "--weight"),
+            http=http,
+            lease_s=lease_s,
+            daemon_id=daemon_id,
             max_queue=max_queue,
             poll_interval_s=poll_interval,
             prom_interval_s=prom_interval,
@@ -406,6 +435,12 @@ def run_serve(
             f"{p['disk_stores']} stored, {p['disk_skips']} skipped"
         )
     print(line)
+    lat = d.get("admit_latency") or {}
+    if lat.get("count"):
+        print(
+            f"admission latency over {lat['count']} admit(s): "
+            f"p50 {lat['p50']}s, p90 {lat['p90']}s, p99 {lat['p99']}s"
+        )
     clean = (
         manifest["jobs_failed"] == 0
         and manifest["jobs_quarantined"] == 0
@@ -416,13 +451,113 @@ def run_serve(
     return 0 if clean else 1
 
 
-def run_submit(spool: str, spec: str, tenant: "str | None" = None) -> int:
-    """`shadow-tpu submit` implementation: atomic drop into the spool."""
-    from shadow_tpu.runtime.daemon import submit_spec
+def run_submit(
+    spool: str,
+    spec: str,
+    tenant: "str | None" = None,
+    wait: bool = False,
+    timeout: "float | None" = None,
+    http: "str | None" = None,
+    poll_s: float = 1.0,
+) -> int:
+    """`shadow-tpu submit` implementation: atomic drop into the spool,
+    printing the canonical job ids the daemon will admit under. With
+    --wait, poll until every id is terminal — via the journal, or the
+    HTTP status endpoint when --http URL is given (a submitter that can
+    see the spool but scrapes a remote daemon). Exit 0 iff all jobs
+    finished `done`; 1 on any failed/quarantined/rejected outcome; 2
+    when --timeout expires first."""
+    from shadow_tpu.runtime.daemon import spec_job_ids, submit_spec
 
     try:
+        _tn, _entry, ids = spec_job_ids(spec, tenant=tenant)
         dest = submit_spec(spool, spec, tenant=tenant)
     except (ValueError, OSError, yaml.YAMLError) as e:
         raise CliUserError(f"invalid spec: {e}") from e
     print(f"spooled {dest}")
-    return 0
+    for jid in ids:
+        print(f"job {jid}")
+    if not wait:
+        return 0
+    return _wait_for_jobs(
+        spool, os.path.basename(dest), ids,
+        timeout=timeout, http=http, poll_s=poll_s,
+    )
+
+
+def _http_job_status(base_url: str, jid: str) -> "str | None":
+    """One GET /v1/jobs/{id} poll: the job's status, or None while the
+    daemon does not know the id yet (404) or is unreachable (it may
+    still be starting — --timeout bounds the patience)."""
+    import urllib.error
+    import urllib.request
+
+    url = f"{base_url.rstrip('/')}/v1/jobs/{jid}"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return json.loads(resp.read()).get("status")
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return None
+        raise CliUserError(f"GET {url} failed: HTTP {e.code}") from e
+    except (OSError, ValueError):
+        return None
+
+
+def _wait_for_jobs(
+    spool: str,
+    spooled_name: str,
+    ids: "list[str]",
+    timeout: "float | None" = None,
+    http: "str | None" = None,
+    poll_s: float = 1.0,
+) -> int:
+    import glob
+    import time
+
+    deadline = (
+        time.monotonic() + timeout if timeout is not None else None
+    )
+    terminal: "dict[str, str]" = {}
+    while True:
+        if http:
+            for jid in ids:
+                if jid in terminal:
+                    continue
+                status = _http_job_status(http, jid)
+                if status in ("done", "failed", "quarantined"):
+                    terminal[jid] = status
+        else:
+            from shadow_tpu.runtime.daemon import journal_terminal_map
+
+            term = journal_terminal_map(spool)
+            terminal = {jid: term[jid] for jid in ids if jid in term}
+            # a rejected spec never admits, so its jobs never reach the
+            # journal — the structured reply file is the terminal signal
+            hits = glob.glob(os.path.join(
+                spool, "rejected", f"*-{spooled_name}.reason.json"
+            ))
+            if hits and len(terminal) < len(ids):
+                try:
+                    with open(hits[0]) as f:
+                        rec = json.load(f)
+                    detail = f"{rec.get('reason')}: {rec.get('detail')}"
+                except (OSError, ValueError):
+                    detail = hits[0]
+                print(f"rejected: {detail}", file=sys.stderr)
+                return 1
+        if len(terminal) == len(ids):
+            break
+        if deadline is not None and time.monotonic() >= deadline:
+            missing = [jid for jid in ids if jid not in terminal]
+            print(
+                f"timeout: {len(missing)} of {len(ids)} job(s) not "
+                f"terminal after {timeout}s "
+                f"(first pending: {missing[0]})",
+                file=sys.stderr,
+            )
+            return 2
+        time.sleep(poll_s)
+    for jid in ids:
+        print(f"{jid}: {terminal[jid]}")
+    return 0 if all(s == "done" for s in terminal.values()) else 1

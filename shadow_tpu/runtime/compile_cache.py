@@ -173,8 +173,12 @@ class PersistentCompileCache(CompileCache):
     Every degradation is survivable BY CONSTRUCTION: an unreadable,
     truncated, digest-mismatched, or version-mismatched entry — and a
     backend whose executables refuse to (de)serialize at all — logs one
-    warning and falls back to a normal XLA compile. `stats()` gains a
-    `persistent` block (disk_hits / disk_stores / disk_skips)."""
+    warning and falls back to a normal XLA compile; a bad entry is also
+    evicted so the recompile re-stores it. A daemon FLEET shares one
+    cache_dir: `_persist` keeps a peer's already-committed entry instead
+    of overwriting it (counted as a peer skip). `stats()` gains a
+    `persistent` block (disk_hits / disk_stores / disk_skips /
+    disk_peer_skips)."""
 
     def __init__(self, cache_dir: str):
         super().__init__()
@@ -182,12 +186,22 @@ class PersistentCompileCache(CompileCache):
         self.disk_hits = 0
         self.disk_stores = 0
         self.disk_skips = 0  # corrupt/mismatched/unserializable entries
+        self.disk_peer_skips = 0  # stores skipped: a fleet peer beat us
         self.runtime_version = f"jax-{jax.__version__}/{jax.default_backend()}"
         os.makedirs(cache_dir, exist_ok=True)
 
     def _entry_path(self, fk) -> str:
         digest = hashlib.sha256(repr(fk).encode()).hexdigest()
         return os.path.join(self.cache_dir, f"exe-{digest[:32]}.bin")
+
+    def _evict(self, path: str) -> None:
+        """Drop a bad entry so the recompile's `_persist` re-stores a
+        fresh copy instead of peer-skipping the corpse (a fleet shares
+        this directory — the existence check must mean 'good entry')."""
+        try:
+            os.remove(path)
+        except OSError:
+            pass
 
     def _load_persisted(self, fk):
         from jax.experimental import serialize_executable
@@ -201,6 +215,7 @@ class PersistentCompileCache(CompileCache):
                 payload = f.read()
         except (OSError, ValueError):
             self.disk_skips += 1
+            self._evict(path)
             slog("warning", 0, "cache",
                  f"persistent compile-cache entry {path} is unreadable "
                  "(corrupt or truncated); recompiling")
@@ -209,6 +224,7 @@ class PersistentCompileCache(CompileCache):
             header.get("runtime") != self.runtime_version
         ):
             self.disk_skips += 1
+            self._evict(path)
             slog("warning", 0, "cache",
                  f"persistent compile-cache entry {path} was written by "
                  f"{header.get('runtime')!r} format {header.get('format')!r} "
@@ -217,6 +233,7 @@ class PersistentCompileCache(CompileCache):
             return None
         if hashlib.sha256(payload).hexdigest() != header.get("sha256"):
             self.disk_skips += 1
+            self._evict(path)
             slog("warning", 0, "cache",
                  f"persistent compile-cache entry {path} failed its "
                  "sha-256 integrity check; recompiling")
@@ -228,6 +245,7 @@ class PersistentCompileCache(CompileCache):
             )
         except Exception as e:  # noqa: BLE001 — any load failure = recompile
             self.disk_skips += 1
+            self._evict(path)
             slog("warning", 0, "cache",
                  f"persistent compile-cache entry {path} failed to "
                  f"deserialize ({type(e).__name__}: {str(e)[:120]}); "
@@ -242,6 +260,13 @@ class PersistentCompileCache(CompileCache):
         from shadow_tpu.runtime import chaos
 
         path = self._entry_path(fk)
+        if os.path.exists(path):
+            # a fleet peer sharing this cache_dir stored the entry while
+            # we were compiling (we raced past _load_persisted before it
+            # landed); any existing entry passed its own integrity gates
+            # when written, and corrupt ones are evicted on load — keep it
+            self.disk_peer_skips += 1
+            return
         try:
             payload = pickle.dumps(serialize_executable.serialize(exe))
         except Exception as e:  # noqa: BLE001 — persistence is best-effort
@@ -283,5 +308,6 @@ class PersistentCompileCache(CompileCache):
             "disk_hits": self.disk_hits,
             "disk_stores": self.disk_stores,
             "disk_skips": self.disk_skips,
+            "disk_peer_skips": self.disk_peer_skips,
         }
         return out

@@ -33,9 +33,28 @@ guarantee:
     the least weighted sim-time served runs next, so one tenant's
     100-job flood cannot starve another tenant's single urgent job.
 
+Three serving-layer extensions ride the same admission path
+(docs/service.md "HTTP front door"):
+
+  * **HTTP front door** (``serve --http HOST:PORT``,
+    runtime/httpapi.py) — network submission/status/results/events/
+    metrics, every POST landing in the spool through the identical
+    atomic-rename + journal path a file drop takes.
+  * **Quota classes** (``--quota-class T=device_seconds:N[,queue:M]``)
+    — the per-tenant device-seconds ledger, ENFORCED: over-budget
+    admissions refuse with a journaled 429-equivalent carrying the
+    refill window's Retry-After, and a running batch whose tenant runs
+    dry parks (checkpoint + re-queue) at the next chunk boundary.
+  * **Daemon fleet** — N serve processes share one spool: journal
+    appends commit with no-overwrite links, per-batch claim files
+    (owner + lease expiry, renewed at chunk ticks) make ownership
+    exclusive, and a dead daemon's expired leases are stolen by
+    survivors who resume from its newest checkpoint.
+
 The compile cache is a PersistentCompileCache
 (runtime/compile_cache.py) rooted in the spool, so a restarted daemon
-pays zero XLA recompiles for worlds it has already compiled. The chaos
+— or a fleet peer — pays zero XLA recompiles for worlds any daemon has
+already compiled. The chaos
 plane closes the loop: ``daemon-kill`` / ``spool-corrupt`` /
 ``cache-corrupt`` faults (runtime/chaos.py) drive the soak test
 (tests/test_daemon_soak.py) — 100+ jobs, 3 tenants, faults firing, and
@@ -127,8 +146,18 @@ class Journal:
         # valid to fold (None = never stuck): the cadence check skips
         # until the count moves past it
         self._compact_stuck_at: "int | None" = None
+        # append() is called from the drain loop AND the HTTP front
+        # door's handler threads (runtime/httpapi.py) — one writer lock
+        # per process; cross-process exclusivity is the link commit's job
+        self._lock = threading.Lock()
         os.makedirs(directory, exist_ok=True)
-        names = os.listdir(directory)
+        self._rescan_seq(floor=0)
+
+    def _rescan_seq(self, floor: "int | None" = None) -> None:
+        """Re-derive the next free sequence number from the directory —
+        construction, and the retry path after a fleet peer wins a
+        sequence-number race."""
+        names = os.listdir(self.directory)
         seqs = [
             int(m.group(1))
             for m in (self._REC_RE.match(f) for f in names)
@@ -139,8 +168,9 @@ class Journal:
             for m in (self._SNAP_RE.match(f) for f in names)
             if m
         ]
+        base = self._seq if floor is None else floor
         self._seq = max(
-            [s + 1 for s in seqs] + [s + 1 for s in snaps] + [0]
+            [s + 1 for s in seqs] + [s + 1 for s in snaps] + [base]
         )
         self._tail_files = len(seqs)
 
@@ -163,26 +193,41 @@ class Journal:
     def append(self, _type: str, **data) -> dict:
         from shadow_tpu.runtime import chaos
 
-        rec = {
-            "seq": self._seq,
-            "version": JOURNAL_VERSION,
-            "type": _type,
-            "wall": round(time.time(), 3),
-            **data,
-        }
-        rec["sha256"] = _record_digest(rec)
-        path = self._path(self._seq)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(rec, f)
-        os.replace(tmp, path)
-        # chaos seam: bit-rot on a fully committed record — exactly what
-        # the per-record digest and the accepted/ rescan defend against
-        if chaos.fire("spool-corrupt", at=rec["seq"]) is not None:
-            chaos.damage_file(path, truncate=False)
-        self._seq += 1
-        self._tail_files += 1
-        return rec
+        with self._lock:
+            while True:
+                rec = {
+                    "seq": self._seq,
+                    "version": JOURNAL_VERSION,
+                    "type": _type,
+                    "wall": round(time.time(), 3),
+                    **data,
+                }
+                rec["sha256"] = _record_digest(rec)
+                path = self._path(self._seq)
+                tmp = f"{path}.tmp.{os.getpid()}"
+                with open(tmp, "w") as f:
+                    json.dump(rec, f)
+                try:
+                    # link-not-replace is the fleet-safe commit: when a
+                    # peer daemon on the same spool claims this sequence
+                    # number first, the link fails loudly and we retry
+                    # at the next free seq (os.replace would silently
+                    # swallow the peer's record)
+                    os.link(tmp, path)
+                except FileExistsError:
+                    os.remove(tmp)
+                    self._rescan_seq()
+                    continue
+                os.remove(tmp)
+                break
+            # chaos seam: bit-rot on a fully committed record — exactly
+            # what the per-record digest and the accepted/ rescan defend
+            # against
+            if chaos.fire("spool-corrupt", at=rec["seq"]) is not None:
+                chaos.damage_file(path, truncate=False)
+            self._seq += 1
+            self._tail_files += 1
+            return rec
 
     def _load_snapshot(self) -> "dict | None":
         """The newest snapshot that passes its sha-256 check; a corrupt
@@ -244,6 +289,21 @@ class Journal:
         self.snapshot = self._load_snapshot()
         after = self.snapshot["through_seq"] if self.snapshot else -1
         return self._read_records(after_seq=after)
+
+    def read_new(self, after_seq: int) -> "list[dict]":
+        """Valid records with seq > after_seq currently on disk — the
+        fleet-coherence read (a peer daemon's appends since our last
+        look). Corrupt records are skipped WITHOUT recounting them into
+        corrupt_skipped (a stable corrupt record would otherwise inflate
+        the counter once per poll), and the next append is bumped past
+        everything seen so our own records never trail a peer's."""
+        skipped = self.corrupt_skipped
+        recs = self._read_records(after_seq=after_seq)
+        self.corrupt_skipped = skipped
+        if recs:
+            with self._lock:
+                self._seq = max(self._seq, recs[-1]["seq"] + 1)
+        return recs
 
     def compact(self) -> "dict | None":
         """Fold snapshot + all current records into a fresh snapshot and
@@ -477,6 +537,63 @@ def parse_spool_spec(text: str, spool_dir: str,
     return tenant, ename, jobs, canonical_text
 
 
+def parse_quota_class(arg: str) -> "tuple[str, dict]":
+    """Parse one `--quota-class T=device_seconds:N[,queue:M]` argument
+    into (tenant, {"device_seconds": float, "queue": int | None}).
+    `device_seconds` is the tenant's budget per refill window (serve
+    --quota-window); `queue` overrides the tenant's outstanding-job
+    quota. Enforcement lives in DaemonService (docs/service.md "Quota
+    classes")."""
+    if "=" not in arg:
+        raise ValueError(
+            f"quota-class {arg!r} must be "
+            "TENANT=device_seconds:N[,queue:M]"
+        )
+    tenant, _, body = arg.partition("=")
+    tenant = tenant.strip()
+    if not _NAME_RE.match(tenant):
+        raise ValueError(f"quota-class tenant {tenant!r} is not a name")
+    out: dict = {"device_seconds": None, "queue": None}
+    for part in body.split(","):
+        key, sep, val = part.partition(":")
+        key = key.strip()
+        if not sep or key not in out:
+            raise ValueError(
+                f"quota-class {arg!r}: bad term {part!r} (want "
+                "device_seconds:N or queue:M)"
+            )
+        try:
+            out[key] = float(val) if key == "device_seconds" else int(val)
+        except ValueError:
+            raise ValueError(
+                f"quota-class {arg!r}: {key} value {val!r} is not a number"
+            ) from None
+        floor = 0 if key == "device_seconds" else 1
+        if out[key] < floor:
+            raise ValueError(
+                f"quota-class {arg!r}: {key} must be >= {floor}"
+            )
+    if out["device_seconds"] is None:
+        raise ValueError(
+            f"quota-class {arg!r} needs a device_seconds:N budget"
+        )
+    return tenant, out
+
+
+def _percentiles(samples: "list[float]") -> dict:
+    """p50/p90/p99 by the nearest-rank method — the admission-latency
+    summary of daemon-manifest.json and bench detail.service."""
+    if not samples:
+        return {}
+    xs = sorted(samples)
+    n = len(xs)
+    return {
+        # nearest-rank: index ceil(p/100 * n) - 1, clamped
+        f"p{p}": round(xs[min(n - 1, max(0, -(-(p * n) // 100) - 1))], 6)
+        for p in (50, 90, 99)
+    }
+
+
 class DaemonService(SweepService):
     """The persistent daemon: a SweepService whose queue is fed by the
     spool, journaled through the WAL, scheduled with per-tenant
@@ -509,10 +626,15 @@ class DaemonService(SweepService):
         default_tenant: str = "default",
         mesh: "str | None" = None,
         journal_compact_every: int = 512,
+        http: "str | None" = None,
+        quota_classes: "dict[str, dict] | None" = None,
+        quota_window_s: float = 3600.0,
+        lease_s: float = 30.0,
+        daemon_id: "str | None" = None,
     ):
         self.spool_dir = os.path.abspath(spool_dir)
         for sub in ("incoming", "accepted", "rejected", "journal",
-                    "jobs", "batches"):
+                    "jobs", "batches", "claims"):
             os.makedirs(os.path.join(self.spool_dir, sub), exist_ok=True)
         spec = SweepSpec(
             name="daemon",
@@ -584,6 +706,36 @@ class DaemonService(SweepService):
         self._last_poll_wall = float("-inf")
         self._last_prom_wall = float("-inf")
         self._manifest_doc: "dict | None" = None
+        # --- front door (runtime/httpapi.py) -----------------------------
+        self.http_addr = http
+        self.front_door = None  # built in run() when http_addr is set
+        # --- quota classes (enforced device-seconds budgets) -------------
+        self.quota_classes = {
+            str(t): dict(c) for t, c in (quota_classes or {}).items()
+        }
+        self.quota_window_s = max(float(quota_window_s), 1e-3)
+        self._window_start = time.monotonic()
+        # tenant_device_seconds snapshot at the window's start: spend
+        # WITHIN the window = current - base, so a refill is just a new
+        # base — the ledger itself never resets
+        self._window_base: "dict[str, float]" = {}
+        self._parked_note: "set[str]" = set()  # park journaled once/run
+        # --- fleet claims (one spool, N daemons) -------------------------
+        self.lease_s = max(float(lease_s), 0.1)
+        self.daemon_id = daemon_id or f"{os.uname().nodename}.{os.getpid()}"
+        self.leases_held = 0
+        self.claims_stolen = 0
+        self._lease_lost = False
+        self._lease_renew_wall = float("-inf")
+        self._renew_ord = 0
+        # highest journal seq already folded into the state mirrors —
+        # _refresh_journal reads past it to absorb fleet peers' records
+        self._refresh_seq = -1
+        # --- admission latency (arrival -> journaled admit) --------------
+        self._admit_latencies: "list[float]" = []
+        # --- per-job progress pub-sub (HTTP event streams) ---------------
+        self._subs_lock = threading.Lock()
+        self._progress_subs: "dict[str, list]" = {}
 
     # --- paths -----------------------------------------------------------
 
@@ -611,12 +763,19 @@ class DaemonService(SweepService):
             prom_path=self.metrics_prom,
         )
         self._install_signals()
+        if self.http_addr:
+            from shadow_tpu.runtime.httpapi import FrontDoor
+
+            self.front_door = FrontDoor(self, self.http_addr)
+            self.front_door.start()
         clean = False
         try:
             self._replay()
             self._drain(self.pending)
             clean = True
         finally:
+            if self.front_door is not None:
+                self.front_door.stop()
             self._restore_signals()
             try:
                 if clean:
@@ -697,6 +856,9 @@ class DaemonService(SweepService):
         resumed: "list[dict]" = []
         for rec in admits:
             resumed.extend(self._replay_admit(rec))
+        # everything on disk so far is folded into the mirrors; the
+        # fleet refresh starts past it (peers' appends land later)
+        self._refresh_seq = self.journal.count - 1
         if records or resumed or snap is not None:
             self.resume_report = {
                 "crashed": crashed,
@@ -898,8 +1060,14 @@ class DaemonService(SweepService):
         try:
             with open(path) as f:
                 text = f.read()
+            spool_mtime = os.stat(path).st_mtime
         except OSError:
             return  # racing the producer's rename; next scan gets it
+        # arrival stamp for the admission-latency percentiles: the
+        # submitter's nanosecond filename prefix (submit_spec and the
+        # HTTP front door both write it) beats the coarser spool mtime
+        m = re.match(r"^(\d{20})-", name)
+        arrival_wall = int(m.group(1)) / 1e9 if m else spool_mtime
         digest = hashlib.sha256(text.encode()).hexdigest()
         if digest in self._admitted_digests:
             # already journaled: a crash between journal and archive, or
@@ -923,7 +1091,24 @@ class DaemonService(SweepService):
                 f"{tenant!r} (submit under a new name)",
             )
             return
+        self._roll_window()
+        rem = self._budget_remaining(tenant)
+        if rem is not None and rem <= 0:
+            # the 429-equivalent: journaled, structured, and carrying
+            # the ledger's refill horizon as Retry-After — the HTTP
+            # front door mirrors this record verbatim
+            self._reject(
+                path, name, digest, tenant, "quota-class",
+                f"tenant {tenant!r} exhausted its device-seconds budget "
+                f"({self.quota_classes[tenant]['device_seconds']:g}s per "
+                f"{self.quota_window_s:g}s window)",
+                retry_after_s=self._retry_after_s(),
+            )
+            return
         quota = self.quotas.get(tenant, self.default_quota)
+        qc = self.quota_classes.get(tenant)
+        if qc is not None and qc.get("queue") is not None:
+            quota = qc["queue"]
         held = self._outstanding(tenant)
         if held + len(jobs) > quota:
             self._reject(
@@ -954,12 +1139,16 @@ class DaemonService(SweepService):
         # the journal embeds the CANONICAL spec (base: inlined, seeds
         # expanded), so a replay can never be changed by later edits to
         # an external base file — the admitted world is pinned here
+        admit_latency_s = round(max(0.0, time.time() - arrival_wall), 6)
         rec = self.journal.append(
             "admit", tenant=tenant, entry=entry,
             jobs=[j.name for j in jobs], seeds=[j.seed for j in jobs],
             priority=jobs[0].priority, spec_sha256=canon_digest,
             source_sha256=digest, spec_file=name, spec=canon,
+            admit_latency_s=admit_latency_s,
         )
+        self._admit_latencies.append(admit_latency_s)
+        del self._admit_latencies[:-512]
         self._register_admit(tenant, entry, rec, jobs)
         if chaos.fire("daemon-kill", at=self._admit_ord,
                       tags=("admit",)) is not None:
@@ -1001,13 +1190,16 @@ class DaemonService(SweepService):
         except OSError:
             pass
 
-    def _reject(self, path, name, digest, tenant, reason, detail) -> None:
+    def _reject(self, path, name, digest, tenant, reason, detail,
+                **extra) -> None:
         """Bounded-queue / quota / bad-spec refusal: a structured,
         journaled record plus a reply file next to the moved spec — the
-        submitter can read WHY without grepping daemon logs."""
+        submitter can read WHY without grepping daemon logs. `extra`
+        rides into the record (quota-class refusals carry
+        retry_after_s, the ledger's refill horizon)."""
         rec = self.journal.append(
             "reject", file=name, tenant=tenant, reason=reason,
-            detail=str(detail)[:400], spec_sha256=digest,
+            detail=str(detail)[:400], spec_sha256=digest, **extra,
         )
         tn = tenant or "?"
         self._rejected[tn] = self._rejected.get(tn, 0) + 1
@@ -1029,14 +1221,68 @@ class DaemonService(SweepService):
              f"injecting fault: daemon-kill at {site} — SIGKILL now")
         os.kill(os.getpid(), signal.SIGKILL)
 
+    # --- fleet coherence (N daemons, one spool) --------------------------
+
+    def _refresh_journal(self, pending: "list[Batch]") -> None:
+        """Absorb journal records fleet peers appended since our last
+        look: their terminal records settle jobs we hold pending (the
+        peer ran them), their admit records hand us their queue (so a
+        dead peer's batches are claimable here). Idempotent — our own
+        records re-read on the way are no-ops against the mirrors."""
+        for rec in self.journal.read_new(self._refresh_seq):
+            self._refresh_seq = max(self._refresh_seq, rec.get("seq", -1))
+            t = rec.get("type")
+            if t in ("job-done", "job-failed", "job-quarantined"):
+                job = rec.get("job")
+                if job:
+                    self._mark_terminal(job, t[len("job-"):])
+            elif (
+                t == "admit"
+                and rec.get("spec_sha256") not in self._admitted_digests
+            ):
+                self._replay_admit(rec)
+
+    def _prune_settled(self, pending: "list[Batch]") -> None:
+        """Drop pending batches whose jobs a fleet peer already finished
+        (absorbed via _refresh_journal) — claiming one would re-run
+        settled work."""
+        for b in list(pending):
+            if b.jobs and all(j.name in self._terminal for j in b.jobs):
+                pending.remove(b)
+                b.status = "done"
+
     # --- scheduling seams (SweepService overrides) -----------------------
 
     def _poll(self, pending: "list[Batch]") -> None:
+        self._refresh_journal(pending)
+        self._prune_settled(pending)
+        self._roll_window()
         self._scan_spool(pending)
+
+    def _blocked_on_claims(self, pending: "list[Batch]") -> bool:
+        """True when some arrived pending batch is unrunnable ONLY
+        because a live peer's lease covers it — drain mode must keep
+        waiting (the peer may die and its lease fall to us), while a
+        queue blocked purely by quota-class budgets may exit (parked
+        work is durable in the journal; a later daemon resumes it)."""
+        now = time.time()
+        for b in pending:
+            if b.arrival_ns > self.clock_ns:
+                continue
+            cur = self._read_claim(self._claim_path(b))
+            if (
+                cur is not None
+                and cur.get("owner") != self.daemon_id
+                and float(cur.get("expires", 0)) > now
+            ):
+                return True
+        return False
 
     def _idle(self, pending: "list[Batch]") -> bool:
         self._maybe_compact_journal()
-        if self.drain_mode or self._stop:
+        if self._stop:
+            return False
+        if self.drain_mode and not self._blocked_on_claims(pending):
             return False
         now = time.monotonic()
         if now - self._last_prom_wall >= self.prom_interval_s:
@@ -1071,6 +1317,268 @@ class DaemonService(SweepService):
             self.tenant_service[batch.tenant] = (
                 self.tenant_service.get(batch.tenant, 0.0) + delta_ns / w
             )
+
+    # --- quota classes (device-seconds budgets, enforced) ----------------
+
+    def _roll_window(self) -> None:
+        """Advance the quota refill window: once quota_window_s of wall
+        passes, every tenant's spend-base snaps to its current ledger
+        position — the budget refills without the ledger resetting."""
+        now = time.monotonic()
+        if now - self._window_start < self.quota_window_s:
+            return
+        periods = int((now - self._window_start) // self.quota_window_s)
+        self._window_start += periods * self.quota_window_s
+        self._accrue_device_seconds(rearm=True)
+        self._window_base = dict(self.tenant_device_seconds)
+        self._parked_note.clear()
+        if self.quota_classes:
+            slog("info", self.clock_ns, "daemon",
+                 "quota window rolled: every tenant's device-seconds "
+                 "budget refilled")
+
+    def _budget_remaining(self, tenant: str) -> "float | None":
+        """Device-seconds left in the tenant's current window, or None
+        when the tenant has no quota class (unmetered)."""
+        qc = self.quota_classes.get(tenant)
+        if qc is None or qc.get("device_seconds") is None:
+            return None
+        spent = self.tenant_device_seconds.get(
+            tenant, 0.0
+        ) - self._window_base.get(tenant, 0.0)
+        return qc["device_seconds"] - spent
+
+    def _retry_after_s(self) -> float:
+        """Seconds until the ledger's next refill window — the
+        Retry-After of a quota-class refusal."""
+        return round(
+            max(
+                0.0,
+                self.quota_window_s
+                - (time.monotonic() - self._window_start),
+            ),
+            3,
+        )
+
+    # --- fleet claims (journal-safe batch ownership) ---------------------
+
+    def _claim_path(self, batch: Batch) -> str:
+        key = batch.dir_key or f"b{batch.index:03d}"
+        return os.path.join(self._sub("claims"), f"claim-{key}.json")
+
+    def _read_claim(self, path: str) -> "dict | None":
+        """The claim file's record, or None when absent/unreadable. A
+        torn or corrupt claim reads as None — claimable, which at worst
+        costs a redundant-but-idempotent re-run, never a lost batch."""
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return doc if isinstance(doc, dict) else None
+
+    def _claim_doc(self, batch: Batch) -> dict:
+        return {
+            "owner": self.daemon_id,
+            "expires": round(time.time() + self.lease_s, 3),
+            "key": batch.dir_key or f"b{batch.index:03d}",
+            "jobs": [j.name for j in batch.jobs],
+        }
+
+    def _claim(self, batch: Batch) -> bool:
+        """Take the batch's lease before dispatch. Exactly one daemon
+        wins: a fresh claim commits with O_CREAT|O_EXCL, a dead peer's
+        expired claim is stolen by atomic rename (one stealer wins the
+        rename; everyone else sees ENOENT and retries the EXCL create).
+        After winning, the journal is re-read: if a peer finished these
+        jobs while we raced, the lease is dropped and the batch prunes
+        instead of re-running settled work."""
+        path = self._claim_path(batch)
+        cur = self._read_claim(path)
+        now = time.time()
+        if cur is not None:
+            owner = cur.get("owner")
+            if owner != self.daemon_id and float(cur.get("expires", 0)) > now:
+                return False  # a live peer owns it
+            # expired (or our own stale) claim: steal by rename — the
+            # atomic winner-take-all step of the reclaim protocol
+            steal = f"{path}.steal.{os.getpid()}"
+            try:
+                os.rename(path, steal)
+            except OSError:
+                return False  # a peer won the steal race this cycle
+            try:
+                os.remove(steal)
+            except OSError:
+                pass
+            if owner != self.daemon_id:
+                self.claims_stolen += 1
+                self.journal.append(
+                    "claim-steal", key=cur.get("key"),
+                    from_owner=owner, owner=self.daemon_id,
+                    jobs=[j.name for j in batch.jobs],
+                )
+                slog("warning", self.clock_ns, "daemon",
+                     f"reclaimed expired lease on {cur.get('key')} from "
+                     f"{owner} — resuming from its newest checkpoint")
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False  # a peer committed between our read and create
+        except OSError:
+            return False
+        with os.fdopen(fd, "w") as f:
+            json.dump(self._claim_doc(batch), f)
+        self.leases_held += 1
+        # the post-claim journal check: a peer may have FINISHED these
+        # jobs between our runnability check and the lease commit
+        self._refresh_journal(self.pending)
+        if batch.jobs and all(j.name in self._terminal for j in batch.jobs):
+            self._release_claim(batch)
+            return False  # _prune_settled drops it next cycle
+        # a batch inherited from a peer (crash, expiry): resume from the
+        # newest checkpoint valid for this exact batch config
+        self._refresh_resume(batch)
+        return True
+
+    def _release_claim(self, batch: Batch) -> None:
+        path = self._claim_path(batch)
+        cur = self._read_claim(path)
+        if cur is not None and cur.get("owner") == self.daemon_id:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        self.leases_held = max(0, self.leases_held - 1)
+
+    def _renew_lease(self, batch: Batch) -> None:
+        """Chunk-tick lease renewal, throttled to lease_s/4 of wall. A
+        claim that no longer names us (stolen after an expiry we slept
+        through, or the `lease-steal` chaos fault) flips _lease_lost:
+        the batch parks at the next chunk boundary and the thief — real
+        or injected — owns the work."""
+        from shadow_tpu.runtime import chaos
+
+        now = time.time()
+        if now - self._lease_renew_wall < self.lease_s / 4:
+            return
+        self._lease_renew_wall = now
+        path = self._claim_path(batch)
+        if chaos.fire("lease-steal", at=self._renew_ord) is not None:
+            thief = {
+                **self._claim_doc(batch),
+                "owner": "chaos-thief",
+                "expires": round(now + self.lease_s, 3),
+            }
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(thief, f)
+            os.replace(tmp, path)
+            slog("warning", self.clock_ns, "chaos",
+                 f"injected fault: lease-steal on {thief['key']} — the "
+                 "claim now names a foreign owner")
+        self._renew_ord += 1
+        cur = self._read_claim(path)
+        if cur is None or cur.get("owner") != self.daemon_id:
+            self._lease_lost = True
+            return
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(self._claim_doc(batch), f)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # renewal retries next tick; expiry is the backstop
+
+    def _refresh_resume(self, batch: Batch) -> None:
+        """Point batch.resume_ckpt at the newest valid checkpoint for
+        this exact batch config — the claim-steal resume step (the dead
+        owner checkpointed right up to its last chunk; our own replay
+        snapshot may be staler or absent)."""
+        from shadow_tpu.runtime.checkpoint import (
+            CheckpointManager,
+            peek_checkpoint_meta,
+        )
+
+        ckpt_dir = os.path.join(self._batch_dir(batch), "ckpts")
+        path = CheckpointManager.latest_path(ckpt_dir)
+        if path is not None:
+            try:
+                meta = peek_checkpoint_meta(path)
+                if meta.get("fingerprint") != config_fingerprint(
+                    self._batch_config(batch)
+                ):
+                    path = None
+            except Exception:  # noqa: BLE001 — unusable = scratch
+                path = None
+        if path is not None:
+            batch.resume_ckpt = path
+
+    def _runnable(self, batch: Batch) -> bool:
+        """Arrived batches filter out when their tenant's quota-class
+        budget is exhausted (parked until the window refills) or a live
+        fleet peer's lease covers them."""
+        tenant = batch.tenant or self.default_tenant
+        rem = self._budget_remaining(tenant)
+        if rem is not None and rem <= 0:
+            return False
+        cur = self._read_claim(self._claim_path(batch))
+        if (
+            cur is not None
+            and cur.get("owner") != self.daemon_id
+            and float(cur.get("expires", 0)) > time.time()
+        ):
+            return False
+        return True
+
+    def _should_park(self, batch: Batch) -> bool:
+        """Chunk-boundary park triggers: the lease was lost to a thief,
+        or the tenant's budget ran dry mid-batch. Either way the batch
+        checkpoints and re-queues via the preemption guard — parked,
+        never lost."""
+        tenant = batch.tenant or self.default_tenant
+        reason = None
+        if self._lease_lost:
+            reason = "lease-lost"
+        else:
+            rem = self._budget_remaining(tenant)
+            if rem is not None and rem <= 0:
+                reason = "quota-class"
+        if reason is None:
+            return False
+        key = batch.dir_key or f"b{batch.index:03d}"
+        if key not in self._parked_note:
+            # journal the park once per batch-run (the guard re-checks
+            # every tick until the checkpoint boundary lands)
+            self._parked_note.add(key)
+            extra = (
+                {"retry_after_s": self._retry_after_s()}
+                if reason == "quota-class" else {}
+            )
+            self.journal.append(
+                "park", key=key, tenant=tenant, reason=reason,
+                jobs=[j.name for j in batch.jobs], **extra,
+            )
+            slog("warning", self.clock_ns, "daemon",
+                 f"parking batch {key} ({reason}): checkpoint at the "
+                 "next chunk boundary, then re-queue")
+        return True
+
+    def _run_batch(self, batch: Batch, pending: "list[Batch]") -> None:
+        self._lease_lost = False
+        self._lease_renew_wall = float("-inf")
+        try:
+            super()._run_batch(batch, pending)
+        finally:
+            # release AFTER terminal records are journaled (they land in
+            # _write_batch_outputs -> _on_job_terminal before this
+            # frame unwinds), so a peer never sees an unclaimed batch
+            # with non-terminal jobs it could double-run. A lost lease
+            # is not ours to release — the thief owns the claim file.
+            if self._lease_lost:
+                self.leases_held = max(0, self.leases_held - 1)
+            else:
+                self._release_claim(batch)
 
     def _ckpt_interval_ns(self, cfgo: ConfigOptions) -> int:
         # periodic checkpoints bound the work a SIGKILL can cost a
@@ -1126,12 +1634,17 @@ class DaemonService(SweepService):
         self._chunk_ticks += 1
         now = time.monotonic()
         # per-tenant device-seconds at chunk cadence (so a SIGKILL
-        # loses at most one chunk's worth of accounting)
+        # loses at most one chunk's worth of accounting) — also the
+        # enforcement read: _should_park sees a live ledger every tick
         self._accrue_device_seconds(rearm=True)
+        self._roll_window()
+        self._renew_lease(batch)
         if now - self._last_poll_wall >= self.poll_interval_s:
             self._last_poll_wall = now
             # live arrivals mid-batch: a higher-priority admission here
-            # arms the preemption guard at the next chunk boundary
+            # arms the preemption guard at the next chunk boundary —
+            # and fleet peers' journal records absorb at the same cadence
+            self._refresh_journal(pending)
             self._scan_spool(pending)
         if now - self._last_prom_wall >= self.prom_interval_s:
             # the satellite fix: gauges advance on a WALL cadence while
@@ -1176,6 +1689,15 @@ class DaemonService(SweepService):
         if record.get("stats"):
             entry["events"] = record["stats"].get("events_handled")
         self.journal.append(_TERMINAL_TYPES.get(status, "job-done"), **entry)
+        # terminal sentinel to event-stream subscribers: the stream ends
+        # with the job's outcome (runtime/httpapi.py)
+        if self._progress_subs:
+            with self._subs_lock:
+                for q in list(self._progress_subs.get(name, ())):
+                    try:
+                        q.put_nowait({"job": name, "terminal": status})
+                    except Exception:  # noqa: BLE001
+                        pass
         self._maybe_prune(record)
         self._maybe_compact_journal()
 
@@ -1218,6 +1740,107 @@ class DaemonService(SweepService):
             self._sub("batches"), self.keep_batch_dirs, protect=protect
         )
 
+    # --- HTTP front-door support (runtime/httpapi.py) --------------------
+
+    def _on_progress(self, name: str, point: dict) -> None:
+        if not self._progress_subs:
+            return
+        with self._subs_lock:
+            for q in list(self._progress_subs.get(name, ())):
+                try:
+                    q.put_nowait({"job": name, **point})
+                except Exception:  # noqa: BLE001 — a full/closed
+                    pass  # subscriber queue never stalls the drain loop
+
+    def subscribe_progress(self, name: str):
+        """A bounded queue of progress points for one job — the HTTP
+        event stream's feed, filled by _on_progress at chunk cadence
+        and closed by the terminal sentinel _on_job_terminal posts."""
+        import queue as _queue
+
+        q = _queue.Queue(maxsize=256)
+        with self._subs_lock:
+            self._progress_subs.setdefault(name, []).append(q)
+        return q
+
+    def unsubscribe_progress(self, name: str, q) -> None:
+        with self._subs_lock:
+            subs = self._progress_subs.get(name, [])
+            if q in subs:
+                subs.remove(q)
+            if not subs:
+                self._progress_subs.pop(name, None)
+
+    def job_status(self, job_id: str) -> "dict | None":
+        """One job's status document (GET /v1/jobs/{id}): admitted ->
+        queued/running off the live progress mirror, terminal off the
+        journal-backed terminal map. None = never admitted (404)."""
+        tenant = self._job_tenant.get(job_id)
+        if tenant is None:
+            return None
+        terminal = self._terminal.get(job_id)
+        progress = self.job_progress.get(job_id)
+        if terminal is not None:
+            status = terminal
+        elif progress and (progress.get("now_ns") or progress.get("events")):
+            status = "running"
+        else:
+            status = "queued"
+        doc = {"job": job_id, "tenant": tenant, "status": status}
+        if progress:
+            doc["progress"] = dict(progress)
+        rec = self.job_records.get(job_id)
+        if rec:
+            for k in ("stats", "failure", "error", "wall_seconds"):
+                if rec.get(k) is not None:
+                    doc[k] = rec[k]
+        return doc
+
+    def job_results_path(self, job_id: str) -> str:
+        return os.path.join(self.spool_dir, "jobs", job_id,
+                            "sim-stats.json")
+
+    def http_refusal(self, tenant, reason, detail, **extra) -> dict:
+        """A front-door refusal that never touched the spool: journaled
+        with the same structured record the .reason.json reply files
+        carry, so an HTTP 4xx is as auditable as a spool rejection."""
+        rec = self.journal.append(
+            "reject", via="http", tenant=tenant, reason=reason,
+            detail=str(detail)[:400], **extra,
+        )
+        tn = tenant or "?"
+        self._rejected[tn] = self._rejected.get(tn, 0) + 1
+        rec2 = getattr(self, "recorder", None)
+        if rec2 is not None:
+            rec2.event("reject", tenant=tenant, reason=reason, via="http")
+        return rec
+
+    def spool_body(self, text: str, label: str) -> str:
+        """Atomically drop an HTTP-submitted spec into incoming/ — the
+        identical write-then-rename protocol submit_spec uses, stamped
+        with the receive-time nanosecond prefix, so HTTP admissions ride
+        the journal-crash-safe path (and its latency percentiles)
+        unchanged."""
+        inc = self._sub("incoming")
+        dest = os.path.join(
+            inc, f"{time.time_ns():020d}-http-{label}.yaml"
+        )
+        tmp = os.path.join(
+            inc, f".{os.path.basename(dest)}.tmp.{os.getpid()}"
+        )
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, dest)
+        return dest
+
+    def render_metrics(self) -> str:
+        """The prom textfile as a string (GET /v1/metrics): the same
+        gauge set _write_prom persists, rendered without touching
+        disk."""
+        return self.recorder.render_prom(
+            extra_gauges=self._prom_gauges(self.pending)
+        )
+
     # --- telemetry -------------------------------------------------------
 
     def _prom_gauges(self, pending: "list[Batch]") -> dict:
@@ -1234,12 +1857,24 @@ class DaemonService(SweepService):
             g[f'shadow_tpu_tenant_queue_depth{{tenant="{t}"}}'] = (
                 self._outstanding(t)
             )
-        # device-seconds served per tenant (accounting only — ROADMAP
-        # item 5 groundwork for device-time quota classes)
+        # device-seconds served per tenant (the quota-class ledger)
         for t in sorted(self.tenant_device_seconds):
             g[f'shadow_tpu_tenant_device_seconds{{tenant="{t}"}}'] = round(
                 self.tenant_device_seconds[t], 3
             )
+        # budget left this window, per quota-classed tenant (clamped at
+        # 0: "how much runway" — overdraft detail lives in the ledger)
+        for t in sorted(self.quota_classes):
+            rem = self._budget_remaining(t)
+            if rem is not None:
+                g[
+                    f'shadow_tpu_tenant_budget_remaining{{tenant="{t}"}}'
+                ] = round(max(rem, 0.0), 3)
+        g[
+            f'shadow_tpu_daemon_leases_held{{daemon="{self.daemon_id}"}}'
+        ] = self.leases_held
+        if self.front_door is not None:
+            g.update(self.front_door.gauges())
         stats = self.cache.stats()
         if "persistent" in stats:
             p = stats["persistent"]
@@ -1277,6 +1912,15 @@ class DaemonService(SweepService):
                 "rejected_specs": self._rejected.get(t, 0),
                 "quota": self.quotas.get(t, self.default_quota),
                 "weight": self.weights.get(t, 1.0),
+                **(
+                    {
+                        "quota_class": self.quota_classes[t],
+                        "budget_remaining_s": round(
+                            max(self._budget_remaining(t) or 0.0, 0.0), 3
+                        ),
+                    }
+                    if t in self.quota_classes else {}
+                ),
                 "service_sim_s": round(
                     self.tenant_service.get(t, 0.0) / 1e9, 4
                 ),
@@ -1293,8 +1937,21 @@ class DaemonService(SweepService):
         done_this_run = m["jobs_done"]
         m["daemon"] = {
             "spool": self.spool_dir,
+            "id": self.daemon_id,
             "drain": self.drain_mode,
             "uptime_s": round(time.monotonic() - self._t0, 3),
+            "leases_held": self.leases_held,
+            "claims_stolen": self.claims_stolen,
+            # arrival -> journaled-admit wall per job this run, nearest-
+            # rank percentiles (docs/service.md "HTTP front door")
+            "admit_latency": {
+                "count": len(self._admit_latencies),
+                **_percentiles(self._admit_latencies),
+            },
+            **(
+                {"http": self.front_door.describe()}
+                if self.front_door is not None else {}
+            ),
             "jobs_per_hour": (
                 round(done_this_run / wall * 3600, 1) if wall > 0 else None
             ),
@@ -1365,3 +2022,45 @@ def submit_spec(spool_dir: str, spec_path: str,
         yaml.safe_dump(raw, f, sort_keys=False)
     os.replace(tmp, dest)
     return dest
+
+
+def spec_job_ids(spec_path: str, tenant: "str | None" = None):
+    """The canonical job ids a spec will admit under — tenant, entry
+    name, and seed expansion ONLY, no config validation (a bad scenario
+    must become the daemon's journaled rejection, not a submit-side
+    crash). Returns (tenant, entry, ids); `shadow-tpu submit` prints
+    the ids and --wait polls them."""
+    with open(spec_path) as f:
+        raw = yaml.safe_load(f.read())
+    if not isinstance(raw, dict) or not isinstance(raw.get("job"), dict):
+        raise ValueError("spec must be a mapping with a 'job' section")
+    j = dict(raw["job"])
+    t = str(tenant if tenant is not None else j.get("tenant", "default"))
+    ename = str(j.get("name", ""))
+    for label, val in (("tenant", t), ("name", ename)):
+        if not _NAME_RE.match(val or ""):
+            raise ValueError(
+                f"job.{label} {val!r} must match {_NAME_RE.pattern}"
+            )
+    seeds = _expand_seeds(
+        ename,
+        {k: j[k] for k in ("seeds", "seed_range") if k in j},
+    )
+    return t, ename, [f"{t}.{ename}-s{s}" for s in seeds]
+
+
+def journal_terminal_map(spool_dir: str) -> "dict[str, str]":
+    """job -> terminal status from a spool's journal, snapshot + tail —
+    the polling read `shadow-tpu submit --wait` uses. Read-only and
+    safe against live daemons: records commit atomically and corrupt
+    ones are skipped."""
+    j = Journal(os.path.join(spool_dir, "journal"))
+    term: "dict[str, str]" = {}
+    recs = j.replay()
+    if j.snapshot:
+        term.update(j.snapshot.get("terminal", {}))
+    for r in recs:
+        t = r.get("type")
+        if t in ("job-done", "job-failed", "job-quarantined"):
+            term[r.get("job")] = t[len("job-"):]
+    return term

@@ -406,14 +406,10 @@ class FlightRecorder:
 
     # --- prometheus textfile -------------------------------------------
 
-    def write_prom(self, path: "str | None" = None,
-                   extra_gauges: "dict | None" = None) -> "str | None":
-        """Rewrite the Prometheus textfile snapshot (node-exporter
-        textfile-collector format: atomic rename, so a scrape never sees
-        a partial file)."""
-        path = path or self.prom_path
-        if not path:
-            return None
+    def render_prom(self, extra_gauges: "dict | None" = None) -> str:
+        """Render the Prometheus snapshot as text — the body write_prom
+        persists, and what `GET /v1/metrics` serves straight off the
+        daemon (runtime/httpapi.py) without touching the textfile."""
         p = self._prev
         gauges = {
             "shadow_tpu_sim_time_ns": p.now if p else 0,
@@ -457,11 +453,22 @@ class FlightRecorder:
                 typed.add(family)
                 lines.append(f"# TYPE {family} gauge")
             lines.append(f"{name} {gauges[name]}")
+        return "\n".join(lines) + "\n"
+
+    def write_prom(self, path: "str | None" = None,
+                   extra_gauges: "dict | None" = None) -> "str | None":
+        """Rewrite the Prometheus textfile snapshot (node-exporter
+        textfile-collector format: atomic rename, so a scrape never sees
+        a partial file)."""
+        path = path or self.prom_path
+        if not path:
+            return None
+        text = self.render_prom(extra_gauges)
         try:
             os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
             tmp = f"{path}.tmp.{os.getpid()}"
             with open(tmp, "w") as f:
-                f.write("\n".join(lines) + "\n")
+                f.write(text)
             os.replace(tmp, path)
             return path
         except OSError:

@@ -437,6 +437,31 @@ class SweepService:
         the daemon adds weighted tenant fair-share within a priority."""
         return min(ready, key=lambda b: (-b.priority, b.arrival_ns, b.index))
 
+    def _runnable(self, batch: Batch) -> bool:
+        """May this arrived batch be scheduled right now? One-shot
+        sweeps run everything; the daemon filters batches whose tenant
+        is over its quota-class budget (parked until the window refills)
+        or whose claim file a fleet peer holds unexpired."""
+        return True
+
+    def _claim(self, batch: Batch) -> bool:
+        """Take exclusive ownership of `batch` before dispatch. One-shot
+        sweeps own their whole queue; a fleet daemon commits a lease
+        file here — False means a peer won the race and the batch goes
+        back to pending (its claim now filters it via _runnable)."""
+        return True
+
+    def _should_park(self, batch: Batch) -> bool:
+        """Checked at every chunk tick of the running batch: True parks
+        it — verified checkpoint at the next boundary, re-queue, not
+        lost — via the same guard path preemption uses (daemon: the
+        tenant's quota-class budget ran out, or its lease was lost)."""
+        return False
+
+    def _on_progress(self, name: str, point: dict) -> None:
+        """A job's per-chunk probe row landed in job_progress (daemon:
+        fan out to HTTP event-stream subscribers)."""
+
     def _on_batch_start(self, batch: Batch, depth: int) -> None:
         """A batch was dispatched (daemon: journal record + kill seam)."""
 
@@ -472,8 +497,21 @@ class SweepService:
                 # arrival (nothing is executing, so no sim time passes)
                 self.clock_ns = min(b.arrival_ns for b in pending)
                 continue
-            batch = self._select(ready)
+            runnable = [b for b in ready if self._runnable(b)]
+            if not runnable:
+                # every arrived batch is blocked (daemon: parked tenant
+                # budgets, a fleet peer's unexpired leases) — wait like
+                # an empty queue instead of spinning on the filter
+                if not self._idle(pending):
+                    break
+                continue
+            batch = self._select(runnable)
             pending.remove(batch)
+            if not self._claim(batch):
+                # a fleet peer won the claim race: back to pending — the
+                # fresh foreign lease now filters it via _runnable
+                pending.append(batch)
+                continue
             # queue-depth gauge at every scheduling decision (the running
             # batch counts toward the depth); getattr because the
             # retry-ladder unit tests drive a bare service shell
@@ -717,6 +755,7 @@ class SweepService:
                 series = self.job_series.setdefault(name, [])
                 series.append({"clock_ns": self.clock_ns, **point})
                 del series[:-64]
+                self._on_progress(name, point)
 
         if self.mesh is not None:
             # 2-D mesh batch (docs/parallelism.md "2-D mesh"): the same
@@ -814,6 +853,10 @@ class SweepService:
             if self._stopping():
                 # graceful shutdown (daemon SIGTERM): checkpoint at the
                 # next boundary and requeue — restart resumes bit-exact
+                guard.arm()
+            if self._should_park(batch):
+                # quota-class exhaustion or lease loss mid-run (daemon):
+                # same checkpoint-and-requeue path — parked, never lost
                 guard.arm()
             if any(
                 b.arrival_ns <= self.clock_ns and b.priority > batch.priority

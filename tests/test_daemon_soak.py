@@ -18,6 +18,12 @@ persistent poison-job capacity fault. The acceptance bar:
   * jobs/hour and cache-hit-rate are published (the numbers bench
     mirrors under detail.service).
 
+A second scenario soaks the FLEET contract (docs/service.md "Running a
+fleet"): two daemons on one spool, one SIGKILLed mid-batch — the
+survivor must wait out the dead daemon's lease, journal the claim
+steal, resume from the newest checkpoint, and finish every job with
+sim-stats bit-exact to uninterrupted standalone runs.
+
 Runs under the `soak` marker (registered in pyproject.toml), excluded
 from tier-1 via `slow`. SHADOW_TPU_SOAK_JOBS overrides the job count.
 """
@@ -202,3 +208,133 @@ def test_soak_100_jobs_3_tenants_chaos(tmp_path):
     # corruption fault forced one recompile
     assert cache["hit_rate"] >= 0.5 or cache["compiles"] <= 2
     assert crashes >= 1, "the chaos phase must have killed the daemon"
+
+
+# ---- fleet: SIGKILL one of two daemons, survivor reclaims the lease ----
+
+# fast-checkpointing small world: enough chunks that the kill lands
+# mid-batch with checkpoints on disk, small enough that standalone
+# comparison runs stay cheap
+FLEET_CONFIG = {
+    "general": {
+        "stop_time": "600 ms",
+        "heartbeat_interval": None,
+        "tracker": True,
+        "checkpoint_interval": "20 ms",
+    },
+    "network": {"graph": {"type": "1_gbit_switch"}},
+    "experimental": {"rounds_per_chunk": 4},
+    "hosts": {
+        "peer": {
+            "network_node_id": 0,
+            "quantity": 8,
+            "processes": [
+                {
+                    "path": "phold",
+                    "args": {"min_delay": "2 ms", "max_delay": "12 ms"},
+                }
+            ],
+        }
+    },
+}
+
+
+def _trajectory_stats(path) -> dict:
+    """sim-stats.json modulo wall-clock and execution-shape counters
+    (the test_daemon_cli.py comparison idiom): a daemon ensemble batch
+    and a sharded standalone run legitimately differ in drain-iteration
+    shape; every trajectory fact must not."""
+    s = json.loads(path.read_text())
+    s.pop("wall_seconds")
+    s.pop("memory", None)
+    if "tracker" in s:
+        s["tracker"].pop("phases", None)
+        for k in ("iters", "lanes_live", "occupancy"):
+            s["tracker"].get("window", {}).pop(k, None)
+    return s
+
+
+def test_fleet_sigkill_lease_reclaim_bit_exact(tmp_path):
+    """Acceptance: SIGKILL of either fleet daemon mid-batch is recovered
+    by the survivor via lease expiry — claim steal journaled, batch
+    resumed from the victim's newest checkpoint, zero lost jobs, zero
+    double-claims, and outputs bit-exact vs standalone runs."""
+    import signal
+    import time
+
+    spool = tmp_path / "spool"
+    cache = tmp_path / "cache"
+    jobs = [("alice", "a", (1, 2)), ("bob", "b", (3, 4))]
+    for i, (tenant, name, seeds) in enumerate(jobs):
+        spec = tmp_path / f"{tenant}.yaml"
+        spec.write_text(yaml.safe_dump({
+            "job": {"tenant": tenant, "name": name,
+                    "seeds": list(seeds), "config": FLEET_CONFIG}
+        }))
+        assert run_submit(str(spool), str(spec)) == 0
+
+    env = dict(os.environ)
+    env.update(PYTHONPATH="", JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+
+    def serve(daemon_id):
+        return subprocess.Popen(
+            [sys.executable, "-m", "shadow_tpu.cli", "serve", str(spool),
+             "--drain", "--poll-interval", "0.2", "--lease-s", "6",
+             "--daemon-id", daemon_id, "--cache-dir", str(cache)],
+            cwd=REPO_ROOT, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+
+    # victim: kill the instant a checkpoint commits — mid-batch with a
+    # held lease and a resumable trajectory on disk
+    victim = serve("victim")
+    deadline = time.monotonic() + 600
+    killed = False
+    while time.monotonic() < deadline:
+        ckpts = list((spool / "batches").glob("*/ckpts/ckpt-*.npz"))
+        if ckpts and victim.poll() is None:
+            victim.send_signal(signal.SIGKILL)
+            killed = True
+            break
+        time.sleep(0.1)
+    assert killed, "victim never reached a checkpoint"
+    assert victim.wait(timeout=60) in (-9, 137)
+    claims = list((spool / "claims").glob("claim-*.json"))
+    assert claims, "the dead daemon's claim must survive the kill"
+
+    survivor = serve("survivor")
+    out, _ = survivor.communicate(timeout=900)
+    assert survivor.returncode == 0, out
+
+    recs = []
+    for f in sorted((spool / "journal").glob("r*.json")):
+        recs.append(json.loads(f.read_text()))
+    steals = [r for r in recs if r["type"] == "claim-steal"]
+    assert steals and steals[0]["from_owner"] == "victim"
+    assert steals[0]["owner"] == "survivor"
+    done = [r["job"] for r in recs if r["type"] == "job-done"]
+    expected = sorted(
+        f"{t}.{n}-s{s}" for t, n, seeds in jobs for s in seeds
+    )
+    # exactly-once: zero lost AND zero double-claimed
+    assert sorted(done) == expected
+    assert not list((spool / "claims").glob("claim-*.json"))
+
+    # bit-exact vs uninterrupted standalone runs, including the batch
+    # that crossed the kill + resume
+    from shadow_tpu.runtime.cli_run import run_from_config
+
+    for tenant, name, seeds in jobs:
+        for seed in seeds:
+            alone = tmp_path / f"alone-s{seed}"
+            cfg = tmp_path / f"alone-s{seed}.yaml"
+            raw = json.loads(json.dumps(FLEET_CONFIG))
+            raw["general"]["seed"] = seed
+            raw["general"]["data_directory"] = str(alone)
+            cfg.write_text(yaml.safe_dump(raw))
+            assert run_from_config(str(cfg)) == 0
+            job = f"{tenant}.{name}-s{seed}"
+            assert _trajectory_stats(
+                spool / "jobs" / job / "sim-stats.json"
+            ) == _trajectory_stats(alone / "sim-stats.json"), job

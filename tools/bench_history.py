@@ -64,6 +64,10 @@ def _service_row(detail: dict) -> "dict | None":
         "jobs_per_hour": svc.get("jobs_per_hour"),
         "cache_hit_rate": svc.get("cache_hit_rate"),
     }
+    # fleet-wide admission latency (ISSUE 20): lower-is-better, only
+    # present once the HTTP+fleet rung started publishing it
+    if svc.get("admit_latency_p99_s") is not None:
+        row["admit_latency_p99_s"] = svc["admit_latency_p99_s"]
     if row["jobs_per_hour"] is None:
         sweep = detail.get("sweep") or {}
         row["jobs_per_hour"] = sweep.get("jobs_per_hour")
@@ -325,15 +329,29 @@ def memory_check(rounds: "list[dict]",
 def service_check(rounds: "list[dict]",
                   current: "dict | None" = None) -> dict:
     """The detail.service trajectory verdicts — jobs_per_hour and
-    cache_hit_rate each get the SAME best-prior/TOLERANCE flagging the
-    headline metric gets (regression_check). `current` is an in-flight
-    {jobs_per_hour, cache_hit_rate} from bench.py; None compares the
-    newest recorded round against the rest."""
+    cache_hit_rate get the SAME best-prior/TOLERANCE flagging the
+    headline metric gets (regression_check), and admit_latency_p99_s
+    (the ISSUE-20 admission-latency satellite) the inverted
+    lower-is-better direction. `current` is an in-flight
+    {jobs_per_hour, cache_hit_rate, admit_latency_p99_s} from bench.py;
+    None compares the newest recorded round against the rest."""
     history, current, latest_round = _pop_latest("service", rounds, current)
     out, verdicts = _metric_verdicts(
         "service", ("jobs_per_hour", "cache_hit_rate"), history, current,
         latest_round,
     )
+    # latency is a cost: only flag it once some round has measured it
+    # (pre-ISSUE-20 history must not turn every round into a null-slide)
+    if (current or {}).get("admit_latency_p99_s") is not None or any(
+        r["service"].get("admit_latency_p99_s") is not None
+        for r in history
+    ):
+        out_lat, v_lat = _metric_verdicts(
+            "service", ("admit_latency_p99_s",), history, current,
+            latest_round, lower_is_better=True,
+        )
+        out["regression"] = out["regression"] or out_lat["regression"]
+        verdicts.update(v_lat)
     out["metrics"] = verdicts
     return out
 
